@@ -1,0 +1,29 @@
+"""mxnet_tpu: a TPU-native deep learning framework with the API surface of
+dmlc-era MXNet (reference at /root/reference), rebuilt from scratch on
+jax/XLA/pjit/Pallas.
+
+Layer map (vs SURVEY.md §1): the reference's engine/storage/graph-executor
+layers collapse into XLA's runtime and compiler; what remains user-visible —
+NDArray, Symbol, Executor, KVStore, DataIter, FeedForward — is re-implemented
+TPU-first here.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# Honor explicit float64 dtypes (the reference supports f64 arrays; JAX
+# truncates to f32 unless x64 is enabled). Python scalars stay weakly typed,
+# so f32/bf16 compute paths are unaffected. NOTE: this is process-global; a
+# host program mixing its own JAX code with this library will also see x64
+# honored. Framework-internal code must therefore pass explicit dtypes (or
+# python-float scalars) everywhere — never numpy float64 scalars.
+_jax.config.update("jax_enable_x64", True)
+
+from .base import MXNetError  # noqa: E402
+from .context import Context, current_context, cpu, gpu, tpu, cpu_pinned  # noqa: E402
+from . import ndarray  # noqa: E402
+from . import ndarray as nd  # noqa: E402
+from .ndarray import NDArray  # noqa: E402
+from . import random  # noqa: E402
+
+__version__ = "0.1.0"

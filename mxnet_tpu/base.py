@@ -1,0 +1,61 @@
+"""Base types, dtype codes and error handling for the TPU-native rebuild.
+
+The reference exposes a ctypes C ABI (``/root/reference/python/mxnet/base.py``,
+``include/mxnet/c_api.h``). Here the runtime is in-process (JAX/XLA), so this
+module keeps only the pieces with user-visible semantics: the mshadow dtype
+codes used by the checkpoint format (``include/mxnet/base.h``, mshadow
+``kFloat32..kInt32``) and the ``MXNetError`` exception type.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MXNetError", "mx_uint", "mx_float", "string_types",
+           "DTYPE_NP_TO_MX", "DTYPE_MX_TO_NP"]
+
+
+class MXNetError(Exception):
+    """Error raised by the framework (parity: ``MXGetLastError`` errors)."""
+
+
+string_types = (str,)
+mx_uint = int
+mx_float = float
+
+# mshadow type codes — used on disk by the NDArray save format and by the
+# C-API dtype handshake (reference: mshadow/base.h kFloat32=0, kFloat64=1,
+# kFloat16=2, kUint8=3, kInt32=4).
+DTYPE_NP_TO_MX = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int32): 4,
+}
+DTYPE_MX_TO_NP = {v: k for k, v in DTYPE_NP_TO_MX.items()}
+
+# TPU-era extension codes (not in the 2015 reference): bfloat16 is the native
+# MXU dtype. Code chosen outside the reference range so reference files never
+# collide.
+try:  # ml_dtypes ships with jax
+    import ml_dtypes  # noqa: F401
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+    DTYPE_NP_TO_MX[_BFLOAT16] = 16
+    DTYPE_MX_TO_NP[16] = _BFLOAT16
+except ImportError:  # pragma: no cover
+    _BFLOAT16 = None
+
+
+def np_dtype(dtype) -> np.dtype:
+    """Normalize a user-provided dtype to a numpy dtype we support."""
+    dt = np.dtype(dtype)
+    if dt not in DTYPE_NP_TO_MX:
+        raise MXNetError("unsupported dtype %s" % dt)
+    return dt
+
+
+def check_call(ret):
+    """Kept for API parity with the ctypes binding; a no-op in-process."""
+    if ret != 0:
+        raise MXNetError("API call returned %s" % ret)

@@ -1,0 +1,632 @@
+"""NDArray: the imperative array API, rebuilt on JAX/XLA.
+
+Parity target: ``/root/reference/python/mxnet/ndarray.py`` (user API) and
+``/root/reference/src/ndarray/ndarray.cc`` + ``include/mxnet/ndarray.h``
+(semantics: mutation, zero-copy axis-0 slices and reshapes, asynchronous
+execution, binary checkpoint format at ``ndarray.cc:518-640``).
+
+TPU-first design
+----------------
+The reference queues every op onto a threaded dependency engine and backs
+arrays with raw device pointers. On TPU, XLA's runtime *is* the async engine:
+each jnp op dispatches asynchronously and ``asnumpy()``/``wait_to_read()``
+block on the XLA future — so the whole engine layer (``src/engine/``)
+collapses into the PJRT runtime. Mutation and views are preserved on top of
+immutable XLA buffers with a storage-chunk indirection:
+
+* ``_Chunk`` owns one flat device buffer (the analogue of
+  ``Chunk{Storage::Handle}`` at ``include/mxnet/ndarray.h:269-340``).
+* An ``NDArray`` is ``(chunk, shape, offset)`` — exactly the reference's
+  view triple (``ndarray.h:227-250``); ``Slice``/``Reshape`` share the chunk.
+* Writes replace or ``.at[...].set`` the chunk's buffer, so every view sees
+  the write (write-through), while XLA still sees pure functional updates
+  (donation makes the common whole-buffer case zero-copy).
+"""
+from __future__ import annotations
+
+import struct
+import sys
+import weakref
+
+import numpy as np
+
+from .base import MXNetError, DTYPE_NP_TO_MX, DTYPE_MX_TO_NP, np_dtype
+from .context import Context, current_context
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["NDArray", "zeros", "ones", "full", "empty", "array", "save",
+           "load", "concatenate", "waitall", "onehot_encode", "clip", "dot",
+           "norm", "sqrt", "rsqrt", "square", "abs", "sign", "round", "ceil",
+           "floor", "exp", "log", "maximum", "minimum", "negative",
+           "choose_element_0index", "fill_element_0index", "sum", "max",
+           "min", "argmax_channel", "transpose", "imdecode"]
+
+# Live chunks, for waitall() — the reference's Engine::WaitForAll
+# (include/mxnet/engine.h:172).
+_LIVE_CHUNKS: "weakref.WeakSet[_Chunk]" = weakref.WeakSet()
+
+
+class _Chunk:
+    """Flat storage buffer; the unit of mutation and engine tracking."""
+
+    __slots__ = ("buf", "ctx", "__weakref__")
+
+    def __init__(self, buf, ctx: Context):
+        self.buf = buf  # 1-D jax.Array
+        self.ctx = ctx
+        _LIVE_CHUNKS.add(self)
+
+
+def _prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def _to_jax(value, dtype=None):
+    """Convert scalars/numpy/NDArray to a jax array."""
+    if isinstance(value, NDArray):
+        value = value._val
+    if dtype is not None:
+        return jnp.asarray(value, dtype=np.dtype(dtype))
+    return jnp.asarray(value)
+
+
+class NDArray:
+    """A possibly-view array with mutation semantics over XLA buffers."""
+
+    __slots__ = ("_chunk", "_shape", "_offset", "writable")
+
+    # make numpy defer to our reflected ops (np_array * ndarray etc.)
+    __array_priority__ = 100.0
+
+    def __init__(self, chunk: _Chunk, shape, offset=0, writable=True):
+        self._chunk = chunk
+        self._shape = tuple(int(s) for s in shape)
+        self._offset = int(offset)
+        self.writable = writable
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    @staticmethod
+    def _new_alloc(shape, ctx=None, dtype=np.float32):
+        ctx = ctx or current_context()
+        dt = np_dtype(dtype)
+        buf = jnp.zeros((_prod(shape),), dtype=dt)
+        buf = jax.device_put(buf, ctx.jax_device())
+        return NDArray(_Chunk(buf, ctx), shape)
+
+    @staticmethod
+    def _from_jax(val, ctx=None):
+        ctx = ctx or current_context()
+        val = jnp.ravel(val)
+        return NDArray(_Chunk(val, ctx), val.shape if val.ndim else (1,))
+
+    # ------------------------------------------------------------------
+    # storage access
+    @property
+    def _size(self):
+        return _prod(self._shape)
+
+    @property
+    def _is_whole(self):
+        return self._offset == 0 and self._size == self._chunk.buf.size
+
+    @property
+    def _val(self):
+        """Read this (view of the) chunk as a shaped jax array."""
+        buf = self._chunk.buf
+        if self._is_whole:
+            return buf.reshape(self._shape)
+        return jax.lax.dynamic_slice(buf, (self._offset,), (self._size,)).reshape(self._shape)
+
+    def _set(self, value):
+        """Write a shaped jax array into this view (write-through)."""
+        if not self.writable:
+            raise MXNetError("trying to write to a read-only NDArray")
+        value = jnp.asarray(value)
+        if value.shape != self._shape:
+            value = jnp.broadcast_to(value, self._shape)
+        value = value.astype(self.dtype)
+        if self._is_whole:
+            self._chunk.buf = value.reshape(-1)
+        else:
+            self._chunk.buf = jax.lax.dynamic_update_slice(
+                self._chunk.buf, value.reshape(-1), (self._offset,))
+        return self
+
+    # ------------------------------------------------------------------
+    # basic properties
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def size(self):
+        return self._size
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._chunk.buf.dtype)
+
+    @property
+    def context(self):
+        return self._chunk.ctx
+
+    ctx = context
+
+    def __repr__(self):
+        return "<%s %s @%s>" % (type(self).__name__,
+                                "x".join(str(s) for s in self._shape),
+                                self.context)
+
+    # ------------------------------------------------------------------
+    # synchronization (engine parity)
+    def wait_to_read(self):
+        """Block until pending writes complete (``NDArray::WaitToRead``)."""
+        jax.block_until_ready(self._chunk.buf)
+
+    def wait_to_write(self):
+        jax.block_until_ready(self._chunk.buf)
+
+    # ------------------------------------------------------------------
+    # host interop
+    def asnumpy(self):
+        """Copy to a numpy array, blocking (``MXNDArraySyncCopyToCPU``)."""
+        out = np.asarray(jax.device_get(self._val)).astype(self.dtype, copy=False)
+        if not out.flags.writeable:
+            out = out.copy()
+        return out
+
+    def asscalar(self):
+        if self._size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def astype(self, dtype):
+        res = empty(self._shape, ctx=self.context, dtype=dtype)
+        res._set(self._val.astype(np_dtype(dtype)))
+        return res
+
+    # ------------------------------------------------------------------
+    # views (zero-copy in the reference: ndarray.h:227-250)
+    def reshape(self, new_shape):
+        # MXNet has no 0-dim arrays: scalars are shape (1,) (ndarray.py ref).
+        new_shape = tuple(int(s) for s in new_shape) or (1,)
+        if _prod(new_shape) != self._size:
+            raise MXNetError("NDArray.reshape: size must not change")
+        return NDArray(self._chunk, new_shape, self._offset, self.writable)
+
+    def slice(self, start, stop):
+        start, stop = int(start), int(stop)
+        if not (0 <= start <= stop <= self._shape[0]):
+            raise MXNetError("slice out of range")
+        stride = self._size // self._shape[0] if self._shape[0] else 0
+        return NDArray(self._chunk, (stop - start,) + self._shape[1:],
+                       self._offset + start * stride, self.writable)
+
+    def __getitem__(self, in_slice):
+        if isinstance(in_slice, int):
+            return self.slice(in_slice, in_slice + 1).reshape(self._shape[1:] or (1,))
+        if isinstance(in_slice, slice):
+            if in_slice.step is not None and in_slice.step != 1:
+                raise MXNetError("NDArray only supports contiguous slicing on axis 0")
+            start = 0 if in_slice.start is None else in_slice.start
+            stop = self._shape[0] if in_slice.stop is None else in_slice.stop
+            return self.slice(start, stop)
+        raise MXNetError("NDArray only supports int/slice indexing on axis 0")
+
+    def __setitem__(self, in_slice, value):
+        if isinstance(in_slice, slice) and (in_slice.step is None or in_slice.step == 1):
+            target = self if (in_slice.start is None and in_slice.stop is None) \
+                else self.__getitem__(in_slice)
+        elif isinstance(in_slice, int):
+            target = self.__getitem__(in_slice)
+        else:
+            raise MXNetError("NDArray only supports contiguous slice assignment")
+        if isinstance(value, (int, float, np.number)):
+            target._set(jnp.full(target._shape, value, dtype=target.dtype))
+        else:
+            target._set(_to_jax(value, target.dtype))
+
+    # ------------------------------------------------------------------
+    # copies
+    def copy(self):
+        return self.copyto(self.context)
+
+    def copyto(self, other):
+        """Copy into another NDArray (mutating it) or to a new one on ctx."""
+        if isinstance(other, NDArray):
+            if other is self or (other._chunk is self._chunk
+                                 and other._offset == self._offset):
+                import warnings
+                warnings.warn("copy an array to itself, is it intended?")
+                return other
+            if other.shape != self.shape:
+                raise MXNetError("copyto shape mismatch %s vs %s"
+                                 % (self.shape, other.shape))
+            other._set(self._val.astype(other.dtype))
+            return other
+        if isinstance(other, Context):
+            res = empty(self._shape, ctx=other, dtype=self.dtype)
+            res._chunk.buf = jax.device_put(self._val.reshape(-1), other.jax_device())
+            return res
+        raise MXNetError("copyto does not support type " + str(type(other)))
+
+    def as_in_context(self, context):
+        if self.context == context:
+            return self
+        return self.copyto(context)
+
+    # ------------------------------------------------------------------
+    # arithmetic — all eager jnp ops; output dtype follows the inputs'
+    # common dtype like mshadow (not numpy's int→float64 promotion).
+    def _binary(self, other, fn, reverse=False):
+        a = self._val
+        if isinstance(other, NDArray):
+            b = other._val
+            rdtype = np.promote_types(self.dtype, other.dtype)
+        elif isinstance(other, (int, float, bool, np.number)):
+            b = other
+            rdtype = self.dtype
+        else:
+            b = jnp.asarray(other)
+            rdtype = np.promote_types(self.dtype, b.dtype)
+        out = fn(b, a) if reverse else fn(a, b)
+        return NDArray._from_jax(out.astype(rdtype).reshape(-1), self.context) \
+            ._reshaped(out.shape)
+
+    def _reshaped(self, shape):
+        self._shape = tuple(int(s) for s in shape) or (1,)
+        return self
+
+    def __add__(self, o):
+        return self._binary(o, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, jnp.subtract)
+
+    def __rsub__(self, o):
+        return self._binary(o, jnp.subtract, reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __div__(self, o):
+        return self._binary(o, jnp.divide)
+
+    def __rdiv__(self, o):
+        return self._binary(o, jnp.divide, reverse=True)
+
+    __truediv__ = __div__
+    __rtruediv__ = __rdiv__
+
+    def __pow__(self, o):
+        return self._binary(o, jnp.power)
+
+    def __neg__(self):
+        return NDArray._from_jax(-self._val.reshape(-1), self.context) \
+            ._reshaped(self._shape)
+
+    # in-place ops mutate the chunk (engine write dependency in the ref)
+    def _inplace(self, other, fn):
+        b = other._val if isinstance(other, NDArray) else other
+        return self._set(fn(self._val, b))
+
+    def __iadd__(self, o):
+        return self._inplace(o, jnp.add)
+
+    def __isub__(self, o):
+        return self._inplace(o, jnp.subtract)
+
+    def __imul__(self, o):
+        return self._inplace(o, jnp.multiply)
+
+    def __idiv__(self, o):
+        return self._inplace(o, jnp.divide)
+
+    __itruediv__ = __idiv__
+
+    # pickle support (reference: ndarray.py __getstate__/__setstate__)
+    def __reduce__(self):
+        return (_ndarray_from_numpy, (self.asnumpy(), self.writable))
+
+    @property
+    def T(self):
+        return transpose(self)
+
+
+def _ndarray_from_numpy(data, writable=True):
+    arr = array(data)
+    arr.writable = writable
+    return arr
+
+
+# ----------------------------------------------------------------------
+# creation functions (reference: python/mxnet/ndarray.py empty/zeros/ones/
+# array + registered C functions ndarray.cc:664-810)
+
+def empty(shape, ctx=None, dtype=np.float32):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray._new_alloc(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype=np.float32):
+    return empty(shape, ctx, dtype)
+
+
+def _from_device_put(values, shape, ctx):
+    ctx = ctx or current_context()
+    buf = jax.device_put(values, ctx.jax_device())
+    return NDArray(_Chunk(buf, ctx), shape)
+
+
+def ones(shape, ctx=None, dtype=np.float32):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _from_device_put(jnp.ones((_prod(shape),), dtype=np_dtype(dtype)),
+                            shape, ctx)
+
+
+def full(shape, val, ctx=None, dtype=np.float32):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _from_device_put(jnp.full((_prod(shape),), val, dtype=np_dtype(dtype)),
+                            shape, ctx)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (reference ndarray.py:370)."""
+    if isinstance(source_array, NDArray):
+        src = source_array.asnumpy()
+    else:
+        src = np.asarray(source_array)
+    if dtype is None:
+        dtype = src.dtype if src.dtype in DTYPE_NP_TO_MX else np.float32
+    src = np.ascontiguousarray(src, dtype=np_dtype(dtype))
+    if src.ndim == 0:
+        src = src.reshape(1)
+    return _from_device_put(src.reshape(-1), src.shape, ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    if not arrays:
+        raise MXNetError("need at least one array")
+    if len(arrays) == 1 and not always_copy and axis == 0:
+        return arrays[0]
+    val = jnp.concatenate([a._val for a in arrays], axis=axis)
+    return NDArray._from_jax(val.reshape(-1), arrays[0].context)._reshaped(val.shape)
+
+
+def waitall():
+    """Block until all queued work completes (``MXNDArrayWaitAll``)."""
+    for chunk in list(_LIVE_CHUNKS):
+        jax.block_until_ready(chunk.buf)
+
+
+# ----------------------------------------------------------------------
+# registered functions — out= supported like the C registry's mutate_vars
+
+def _maybe_out(val, out, ctx):
+    if out is not None:
+        out._set(val.astype(out.dtype))
+        return out
+    res = NDArray._from_jax(jnp.ravel(val), ctx)
+    return res._reshaped(val.shape)
+
+
+def _unary_factory(fn, name):
+    def func(arr, out=None):
+        return _maybe_out(fn(arr._val).astype(arr.dtype), out, arr.context)
+    func.__name__ = name
+    func.__doc__ = "Elementwise %s (reference: unary_function-inl.h:146-189)" % name
+    return func
+
+
+sqrt = _unary_factory(jnp.sqrt, "sqrt")
+rsqrt = _unary_factory(lambda x: 1.0 / jnp.sqrt(x), "rsqrt")
+square = _unary_factory(jnp.square, "square")
+exp = _unary_factory(jnp.exp, "exp")
+log = _unary_factory(jnp.log, "log")
+sign = _unary_factory(jnp.sign, "sign")
+ceil = _unary_factory(jnp.ceil, "ceil")
+floor = _unary_factory(jnp.floor, "floor")
+round = _unary_factory(jnp.round, "round")
+abs = _unary_factory(jnp.abs, "abs")
+
+
+def negative(arr, out=None):
+    return _maybe_out(-arr._val, out, arr.context)
+
+
+def maximum(lhs, rhs, out=None):
+    a = lhs._val if isinstance(lhs, NDArray) else lhs
+    b = rhs._val if isinstance(rhs, NDArray) else rhs
+    ctx = lhs.context if isinstance(lhs, NDArray) else rhs.context
+    return _maybe_out(jnp.maximum(a, b), out, ctx)
+
+
+def minimum(lhs, rhs, out=None):
+    a = lhs._val if isinstance(lhs, NDArray) else lhs
+    b = rhs._val if isinstance(rhs, NDArray) else rhs
+    ctx = lhs.context if isinstance(lhs, NDArray) else rhs.context
+    return _maybe_out(jnp.minimum(a, b), out, ctx)
+
+
+def clip(arr, a_min, a_max, out=None):
+    """Clip values (reference: ndarray.cc:793 ``clip``)."""
+    return _maybe_out(jnp.clip(arr._val, a_min, a_max), out, arr.context)
+
+
+def dot(lhs, rhs, out=None):
+    """Matrix/vector product (reference: ndarray.cc:741 ``dot``)."""
+    return _maybe_out(jnp.dot(lhs._val, rhs._val), out, lhs.context)
+
+
+def norm(arr, out=None):
+    """L2 norm, returned as a 1-element NDArray (reference mx.nd.norm)."""
+    val = jnp.linalg.norm(arr._val.astype(np.float32).reshape(-1))
+    return _maybe_out(val.reshape(1), out, arr.context)
+
+
+def sum(arr, out=None):
+    return _maybe_out(jnp.sum(arr._val).reshape(1), out, arr.context)
+
+
+def max(arr, out=None):
+    return _maybe_out(jnp.max(arr._val).reshape(1), out, arr.context)
+
+
+def min(arr, out=None):
+    return _maybe_out(jnp.min(arr._val).reshape(1), out, arr.context)
+
+
+def transpose(arr, axes=None, out=None):
+    return _maybe_out(jnp.transpose(arr._val, axes), out, arr.context)
+
+
+def argmax_channel(arr, out=None):
+    val = jnp.argmax(arr._val, axis=1).astype(arr.dtype)
+    return _maybe_out(val, out, arr.context)
+
+
+def onehot_encode(indices, out):
+    """Fill ``out`` with one-hot rows (reference: ndarray.cc:764)."""
+    depth = out.shape[1]
+    idx = indices._val.astype(np.int32).reshape(-1)
+    val = jax.nn.one_hot(idx, depth, dtype=out.dtype)
+    out._set(val)
+    return out
+
+
+def choose_element_0index(lhs, rhs, out=None):
+    """out[i] = lhs[i, rhs[i]] (reference: ndarray.cc:771)."""
+    idx = rhs._val.astype(np.int32).reshape(-1)
+    val = jnp.take_along_axis(lhs._val, idx[:, None], axis=1)[:, 0]
+    return _maybe_out(val, out, lhs.context)
+
+
+def fill_element_0index(lhs, mhs, rhs, out=None):
+    """out = lhs; out[i, rhs[i]] = mhs[i] (reference: ndarray.cc:778)."""
+    idx = rhs._val.astype(np.int32).reshape(-1)
+    rows = jnp.arange(idx.shape[0])
+    val = lhs._val.at[rows, idx].set(mhs._val.reshape(-1).astype(lhs.dtype))
+    return _maybe_out(val, out, lhs.context)
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3, mean=None):
+    """Decode an image bytestring (reference: ndarray.cc:799 ``_imdecode``).
+
+    Uses Pillow/OpenCV if available; raises otherwise.
+    """
+    import io as _io
+    try:
+        from PIL import Image
+        img = np.asarray(Image.open(_io.BytesIO(str_img)).convert("RGB"))
+    except ImportError:
+        try:
+            import cv2
+            img = cv2.imdecode(np.frombuffer(str_img, np.uint8), cv2.IMREAD_COLOR)
+            img = img[:, :, ::-1]
+        except ImportError as exc:
+            raise MXNetError("imdecode needs PIL or cv2") from exc
+    x0, y0, x1, y1 = clip_rect
+    if x1 > 0 or y1 > 0:
+        img = img[y0:y1, x0:x1]
+    img = np.transpose(img, (2, 0, 1)).astype(np.float32)
+    if mean is not None:
+        img = img - mean.asnumpy()
+    img = img[None]
+    if out is not None:
+        out._set(jnp.asarray(img))
+        return out
+    return array(img)
+
+
+# ----------------------------------------------------------------------
+# serialization — bit-compatible with the reference checkpoint format
+# (ndarray.cc:518-640: TShape{uint32 ndim, uint32[ndim]}, Context{int32
+# dev_type, int32 dev_id}, int32 type_flag, raw data; list files prepend
+# uint64 magic 0x112 + uint64 reserved, then dmlc-serialized vectors).
+
+_LIST_MAGIC = 0x112
+
+
+def _save_one(fo, arr: NDArray):
+    shape = arr.shape or (1,)  # no 0-dim arrays on disk (matches reference)
+    fo.write(struct.pack("<I", len(shape)))
+    fo.write(struct.pack("<%dI" % len(shape), *shape))
+    fo.write(struct.pack("<ii", 1, 0))  # saved as CPU context like the ref
+    type_flag = DTYPE_NP_TO_MX[arr.dtype]
+    fo.write(struct.pack("<i", type_flag))
+    data = np.ascontiguousarray(arr.asnumpy())
+    if sys.byteorder != "little":  # pragma: no cover
+        data = data.byteswap()
+    fo.write(data.tobytes())
+
+
+def _load_one(fi) -> NDArray:
+    (ndim,) = struct.unpack("<I", fi.read(4))
+    if ndim == 0:
+        return empty((1,))
+    shape = struct.unpack("<%dI" % ndim, fi.read(4 * ndim))
+    struct.unpack("<ii", fi.read(8))  # context, ignored: we re-place
+    (type_flag,) = struct.unpack("<i", fi.read(4))
+    dtype = DTYPE_MX_TO_NP[type_flag]
+    count = _prod(shape)
+    data = np.frombuffer(fi.read(count * dtype.itemsize), dtype=dtype).reshape(shape)
+    return array(data, dtype=dtype)
+
+
+def save(fname, data):
+    """Save a list or str->NDArray dict (reference ndarray.py:565)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    names = []
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        arrays = list(data)
+    if any(not isinstance(a, NDArray) for a in arrays):
+        raise MXNetError("save only accepts NDArrays")
+    with open(fname, "wb") as fo:
+        fo.write(struct.pack("<QQ", _LIST_MAGIC, 0))
+        fo.write(struct.pack("<Q", len(arrays)))
+        for arr in arrays:
+            _save_one(fo, arr)
+        fo.write(struct.pack("<Q", len(names)))
+        for name in names:
+            enc = name.encode("utf-8")
+            fo.write(struct.pack("<Q", len(enc)))
+            fo.write(enc)
+
+
+def load(fname):
+    """Load a list or dict saved by :func:`save` (or the reference)."""
+    with open(fname, "rb") as fi:
+        magic, _ = struct.unpack("<QQ", fi.read(16))
+        if magic != _LIST_MAGIC:
+            raise MXNetError("Invalid NDArray file format")
+        (count,) = struct.unpack("<Q", fi.read(8))
+        arrays = [_load_one(fi) for _ in range(count)]
+        (nkeys,) = struct.unpack("<Q", fi.read(8))
+        if nkeys == 0:
+            return arrays
+        names = []
+        for _ in range(nkeys):
+            (ln,) = struct.unpack("<Q", fi.read(8))
+            names.append(fi.read(ln).decode("utf-8"))
+        return dict(zip(names, arrays))

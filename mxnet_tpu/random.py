@@ -1,0 +1,57 @@
+"""Random sampling and global seeding.
+
+Parity: ``/root/reference/python/mxnet/random.py`` (uniform/normal/seed) over
+``src/ndarray/ndarray.cc:786-792`` (``_random_uniform``/``_random_gaussian``)
+and ``mx.random.seed`` → ``RandomSeed`` (``ndarray.cc:648``).
+
+Implementation: a process-global JAX PRNG key threaded through functional
+splits — gives the reference's "seed once, reproduce the stream" semantics
+without mutable device RNG state. The key is created lazily on first use so
+importing the library never initializes a JAX backend.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .context import current_context
+from .ndarray import _maybe_out
+
+__all__ = ["seed", "uniform", "normal"]
+
+_KEY = None
+
+
+def _next_key():
+    global _KEY
+    if _KEY is None:
+        _KEY = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+    _KEY, sub = jax.random.split(_KEY)
+    return sub
+
+
+def seed(seed_state):
+    """Seed the global RNG (reference: random.py:39 ``mx.random.seed``)."""
+    global _KEY
+    if not isinstance(seed_state, (int, np.integer)):
+        raise ValueError("seed_state must be int")
+    _KEY = jax.random.PRNGKey(int(seed_state))
+
+
+def uniform(low, high, shape=None, ctx=None, out=None):
+    """Uniform samples in [low, high) (reference: random.py:12)."""
+    if out is not None:
+        shape, ctx = out.shape, out.context
+    val = jax.random.uniform(_next_key(), shape or (1,), dtype=jnp.float32,
+                             minval=low, maxval=high)
+    return _maybe_out(val, out, ctx or current_context())
+
+
+def normal(mean, stdvar, shape=None, ctx=None, out=None):
+    """Gaussian samples (reference: random.py:26)."""
+    if out is not None:
+        shape, ctx = out.shape, out.context
+    val = mean + stdvar * jax.random.normal(_next_key(), shape or (1,),
+                                            dtype=jnp.float32)
+    return _maybe_out(val, out, ctx or current_context())

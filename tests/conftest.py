@@ -1,0 +1,25 @@
+"""Test harness: run on a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; per the reference's test strategy
+(SURVEY.md §4: multi-process localhost testing for dist kvstore), all
+sharding/collective paths are tested on
+``--xla_force_host_platform_device_count=8``.
+
+The image's sitecustomize imports jax and registers the axon TPU PJRT plugin
+at interpreter startup, so env vars alone are too late — we must flip
+``jax_platforms`` via config before any backend initializes. XLA_FLAGS is
+still read lazily at first backend init, so setting it here works. Set
+``MXNET_TPU_TEST_ON_TPU=1`` to opt back into the real chip.
+"""
+import os
+
+if os.environ.get("MXNET_TPU_TEST_ON_TPU") != "1":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,236 @@
+"""NDArray semantics tests — port of
+/root/reference/tests/python/unittest/test_ndarray.py (behavioral parity)."""
+import os
+import pickle as pkl
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def reldiff(a, b):
+    diff = np.sum(np.abs(np.asarray(a, dtype=np.float64) - np.asarray(b, dtype=np.float64)))
+    norm = np.sum(np.abs(np.asarray(a, dtype=np.float64)))
+    return diff / (norm + 1e-8)
+
+
+def same(a, b):
+    return np.sum(a != b) == 0
+
+
+def check_with_uniform(uf, arg_shapes, dim=None, npuf=None, rmin=-10,
+                       type_list=(np.float32,)):
+    if isinstance(arg_shapes, int):
+        assert dim
+        shape = tuple(np.random.randint(1, int(1000 ** (1.0 / dim)), size=dim))
+        arg_shapes = [shape] * arg_shapes
+    for dtype in type_list:
+        ndarray_arg = []
+        numpy_arg = []
+        for s in arg_shapes:
+            npy = np.random.uniform(rmin, 10, s).astype(dtype)
+            narr = mx.nd.array(npy, dtype=dtype)
+            ndarray_arg.append(narr)
+            numpy_arg.append(npy)
+        out1 = uf(*ndarray_arg)
+        if npuf is None:
+            out2 = uf(*numpy_arg).astype(dtype)
+        else:
+            out2 = npuf(*numpy_arg).astype(dtype)
+        assert out1.shape == out2.shape
+        if isinstance(out1, mx.nd.NDArray):
+            out1 = out1.asnumpy()
+        if dtype == np.float16:
+            assert reldiff(out1, out2) < 1e-3
+        else:
+            assert reldiff(out1, out2) < 1e-6
+
+
+def random_ndarray(dim):
+    shape = tuple(np.random.randint(1, int(1000 ** (1.0 / dim)), size=dim))
+    return mx.nd.array(np.random.uniform(-10, 10, shape))
+
+
+def test_ndarray_elementwise():
+    np.random.seed(0)
+    nrepeat = 2
+    maxdim = 4
+    all_type = [np.float32, np.float64, np.float16, np.uint8, np.int32]
+    real_type = [np.float32, np.float64, np.float16]
+    for _ in range(nrepeat):
+        for dim in range(1, maxdim):
+            check_with_uniform(lambda x, y: x + y, 2, dim, type_list=all_type)
+            check_with_uniform(lambda x, y: x - y, 2, dim, type_list=all_type)
+            check_with_uniform(lambda x, y: x * y, 2, dim, type_list=all_type)
+            check_with_uniform(lambda x, y: x / y, 2, dim, type_list=real_type)
+            check_with_uniform(mx.nd.sqrt, 2, dim, np.sqrt, rmin=0)
+            check_with_uniform(mx.nd.square, 2, dim, np.square, rmin=0)
+            check_with_uniform(lambda x: mx.nd.norm(x).asscalar(), 1, dim,
+                               np.linalg.norm)
+
+
+def test_ndarray_negate():
+    npy = np.random.uniform(-10, 10, (2, 3, 4))
+    arr = mx.nd.array(npy)
+    assert reldiff(npy, arr.asnumpy()) < 1e-6
+    assert reldiff(-npy, (-arr).asnumpy()) < 1e-6
+    # negation must not be in-place
+    assert reldiff(npy, arr.asnumpy()) < 1e-6
+
+
+def test_ndarray_choose():
+    shape = (100, 20)
+    npy = np.arange(np.prod(shape)).reshape(shape)
+    arr = mx.nd.array(npy)
+    for _ in range(3):
+        indices = np.random.randint(shape[1], size=shape[0])
+        assert same(npy[np.arange(shape[0]), indices],
+                    mx.nd.choose_element_0index(arr, mx.nd.array(indices)).asnumpy())
+
+
+def test_ndarray_fill():
+    shape = (100, 20)
+    npy = np.arange(np.prod(shape)).reshape(shape)
+    arr = mx.nd.array(npy)
+    new_npy = npy.copy()
+    for _ in range(3):
+        indices = np.random.randint(shape[1], size=shape[0])
+        val = np.random.randint(shape[1], size=shape[0])
+        new_npy[:] = npy
+        new_npy[np.arange(shape[0]), indices] = val
+        out = mx.nd.fill_element_0index(arr, mx.nd.array(val), mx.nd.array(indices))
+        assert same(new_npy, out.asnumpy())
+
+
+def test_ndarray_onehot():
+    shape = (100, 20)
+    npy = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    arr = mx.nd.array(npy)
+    for _ in range(3):
+        indices = np.random.randint(shape[1], size=shape[0])
+        npy[:] = 0.0
+        npy[np.arange(shape[0]), indices] = 1.0
+        mx.nd.onehot_encode(mx.nd.array(indices), out=arr)
+        assert same(npy, arr.asnumpy())
+
+
+def test_ndarray_copy():
+    c = mx.nd.array(np.random.uniform(-10, 10, (10, 10)))
+    d = c.copyto(mx.Context("cpu", 0))
+    assert np.sum(np.abs(c.asnumpy() != d.asnumpy())) == 0.0
+
+
+def test_ndarray_scalar():
+    c = mx.nd.empty((10, 10))
+    d = mx.nd.empty((10, 10))
+    c[:] = 0.5
+    d[:] = 1.0
+    d -= c * 2 / 3 * 6.0
+    c += 0.5
+    assert np.sum(c.asnumpy()) - 100 < 1e-5
+    assert np.sum(d.asnumpy()) + 100 < 1e-5
+    c[:] = 2
+    assert np.sum(c.asnumpy()) - 200 < 1e-5
+    d = -c + 2
+    assert np.sum(d.asnumpy()) < 1e-5
+
+
+def test_ndarray_pickle():
+    np.random.seed(0)
+    for _ in range(2):
+        for dim in range(1, 5):
+            a = random_ndarray(dim)
+            b = mx.nd.empty(a.shape)
+            a[:] = np.random.uniform(-10, 10, a.shape)
+            b[:] = np.random.uniform(-10, 10, a.shape)
+            a = a + b
+            data = pkl.dumps(a)
+            a2 = pkl.loads(data)
+            assert np.sum(a.asnumpy() != a2.asnumpy()) == 0
+
+
+def test_ndarray_saveload(tmp_path):
+    np.random.seed(0)
+    fname = str(tmp_path / "tmp_list.bin")
+    for _ in range(2):
+        data = [random_ndarray(np.random.randint(1, 5)) for _ in range(10)]
+        mx.nd.save(fname, data)
+        data2 = mx.nd.load(fname)
+        assert len(data) == len(data2)
+        for x, y in zip(data, data2):
+            assert np.sum(x.asnumpy() != y.asnumpy()) == 0
+        dmap = {"ndarray xx %s" % i: x for i, x in enumerate(data)}
+        mx.nd.save(fname, dmap)
+        dmap2 = mx.nd.load(fname)
+        assert len(dmap2) == len(dmap)
+        for k, x in dmap.items():
+            assert np.sum(x.asnumpy() != dmap2[k].asnumpy()) == 0
+
+
+def test_ndarray_slice():
+    shape = (10,)
+    A = mx.nd.array(np.random.uniform(-10, 10, shape))
+    A2 = A.asnumpy()
+    assert same(A[3:8].asnumpy(), A2[3:8])
+    A2[3:8] *= 10
+    A[3:8] = A2[3:8]
+    assert same(A[3:8].asnumpy(), A2[3:8])
+    # write-through: the parent must see the slice write
+    assert same(A.asnumpy(), A2)
+
+
+def test_ndarray_slice_view_mutation():
+    """Slices are views sharing storage (reference ndarray.h:227-239)."""
+    A = mx.nd.zeros((6, 4))
+    v = A[2:4]
+    v[:] = 7.0
+    out = A.asnumpy()
+    assert same(out[2:4], np.full((2, 4), 7.0))
+    assert same(out[:2], np.zeros((2, 4)))
+    # reshape shares storage too
+    r = A.reshape((4, 6))
+    r[:] = 1.0
+    assert same(A.asnumpy(), np.ones((6, 4)))
+
+
+def test_clip():
+    shape = (10,)
+    A = mx.random.uniform(-10, 10, shape)
+    B = mx.nd.clip(A, -2, 2)
+    B1 = B.asnumpy()
+    assert np.all(B1 >= -2) and np.all(B1 <= 2)
+
+
+def test_dot():
+    a = np.random.uniform(-3, 3, (3, 4))
+    b = np.random.uniform(-3, 3, (4, 5))
+    c = np.dot(a, b)
+    A = mx.nd.array(a)
+    B = mx.nd.array(b)
+    C = mx.nd.dot(A, B)
+    assert reldiff(c, C.asnumpy()) < 1e-5
+
+
+def test_reference_format_compat():
+    """The save format must match the reference byte layout exactly
+    (ndarray.cc:518-640): magic 0x112, dmlc vectors, TShape uint32s."""
+    import struct
+    fname = "tmp_fmt.bin"
+    arr = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    try:
+        mx.nd.save(fname, {"w": arr})
+        with open(fname, "rb") as f:
+            raw = f.read()
+        magic, reserved, count = struct.unpack("<QQQ", raw[:24])
+        assert magic == 0x112 and reserved == 0 and count == 1
+        ndim, d0, d1 = struct.unpack("<III", raw[24:36])
+        assert (ndim, d0, d1) == (2, 2, 3)
+        devtype, devid, typeflag = struct.unpack("<iii", raw[36:48])
+        assert (devtype, devid, typeflag) == (1, 0, 0)
+        data = np.frombuffer(raw[48:48 + 24], dtype=np.float32)
+        assert same(data, np.arange(6, dtype=np.float32))
+        nkeys, klen = struct.unpack("<QQ", raw[72:88])
+        assert nkeys == 1 and klen == 1 and raw[88:89] == b"w"
+    finally:
+        os.path.exists(fname) and os.remove(fname)
