@@ -25,5 +25,18 @@ from . import ndarray  # noqa: E402
 from . import ndarray as nd  # noqa: E402
 from .ndarray import NDArray  # noqa: E402
 from . import random  # noqa: E402
+from . import symbol  # noqa: E402
+from . import symbol as sym  # noqa: E402
+from .symbol import Symbol, Group  # noqa: E402
+from . import executor  # noqa: E402
+from .executor import Executor  # noqa: E402
+from . import operator  # noqa: E402
+from .attribute import AttrScope  # noqa: E402
+from .name import NameManager, Prefix  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import metric  # noqa: E402
+from . import initializer  # noqa: E402
+from .initializer import Uniform, Normal, Orthogonal, Xavier, MSRAPrelu  # noqa: E402
+from . import lr_scheduler  # noqa: E402
 
 __version__ = "0.1.0"

@@ -1,0 +1,349 @@
+"""Executor: a bound, compiled symbol graph.
+
+Parity: ``/root/reference/python/mxnet/executor.py`` (user API) and
+``src/symbol/graph_executor.cc`` (semantics: bind → run; grad_req
+write/add/null; aux state mutation; monitor callback).
+
+TPU-first design
+----------------
+The reference's five-phase bind pipeline (InitGraph/AssignContext/
+InitDataEntryInfo/InitDataEntryMemory/InitOpNodes, graph_executor.h:40-69)
+exists to schedule per-op kernels and plan memory. Here the *whole graph* is
+traced into one XLA computation:
+
+* ``forward(is_train=True)`` runs one jitted program that computes outputs,
+  the updated aux states, AND the vjp residuals (the activations autodiff
+  needs). The residual pytree of ``jax.vjp`` is flattened inside the traced
+  function; its treedef is captured host-side at trace time. This replaces
+  the reference's "keep forward buffers alive between Forward and Backward"
+  memory plan — residuals are exactly those buffers, chosen by XLA.
+* ``backward(head_grads)`` runs a second jitted program: unflatten residuals,
+  apply the vjp. Together the pair is the reference's forward/backward node
+  split (graph_executor.cc:856-894) with XLA doing memory planning, inplace
+  (buffer reuse), and scheduling.
+* Gradient aggregation for multi-consumer nodes, grad mirroring
+  (MXNET_BACKWARD_DO_MIRROR) and temp-space coloring are all subsumed by
+  XLA autodiff + rematerialization + buffer assignment.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["Executor"]
+
+
+def _normalize_dict_or_list(vals, names, what, allow_missing=False):
+    if vals is None:
+        return [None] * len(names)
+    if isinstance(vals, dict):
+        out = []
+        for n in names:
+            if n in vals:
+                out.append(vals[n])
+            elif allow_missing:
+                out.append(None)
+            else:
+                raise MXNetError("%s: missing entry for %s" % (what, n))
+        return out
+    vals = list(vals)
+    if len(vals) != len(names):
+        raise MXNetError("%s: expected %d entries, got %d"
+                         % (what, len(names), len(vals)))
+    return vals
+
+
+class Executor:
+    """A compiled, bound computation graph."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None, shared_exec=None,
+                 _outputs=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self._group2ctx = group2ctx or {}
+        self._monitor_callback = None
+
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        self._arg_names = arg_names
+        self._aux_names = aux_names
+
+        self.arg_arrays = _normalize_dict_or_list(args, arg_names, "args")
+        if any(a is None for a in self.arg_arrays):
+            raise MXNetError("bind: every argument needs an array")
+        self.grad_arrays = _normalize_dict_or_list(
+            args_grad, arg_names, "args_grad", allow_missing=True)
+        self.aux_arrays = _normalize_dict_or_list(
+            aux_states, aux_names, "aux_states")
+        if any(a is None for a in self.aux_arrays):
+            # auto-allocate missing aux (simple_bind path provides them;
+            # bind with None aux allocates zeros from inferred shapes)
+            shapes = {n: a.shape for n, a in zip(arg_names, self.arg_arrays)}
+            _, _, aux_shapes = symbol.infer_shape(**shapes)
+            if aux_shapes is None:
+                raise MXNetError("bind: cannot infer aux shapes")
+            self.aux_arrays = [a if a is not None else nd.zeros(s, ctx)
+                               for a, s in zip(self.aux_arrays, aux_shapes)]
+
+        # grad_req -> per-arg list
+        if isinstance(grad_req, str):
+            reqs = [grad_req] * len(arg_names)
+        elif isinstance(grad_req, dict):
+            reqs = [grad_req.get(n, "null") for n in arg_names]
+        else:
+            reqs = list(grad_req)
+        self._grad_req = ["null" if g is None else r
+                         for r, g in zip(reqs, self.grad_arrays)]
+
+        # output arrays (persistent, refreshed by forward) — shapes from
+        # inference over the bound arg shapes
+        shapes = {n: a.shape for n, a in zip(arg_names, self.arg_arrays)}
+        arg_shapes, out_shapes, _ = symbol.infer_shape(**shapes)
+        if out_shapes is None:
+            raise MXNetError("bind: cannot infer output shapes from %s"
+                             % (shapes,))
+        for name, a, s in zip(arg_names, self.arg_arrays, arg_shapes):
+            if tuple(a.shape) != tuple(s):
+                raise MXNetError("bind: argument %s has shape %s, expected %s"
+                                 % (name, a.shape, s))
+        if _outputs is not None:
+            self._out_arrays = _outputs
+        else:
+            arg_types = [a.dtype for a in self.arg_arrays]
+            _, out_types, _ = symbol.infer_type(*arg_types)
+            if out_types is None:
+                out_types = [self.arg_arrays[0].dtype] * len(out_shapes)
+            self._out_arrays = [nd.empty(s, ctx, dtype=t)
+                                for s, t in zip(out_shapes, out_types)]
+        self._out_dtypes = [a.dtype for a in self._out_arrays]
+
+        # compiled functions (built lazily; one per is_train mode)
+        self._jit_infer = None
+        self._jit_train = None
+        self._jit_bwd = None
+        self._vjp_treedef = None
+        self._residuals = None
+        self._topo = symbol._topo()
+        self._base_key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        self._step = 0
+
+    # ------------------------------------------------------------------
+    # graph evaluation (traced under jit)
+    def _eval_graph(self, arg_vals, aux_vals, is_train, rng):
+        env = {}
+        # variables map positionally (list_arguments order = topo order of
+        # var nodes); distinct nodes may share a name (reference allows it)
+        var_iter = iter(arg_vals)
+        aux_cursor = 0
+        new_aux = list(aux_vals)
+        for i, n in enumerate(self._topo):
+            if n.is_var:
+                env[(id(n), 0)] = next(var_iter)
+                continue
+            ins = [env[(id(inp), idx)] for inp, idx in n.inputs]
+            n_aux = len(n.spec.aux_states(n.params))
+            aux_in = list(aux_vals[aux_cursor:aux_cursor + n_aux])
+            node_rng = jax.random.fold_in(rng, i)
+            outs, aux_out = n.spec.forward(n.params, ins, aux_in,
+                                           is_train, node_rng)
+            for j, o in enumerate(outs):
+                env[(id(n), j)] = o
+            if n_aux:
+                new_aux[aux_cursor:aux_cursor + n_aux] = list(aux_out)
+            aux_cursor += n_aux
+        heads = [env[(id(h), i)] for h, i in self._symbol._heads]
+        return heads, new_aux, env
+
+    # ------------------------------------------------------------------
+    def _build_infer(self):
+        def run(arg_vals, aux_vals, rng):
+            outs, new_aux, _ = self._eval_graph(arg_vals, aux_vals, False, rng)
+            return tuple(outs), tuple(new_aux)
+        return jax.jit(run)
+
+    def _build_train(self):
+        def run(arg_vals, aux_vals, rng):
+            def f(av):
+                outs, new_aux, _ = self._eval_graph(list(av), aux_vals,
+                                                    True, rng)
+                return tuple(outs), tuple(new_aux)
+            outs, vjp_fn, new_aux = jax.vjp(f, tuple(arg_vals), has_aux=True)
+            leaves, treedef = jax.tree_util.tree_flatten(vjp_fn)
+            self._vjp_treedef = treedef  # host capture during trace
+            return outs, new_aux, tuple(leaves)
+        return jax.jit(run)
+
+    def _build_bwd(self):
+        treedef = self._vjp_treedef
+
+        def run(leaves, head_grads):
+            vjp_fn = jax.tree_util.tree_unflatten(treedef, list(leaves))
+            (arg_grads,) = vjp_fn(tuple(head_grads))
+            return arg_grads
+        return jax.jit(run)
+
+    # ------------------------------------------------------------------
+    # public API (reference executor.py)
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self._arg_names:
+                raise MXNetError("forward: unknown argument %s" % k)
+            dst = self.arg_arrays[self._arg_names.index(k)]
+            if isinstance(v, NDArray):
+                v.copyto(dst)
+            else:
+                dst[:] = v
+        arg_vals = tuple(a._val for a in self.arg_arrays)
+        aux_vals = tuple(a._val for a in self.aux_arrays)
+        self._step += 1
+        rng = jax.random.fold_in(self._base_key, self._step)
+        self._last_inputs = (arg_vals, aux_vals, rng)
+        if self._monitor_callback is not None:
+            self._run_monitor(arg_vals, aux_vals, is_train, rng)
+        if is_train:
+            if self._jit_train is None:
+                self._jit_train = self._build_train()
+            outs, new_aux, leaves = self._jit_train(arg_vals, aux_vals, rng)
+            self._residuals = leaves
+        else:
+            if self._jit_infer is None:
+                self._jit_infer = self._build_infer()
+            outs, new_aux = self._jit_infer(arg_vals, aux_vals, rng)
+            self._residuals = None
+        self._out_dtypes = [v.dtype for v in outs]
+        for dst, val in zip(self._out_arrays, outs):
+            dst._set(val)
+        for dst, val in zip(self.aux_arrays, new_aux):
+            dst._set(val)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if self._residuals is None:
+            # forward() ran in inference mode (or not at all). The reference
+            # permits backward after any forward; recompute the train-mode
+            # forward for its residuals (aux updates are discarded so the
+            # visible state stays what forward() produced).
+            if not hasattr(self, "_last_inputs"):
+                raise MXNetError("backward: call forward first")
+            if self._jit_train is None:
+                self._jit_train = self._build_train()
+            arg_vals, aux_vals, rng = self._last_inputs
+            _, _, self._residuals = self._jit_train(arg_vals, aux_vals, rng)
+        if out_grads is None:
+            heads = tuple(jnp.ones(o.shape, dt)
+                          for o, dt in zip(self._out_arrays, self._out_dtypes))
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            heads = tuple(
+                (g._val if isinstance(g, NDArray) else jnp.asarray(g))
+                .astype(dt)
+                for g, dt in zip(out_grads, self._out_dtypes))
+        if self._jit_bwd is None:
+            self._jit_bwd = self._build_bwd()
+        arg_grads = self._jit_bwd(self._residuals, heads)
+        for g_arr, req, g in zip(self.grad_arrays, self._grad_req, arg_grads):
+            if req == "null" or g_arr is None:
+                continue
+            if req == "add":
+                g_arr._set(g_arr._val + g.astype(g_arr.dtype))
+            else:  # write
+                g_arr._set(g.astype(g_arr.dtype))
+
+    @property
+    def outputs(self):
+        return self._out_arrays
+
+    @property
+    def arg_dict(self):
+        return dict(zip(self._arg_names, self.arg_arrays))
+
+    @property
+    def grad_dict(self):
+        return dict(zip(self._arg_names, self.grad_arrays))
+
+    @property
+    def aux_dict(self):
+        return dict(zip(self._aux_names, self.aux_arrays))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """Copy parameter values in (reference executor.py:copy_params_from)."""
+        for name, arr in arg_params.items():
+            if name in self._arg_names:
+                arr.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("unknown arg %s" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self._aux_names:
+                    arr.copyto(self.aux_dict[name])
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux %s" % name)
+
+    # ------------------------------------------------------------------
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor with new input shapes, sharing memory with
+        this one where possible (reference: shape-bucketed executors share
+        one memory pool via shared_exec / GraphStoragePool;
+        graph_executor.h:48-55). A batch-dim shrink yields views onto this
+        executor's buffers, so writes through the new executor are visible
+        here — the contract test_executor.test_reshape checks."""
+        new_shapes = {n: a.shape for n, a in zip(self._arg_names,
+                                                 self.arg_arrays)}
+        new_shapes.update(kwargs)
+        arg_shapes, out_shapes, _ = self._symbol.infer_shape(**new_shapes)
+        if arg_shapes is None:
+            raise MXNetError("reshape: cannot infer shapes")
+
+        def make_view(base, shape):
+            if base is None:
+                return None
+            if tuple(base.shape) == tuple(shape):
+                return base
+            if (base.shape[1:] == tuple(shape[1:])
+                    and shape[0] <= base.shape[0]):
+                return base.slice(0, shape[0])
+            if not allow_up_sizing and np.prod(shape) > base.size:
+                raise MXNetError("reshape: %s -> %s grows buffer; pass "
+                                 "allow_up_sizing=True" % (base.shape, shape))
+            return nd.zeros(shape, self._ctx, dtype=base.dtype)
+
+        new_args = [make_view(a, s) for a, s in zip(self.arg_arrays,
+                                                    arg_shapes)]
+        new_grads = [make_view(g, s) for g, s in zip(self.grad_arrays,
+                                                     arg_shapes)]
+        new_outs = [make_view(o, s) for o, s in zip(self._out_arrays,
+                                                    out_shapes)]
+        return Executor(self._symbol, self._ctx, new_args,
+                        new_grads if any(g is not None for g in new_grads)
+                        else None,
+                        self._grad_req, self.aux_arrays,
+                        group2ctx=self._group2ctx, _outputs=new_outs)
+
+    # ------------------------------------------------------------------
+    # debugging / monitor (reference: MXExecutorSetMonitorCallback +
+    # monitor.py; fires the callback with every node output)
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    def _run_monitor(self, arg_vals, aux_vals, is_train, rng):
+        _, _, env = self._eval_graph(list(arg_vals), list(aux_vals),
+                                     is_train, rng)
+        for n in self._topo:
+            if n.is_var:
+                continue
+            for j, out_name in enumerate(n.output_names()):
+                val = env.get((id(n), j))
+                if val is not None:
+                    self._monitor_callback(out_name,
+                                           nd.array(np.asarray(val)))
+
+    def debug_str(self):
+        return self._symbol.debug_str()

@@ -1,0 +1,177 @@
+"""Evaluation metrics.
+
+Parity: ``/root/reference/python/mxnet/metric.py`` — EvalMetric base,
+Accuracy, F1, MAE/MSE/RMSE, CrossEntropy, CustomMetric and the ``np``
+decorator helper; ``create`` by-name factory.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "Accuracy", "F1", "MAE", "MSE", "RMSE",
+           "CrossEntropy", "CustomMetric", "create", "np"]
+
+
+def _as_numpy(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+class EvalMetric:
+    """Base metric accumulating (sum_metric, num_inst)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.reset()
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
+
+
+class Accuracy(EvalMetric):
+    """Classification accuracy (argmax over axis 1)."""
+
+    def __init__(self):
+        super().__init__("accuracy")
+
+    def update(self, labels, preds):
+        if len(labels) != len(preds):
+            raise MXNetError("labels and preds length mismatch")
+        for label, pred in zip(labels, preds):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label).astype(np.int32)
+            pred_label = np.argmax(pred, axis=1)
+            self.sum_metric += int((pred_label.flat == label.flat).sum())
+            self.num_inst += len(pred_label.flat)
+
+
+class F1(EvalMetric):
+    """Binary F1 score (reference metric.py:83)."""
+
+    def __init__(self):
+        super().__init__("f1")
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label).astype(np.int32)
+            pred_label = np.argmax(pred, axis=1)
+            if len(np.unique(label)) > 2:
+                raise MXNetError("F1 currently only supports binary"
+                                 " classification.")
+            tp = np.sum((pred_label == 1) & (label == 1))
+            fp = np.sum((pred_label == 1) & (label == 0))
+            fn = np.sum((pred_label == 0) & (label == 1))
+            precision = tp / (tp + fp) if tp + fp > 0 else 0.0
+            recall = tp / (tp + fn) if tp + fn > 0 else 0.0
+            if precision + recall > 0:
+                self.sum_metric += 2 * precision * recall / (precision + recall)
+            self.num_inst += 1
+
+
+class MAE(EvalMetric):
+    def __init__(self):
+        super().__init__("mae")
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += np.abs(label - pred).mean()
+            self.num_inst += 1
+
+
+class MSE(EvalMetric):
+    def __init__(self):
+        super().__init__("mse")
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += ((label - pred) ** 2.0).mean()
+            self.num_inst += 1
+
+
+class RMSE(EvalMetric):
+    def __init__(self):
+        super().__init__("rmse")
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += np.sqrt(((label - pred) ** 2.0).mean())
+            self.num_inst += 1
+
+
+class CrossEntropy(EvalMetric):
+    def __init__(self):
+        super().__init__("cross-entropy")
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).ravel()
+            pred = _as_numpy(pred)
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[np.arange(label.shape[0]), label.astype(np.int64)]
+            self.sum_metric += (-np.log(np.maximum(prob, 1e-30))).sum()
+            self.num_inst += label.shape[0]
+
+
+class CustomMetric(EvalMetric):
+    """Wrap a feval(label, pred) -> float (reference CustomMetric)."""
+
+    def __init__(self, feval, name=None):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = "custom(%s)" % name
+        super().__init__(name)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            self.sum_metric += self._feval(_as_numpy(label), _as_numpy(pred))
+            self.num_inst += 1
+
+
+def np(numpy_feval, name=None):
+    """Create a CustomMetric from a numpy feval (reference metric.np)."""
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name)
+
+
+def create(metric):
+    """Create by name or pass through callables (reference metric.create)."""
+    if callable(metric):
+        return CustomMetric(metric)
+    if isinstance(metric, EvalMetric):
+        return metric
+    metrics = {"acc": Accuracy, "accuracy": Accuracy, "f1": F1, "mae": MAE,
+               "mse": MSE, "rmse": RMSE, "ce": CrossEntropy,
+               "cross-entropy": CrossEntropy}
+    try:
+        return metrics[metric.lower()]()
+    except KeyError:
+        raise ValueError("Metric must be either callable or in %s"
+                         % sorted(metrics))

@@ -39,7 +39,8 @@ import jax.numpy as jnp
 __all__ = ["NDArray", "zeros", "ones", "full", "empty", "array", "save",
            "load", "concatenate", "waitall", "onehot_encode", "clip", "dot",
            "norm", "sqrt", "rsqrt", "square", "abs", "sign", "round", "ceil",
-           "floor", "exp", "log", "maximum", "minimum", "negative",
+           "floor", "exp", "log", "cos", "sin", "maximum", "minimum",
+           "negative",
            "choose_element_0index", "fill_element_0index", "sum", "max",
            "min", "argmax_channel", "transpose", "imdecode"]
 
@@ -49,12 +50,18 @@ _LIVE_CHUNKS: "weakref.WeakSet[_Chunk]" = weakref.WeakSet()
 
 
 class _Chunk:
-    """Flat storage buffer; the unit of mutation and engine tracking."""
+    """Storage buffer; the unit of mutation and engine tracking.
+
+    ``buf`` may be stored in ANY shape (only its total size is invariant):
+    the whole-array fast path then returns/stores buffers without a reshape
+    dispatch — important on TPU where every dispatch pays host↔device RTT.
+    View reads/writes flatten on demand.
+    """
 
     __slots__ = ("buf", "ctx", "__weakref__")
 
     def __init__(self, buf, ctx: Context):
-        self.buf = buf  # 1-D jax.Array
+        self.buf = buf  # jax.Array, any shape
         self.ctx = ctx
         _LIVE_CHUNKS.add(self)
 
@@ -102,8 +109,8 @@ class NDArray:
     @staticmethod
     def _from_jax(val, ctx=None):
         ctx = ctx or current_context()
-        val = jnp.ravel(val)
-        return NDArray(_Chunk(val, ctx), val.shape if val.ndim else (1,))
+        shape = val.shape if val.ndim else (1,)
+        return NDArray(_Chunk(val, ctx), shape)
 
     # ------------------------------------------------------------------
     # storage access
@@ -120,8 +127,10 @@ class NDArray:
         """Read this (view of the) chunk as a shaped jax array."""
         buf = self._chunk.buf
         if self._is_whole:
-            return buf.reshape(self._shape)
-        return jax.lax.dynamic_slice(buf, (self._offset,), (self._size,)).reshape(self._shape)
+            return buf if buf.shape == self._shape else buf.reshape(self._shape)
+        flat = buf.reshape(-1)
+        return jax.lax.dynamic_slice(flat, (self._offset,),
+                                     (self._size,)).reshape(self._shape)
 
     def _set(self, value):
         """Write a shaped jax array into this view (write-through)."""
@@ -132,10 +141,11 @@ class NDArray:
             value = jnp.broadcast_to(value, self._shape)
         value = value.astype(self.dtype)
         if self._is_whole:
-            self._chunk.buf = value.reshape(-1)
+            self._chunk.buf = value  # keep natural shape; readers adapt
         else:
             self._chunk.buf = jax.lax.dynamic_update_slice(
-                self._chunk.buf, value.reshape(-1), (self._offset,))
+                self._chunk.buf.reshape(-1), value.reshape(-1),
+                (self._offset,))
         return self
 
     # ------------------------------------------------------------------
@@ -280,12 +290,7 @@ class NDArray:
             b = jnp.asarray(other)
             rdtype = np.promote_types(self.dtype, b.dtype)
         out = fn(b, a) if reverse else fn(a, b)
-        return NDArray._from_jax(out.astype(rdtype).reshape(-1), self.context) \
-            ._reshaped(out.shape)
-
-    def _reshaped(self, shape):
-        self._shape = tuple(int(s) for s in shape) or (1,)
-        return self
+        return NDArray._from_jax(out.astype(rdtype), self.context)
 
     def __add__(self, o):
         return self._binary(o, jnp.add)
@@ -316,8 +321,7 @@ class NDArray:
         return self._binary(o, jnp.power)
 
     def __neg__(self):
-        return NDArray._from_jax(-self._val.reshape(-1), self.context) \
-            ._reshaped(self._shape)
+        return NDArray._from_jax(-self._val, self.context)
 
     # in-place ops mutate the chunk (engine write dependency in the ref)
     def _inplace(self, other, fn):
@@ -407,7 +411,7 @@ def concatenate(arrays, axis=0, always_copy=True):
     if len(arrays) == 1 and not always_copy and axis == 0:
         return arrays[0]
     val = jnp.concatenate([a._val for a in arrays], axis=axis)
-    return NDArray._from_jax(val.reshape(-1), arrays[0].context)._reshaped(val.shape)
+    return NDArray._from_jax(val, arrays[0].context)
 
 
 def waitall():
@@ -423,8 +427,7 @@ def _maybe_out(val, out, ctx):
     if out is not None:
         out._set(val.astype(out.dtype))
         return out
-    res = NDArray._from_jax(jnp.ravel(val), ctx)
-    return res._reshaped(val.shape)
+    return NDArray._from_jax(val, ctx)
 
 
 def _unary_factory(fn, name):
@@ -441,6 +444,8 @@ square = _unary_factory(jnp.square, "square")
 exp = _unary_factory(jnp.exp, "exp")
 log = _unary_factory(jnp.log, "log")
 sign = _unary_factory(jnp.sign, "sign")
+cos = _unary_factory(jnp.cos, "cos")
+sin = _unary_factory(jnp.sin, "sin")
 ceil = _unary_factory(jnp.ceil, "ceil")
 floor = _unary_factory(jnp.floor, "floor")
 round = _unary_factory(jnp.round, "round")

@@ -1,0 +1,165 @@
+"""Custom operators defined in Python.
+
+Parity: ``/root/reference/python/mxnet/operator.py`` — ``PythonOp``/
+``NumpyOp`` (synchronous host-side ops, reference ``native_op-inl.h`` C
+callback bridge) and ``NDArrayOp`` (async, ``ndarray_op-inl.h``).
+
+TPU-first: the host bridge is ``jax.pure_callback`` — the op participates in
+the jitted XLA program, XLA inserts the device↔host transfers around it, and
+``jax.custom_vjp`` routes the user's ``backward`` the same way. This is
+exactly the role NativeOp's blocking C callback plays in the reference, but
+it stays inside the compiled graph instead of breaking the engine pipeline.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ops.registry import OpSpec
+from .symbol import Symbol, _Node, Variable
+from .name import NameManager
+
+__all__ = ["PythonOp", "NumpyOp", "NDArrayOp"]
+
+
+class PythonOp:
+    """Base class for Python-defined operators.
+
+    Subclasses override ``forward(in_data, out_data)`` (write outputs into
+    out_data in place), ``backward(out_grad, in_data, out_data, in_grad)``,
+    ``infer_shape(in_shape) -> (in_shapes, out_shapes)``,
+    ``list_arguments``/``list_outputs``. ``need_top_grad=False`` declares a
+    loss op whose backward ignores head gradients (reference operator.py:
+    NumpyOp(need_top_grad)).
+    """
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    # --- user-overridable interface (defaults: identity op, matching the
+    # reference operator.py base-class behavior exercised by test_python_op)
+    def forward(self, in_data, out_data):
+        out_data[0][:] = in_data[0]
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        if self.need_top_grad_:
+            in_grad[0][:] = out_grad[0]
+        else:
+            in_grad[0][:] = 1.0
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    # --- symbol creation ----------------------------------------------
+    def __call__(self, *args, **kwargs):
+        spec = _PythonOpSpec(self)
+        name = kwargs.pop("name", None)
+        name = NameManager.current().get(name, type(self).__name__.lower())
+        arg_names = self.list_arguments()
+        inputs = [None] * len(arg_names)
+        for i, s in enumerate(args):
+            inputs[i] = s._single_head()
+        for k, s in kwargs.items():
+            if k not in arg_names:
+                raise MXNetError("unknown input %s" % k)
+            inputs[arg_names.index(k)] = s._single_head()
+        for i, inp in enumerate(inputs):
+            if inp is None:
+                inputs[i] = Variable(name + "_" + arg_names[i])._single_head()
+        node = _Node("_Python_" + type(self).__name__, spec, {}, name, inputs)
+        return Symbol([(node, i) for i in range(len(self.list_outputs()))])
+
+    def get_symbol(self, *args, **kwargs):
+        return self(*args, **kwargs)
+
+
+# NumpyOp and NDArrayOp share PythonOp's protocol; the reference's
+# distinction (blocking TBlob callback vs async NDArray callback) collapses
+# on TPU — both run as pure_callbacks scheduled by XLA.
+class NumpyOp(PythonOp):
+    pass
+
+
+class NDArrayOp(PythonOp):
+    pass
+
+
+class _PythonOpSpec(OpSpec):
+    """Adapter presenting a PythonOp instance as an OpSpec."""
+
+    def __init__(self, pyop):
+        self.pyop = pyop
+        self.name = "_Python_" + type(pyop).__name__
+        self._out_shapes = None
+
+    def arguments(self, p):
+        return self.pyop.list_arguments()
+
+    def outputs(self, p):
+        return self.pyop.list_outputs()
+
+    def infer_shape(self, p, in_shapes):
+        if any(s is None for s in in_shapes):
+            return list(in_shapes), [None] * len(self.pyop.list_outputs()), []
+        ins, outs = self.pyop.infer_shape([list(s) for s in in_shapes])
+        self._out_shapes = [tuple(o) for o in outs]
+        return ([tuple(s) for s in ins], self._out_shapes, [])
+
+    def forward(self, p, ins, aux, is_train, rng):
+        pyop = self.pyop
+        _, out_shapes = pyop.infer_shape([list(x.shape) for x in ins])
+        out_avals = [jax.ShapeDtypeStruct(tuple(s), ins[0].dtype)
+                     for s in out_shapes]
+        in_avals = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in ins]
+
+        def host_forward(*in_arrays):
+            in_np = [np.asarray(a) for a in in_arrays]
+            out_np = [np.zeros(s, dtype=in_np[0].dtype) for s in out_shapes]
+            pyop.forward(in_data=in_np, out_data=out_np)
+            return tuple(out_np)
+
+        def host_backward(*flat):
+            n_out = len(out_shapes)
+            n_in = len(in_avals)
+            out_grad = [np.asarray(a) for a in flat[:n_out]]
+            in_data = [np.asarray(a) for a in flat[n_out:n_out + n_in]]
+            out_data = [np.asarray(a) for a in flat[n_out + n_in:]]
+            in_grad = [np.zeros_like(a) for a in in_data]
+            if not pyop.need_top_grad():
+                out_grad = []  # loss op: head grads not materialized (ref)
+            pyop.backward(out_grad=out_grad, in_data=in_data,
+                          out_data=out_data, in_grad=in_grad)
+            return tuple(in_grad)
+
+        @jax.custom_vjp
+        def f(*xs):
+            return jax.pure_callback(host_forward, tuple(out_avals), *xs)
+
+        def f_fwd(*xs):
+            outs = jax.pure_callback(host_forward, tuple(out_avals), *xs)
+            return outs, (xs, outs)
+
+        def f_bwd(res, gs):
+            xs, outs = res
+            if not isinstance(gs, tuple):
+                gs = (gs,)
+            grads = jax.pure_callback(host_backward, tuple(in_avals),
+                                      *(tuple(gs) + tuple(xs) + tuple(outs)))
+            return tuple(grads)
+
+        f.defvjp(f_fwd, f_bwd)
+        outs = f(*ins)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        return list(outs), []

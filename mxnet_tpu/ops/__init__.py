@@ -1,0 +1,12 @@
+"""Operator library: declarative specs + pure JAX forwards.
+
+The registry replaces the reference's ``OperatorProperty`` +
+``MXNET_REGISTER_OP_PROPERTY`` machinery (``include/mxnet/operator.h``);
+see registry.py. Importing this package registers every op family from
+SURVEY.md §2.4.
+"""
+from . import registry
+from .registry import REGISTRY, OpSpec, Param, register, get
+from . import tensor  # noqa: F401  (registers structural/elementwise ops)
+from . import nn      # noqa: F401  (registers NN ops)
+from . import loss    # noqa: F401  (registers output/loss ops)

@@ -1,0 +1,178 @@
+"""Output (loss) operators.
+
+Parity: ``src/operator/softmax_output-inl.h``, ``regression_output-inl.h``,
+``identity_attach_KL_sparse_reg-inl.h``.
+
+Reference semantics preserved exactly: loss layers IGNORE incoming head
+gradients — ``Executor.backward()`` with no head grads "just works" — and
+their gradients are *summed* over the batch, not averaged (the optimizer's
+``rescale_grad`` handles 1/batch). This is expressed with ``jax.custom_vjp``
+so the rest of the graph still differentiates through plain XLA autodiff.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import OpSpec, Param, register, shape_assign
+
+
+def _loss_vjp(fwd_fn, grad_fn):
+    """Build f(data, label) whose data-gradient is grad_fn(out, label),
+    independent of the incoming cotangent (reference loss-layer contract)."""
+    @jax.custom_vjp
+    def f(data, label):
+        return fwd_fn(data, label)
+
+    def f_fwd(data, label):
+        out = fwd_fn(data, label)
+        return out, (out, label)
+
+    def f_bwd(res, g):
+        out, label = res
+        del g  # reference loss layers ignore head gradients
+        return grad_fn(out, label), jnp.zeros_like(label)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+@register
+class SoftmaxOutput(OpSpec):
+    """Softmax forward + fused cross-entropy gradient
+    (``softmax_output-inl.h``). grad = (p - onehot(label)) * grad_scale;
+    ``use_ignore`` zeroes gradients where label == ignore_label;
+    ``multi_output`` does per-position softmax over axis 1."""
+
+    name = "SoftmaxOutput"
+    aliases = ("Softmax",)  # deprecated alias kept by the reference
+    params = {"grad_scale": Param("float", 1.0),
+              "ignore_label": Param("float", -1.0),
+              "multi_output": Param("bool", False),
+              "use_ignore": Param("bool", False)}
+
+    def arguments(self, p):
+        return ["data", "label"]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return list(in_shapes), [None], []
+        if p["multi_output"]:
+            lshape = (d[0],) + tuple(d[2:])
+        else:
+            lshape = (d[0],)
+        ins = [d, shape_assign(in_shapes[1], lshape, "SoftmaxOutput label")]
+        return ins, [d], []
+
+    def forward(self, p, ins, aux, is_train, rng):
+        data, label = ins
+        axis = 1 if p["multi_output"] else -1
+        scale = p["grad_scale"]
+        use_ignore = p["use_ignore"]
+        ignore = p["ignore_label"]
+
+        def fwd_fn(d, l):
+            return jax.nn.softmax(d, axis=axis)
+
+        def grad_fn(out, l):
+            nclass = out.shape[axis]
+            idx = l.astype(jnp.int32)
+            onehot = jax.nn.one_hot(idx, nclass, dtype=out.dtype,
+                                    axis=axis if p["multi_output"] else -1)
+            grad = (out - onehot) * scale
+            if use_ignore:
+                keep = (l != ignore).astype(out.dtype)
+                kshape = list(l.shape)
+                kshape.insert(axis if axis >= 0 else out.ndim - 1 + 1, 1)
+                grad = grad * keep.reshape(kshape)
+            return grad
+
+        return [_loss_vjp(fwd_fn, grad_fn)(data, label)], []
+
+
+def _regression(opname, out_fn, grad_fn):
+    @register
+    class _Reg(OpSpec):
+        name = opname
+        params = {"grad_scale": Param("float", 1.0)}
+
+        def arguments(self, p):
+            return ["data", "label"]
+
+        def infer_shape(self, p, in_shapes):
+            d = in_shapes[0]
+            if d is None:
+                return list(in_shapes), [None], []
+            # label matches data, but a (N,) label is accepted for (N,1) data
+            l = in_shapes[1]
+            if l is not None and tuple(l) != tuple(d) \
+                    and tuple(l) != tuple(d[:-1]):
+                raise MXNetError("%s: label shape %s vs data %s"
+                                 % (opname, l, d))
+            return [d, l or d], [d], []
+
+        def forward(self, p, ins, aux, is_train, rng):
+            scale = p["grad_scale"]
+
+            def g(out, label):
+                lbl = label.reshape(out.shape)
+                return grad_fn(out, lbl) * scale
+
+            return [_loss_vjp(lambda d, l: out_fn(d), g)(*ins)], []
+    _Reg.__name__ = "Op" + opname
+    return _Reg
+
+
+# reference regression_output-inl.h: Linear (identity, out-label),
+# Logistic (sigmoid, out-label), MAE (identity, sign(out-label))
+_regression("LinearRegressionOutput", lambda d: d, lambda o, l: o - l)
+_regression("LogisticRegressionOutput", jax.nn.sigmoid, lambda o, l: o - l)
+_regression("MAERegressionOutput", lambda d: d, lambda o, l: jnp.sign(o - l))
+
+
+@register
+class IdentityAttachKLSparseReg(OpSpec):
+    """Identity forward that attaches a KL sparsity penalty gradient
+    (``identity_attach_KL_sparse_reg-inl.h``, sparse autoencoders). The
+    average activation rho_hat is tracked in aux ``moving_avg``."""
+
+    name = "IdentityAttachKLSparseReg"
+    params = {"sparseness_target": Param("float", 0.1),
+              "penalty": Param("float", 0.001),
+              "momentum": Param("float", 0.9)}
+
+    def aux_states(self, p):
+        return ["moving_avg"]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return [None], [None], [None]
+        return [d], [d], [(d[1],)]
+
+    def forward(self, p, ins, aux, is_train, rng):
+        x = ins[0]
+        rho = p["sparseness_target"]
+        penalty = p["penalty"]
+        mom = p["momentum"]
+        rho_hat = jnp.mean(x, axis=tuple(i for i in range(x.ndim) if i != 1))
+        new_avg = mom * aux[0] + (1 - mom) * rho_hat if is_train else aux[0]
+
+        @jax.custom_vjp
+        def f(d):
+            return d
+
+        def f_fwd(d):
+            return d, jnp.mean(d, axis=tuple(i for i in range(d.ndim) if i != 1))
+
+        def f_bwd(res, g):
+            rh = jnp.clip(res, 1e-6, 1 - 1e-6)
+            kl_grad = penalty * (-rho / rh + (1 - rho) / (1 - rh))
+            shape = (1, -1) + (1,) * (g.ndim - 2)
+            return (g + kl_grad.reshape(shape),)
+
+        f.defvjp(f_fwd, f_bwd)
+        return [f(x)], [new_avg]

@@ -1,0 +1,340 @@
+"""Structural and elementwise operators.
+
+Parity: ``src/operator/elementwise_binary_op-inl.h``,
+``elementwise_binary_scalar_op-inl.h``, ``elementwise_sum-inl.h``,
+``reshape-inl.h``, ``concat-inl.h``, ``slice_channel-inl.h``,
+``swapaxis-inl.h``, ``cast-inl.h``, ``block_grad-inl.h``,
+``crop-inl.h`` and the unary zoo in ``src/ndarray/unary_function-inl.h``.
+
+All forwards are single jnp/lax calls — XLA fuses them into neighbors, which
+is the TPU-native replacement for mshadow expression-template fusion.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import (OpSpec, Param, register, same_shape_infer,
+                       shape_assign)
+
+
+def _binary_op(opname, fn):
+    @register
+    class _Bin(OpSpec):
+        name = opname
+
+        def arguments(self, p):
+            return ["lhs", "rhs"]
+
+        def infer_shape(self, p, in_shapes):
+            return same_shape_infer(p, in_shapes)
+
+        def forward(self, p, ins, aux, is_train, rng):
+            return [fn(ins[0], ins[1])], []
+    _Bin.__name__ = "Op" + opname
+    return _Bin
+
+
+_binary_op("_Plus", jnp.add)
+_binary_op("_Minus", jnp.subtract)
+_binary_op("_Mul", jnp.multiply)
+_binary_op("_Div", jnp.divide)
+_binary_op("_Power", jnp.power)
+_binary_op("_Maximum", jnp.maximum)
+_binary_op("_Minimum", jnp.minimum)
+
+
+def _scalar_op(opname, fn):
+    @register
+    class _Scal(OpSpec):
+        name = opname
+        params = {"scalar": Param("float")}
+
+        def infer_shape(self, p, in_shapes):
+            return same_shape_infer(p, in_shapes)
+
+        def forward(self, p, ins, aux, is_train, rng):
+            return [fn(ins[0], p["scalar"]).astype(ins[0].dtype)], []
+    _Scal.__name__ = "Op" + opname
+    return _Scal
+
+
+_scalar_op("_PlusScalar", lambda x, s: x + s)
+_scalar_op("_MinusScalar", lambda x, s: x - s)
+_scalar_op("_RMinusScalar", lambda x, s: s - x)
+_scalar_op("_MulScalar", lambda x, s: x * s)
+_scalar_op("_DivScalar", lambda x, s: x / s)
+_scalar_op("_RDivScalar", lambda x, s: s / x)
+_scalar_op("_PowerScalar", lambda x, s: jnp.power(x, s))
+_scalar_op("_RPowerScalar", lambda x, s: jnp.power(s, x))
+_scalar_op("_MaximumScalar", lambda x, s: jnp.maximum(x, s))
+_scalar_op("_MinimumScalar", lambda x, s: jnp.minimum(x, s))
+
+
+def _unary_op(opname, fn, aliases=()):
+    als = aliases
+
+    @register
+    class _Un(OpSpec):
+        name = opname
+        aliases = als
+
+        def infer_shape(self, p, in_shapes):
+            return same_shape_infer(p, in_shapes)
+
+        def forward(self, p, ins, aux, is_train, rng):
+            return [fn(ins[0]).astype(ins[0].dtype)], []
+    _Un.__name__ = "Op" + opname
+    return _Un
+
+
+# unary zoo (tblob registry: both mx.nd.* and mx.sym.* in the reference)
+_unary_op("abs", jnp.abs)
+_unary_op("sign", jnp.sign)
+_unary_op("round", jnp.round)
+_unary_op("ceil", jnp.ceil)
+_unary_op("floor", jnp.floor)
+_unary_op("square", jnp.square)
+_unary_op("sqrt", jnp.sqrt)
+_unary_op("rsqrt", lambda x: 1.0 / jnp.sqrt(x))
+_unary_op("exp", jnp.exp)
+_unary_op("log", jnp.log)
+_unary_op("cos", jnp.cos)
+_unary_op("sin", jnp.sin)
+
+
+@register
+class ElementWiseSum(OpSpec):
+    """N-ary addition (``elementwise_sum-inl.h``); also what autodiff uses
+    to aggregate multi-consumer gradients in the reference
+    (``static_graph.cc:374`` CreateSumNode) — here XLA does that itself."""
+
+    name = "ElementWiseSum"
+    params = {"num_args": Param("int")}
+
+    def arguments(self, p):
+        return ["arg%d" % i for i in range(p["num_args"])]
+
+    def infer_shape(self, p, in_shapes):
+        return same_shape_infer(p, in_shapes)
+
+    def forward(self, p, ins, aux, is_train, rng):
+        out = ins[0]
+        for x in ins[1:]:
+            out = out + x
+        return [out], []
+
+
+@register
+class Reshape(OpSpec):
+    """View change (``reshape-inl.h``). target_shape excludes batch dim 0
+    in the 2015 interface."""
+
+    name = "Reshape"
+    params = {"target_shape": Param("shape")}
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return [None], [None], []
+        tgt = (d[0],) + tuple(p["target_shape"])
+        # one dim may be 0 = inferred
+        if 0 in tgt[1:]:
+            known = int(np.prod([x for x in tgt[1:] if x != 0])) * tgt[0]
+            total = int(np.prod(d))
+            tgt = tuple(total // max(known, 1) if x == 0 else x for x in tgt)
+        if int(np.prod(tgt)) != int(np.prod(d)):
+            raise MXNetError("Reshape: size mismatch %s -> %s" % (d, tgt))
+        return [d], [tgt], []
+
+    def forward(self, p, ins, aux, is_train, rng):
+        x = ins[0]
+        tgt = (x.shape[0],) + tuple(p["target_shape"])
+        if 0 in tgt[1:]:
+            known = int(np.prod([t for t in tgt[1:] if t != 0])) * tgt[0]
+            tgt = tuple(x.size // max(known, 1) if t == 0 else t for t in tgt)
+        return [x.reshape(tgt)], []
+
+
+@register
+class Flatten(OpSpec):
+    """Collapse all but the batch dim (``reshape-inl.h`` FlattenProp)."""
+
+    name = "Flatten"
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return [None], [None], []
+        return [d], [(d[0], int(np.prod(d[1:])))], []
+
+    def forward(self, p, ins, aux, is_train, rng):
+        x = ins[0]
+        return [x.reshape(x.shape[0], -1)], []
+
+
+@register
+class Concat(OpSpec):
+    """Concatenate along ``dim`` (``concat-inl.h``)."""
+
+    name = "Concat"
+    params = {"num_args": Param("int"), "dim": Param("int", 1)}
+
+    def arguments(self, p):
+        return ["arg%d" % i for i in range(p["num_args"])]
+
+    def infer_shape(self, p, in_shapes):
+        dim = p["dim"]
+        if any(s is None for s in in_shapes):
+            return list(in_shapes), [None], []
+        ndim = len(in_shapes[0])
+        out = list(in_shapes[0])
+        total = 0
+        for s in in_shapes:
+            if len(s) != ndim:
+                raise MXNetError("Concat: ndim mismatch")
+            for ax in range(ndim):
+                if ax != dim and s[ax] != out[ax]:
+                    raise MXNetError("Concat: shape mismatch %s vs %s"
+                                     % (s, tuple(out)))
+            total += s[dim]
+        out[dim] = total
+        return list(in_shapes), [tuple(out)], []
+
+    def forward(self, p, ins, aux, is_train, rng):
+        return [jnp.concatenate(ins, axis=p["dim"])], []
+
+
+@register
+class SliceChannel(OpSpec):
+    """Split along an axis into num_outputs (``slice_channel-inl.h``);
+    the inverse of Concat, used for LSTM gate splitting."""
+
+    name = "SliceChannel"
+    params = {"num_outputs": Param("int"), "axis": Param("int", 1),
+              "squeeze_axis": Param("bool", False)}
+
+    def outputs(self, p):
+        return ["output%d" % i for i in range(p["num_outputs"])]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        n = p["num_outputs"]
+        if d is None:
+            return [None], [None] * n, []
+        ax = p["axis"]
+        if d[ax] % n != 0:
+            raise MXNetError("SliceChannel: dim %d not divisible by %d"
+                             % (d[ax], n))
+        piece = list(d)
+        piece[ax] //= n
+        if p["squeeze_axis"]:
+            if piece[ax] != 1:
+                raise MXNetError("SliceChannel: squeeze needs size-1 axis")
+            piece = piece[:ax] + piece[ax + 1:]
+        return [d], [tuple(piece)] * n, []
+
+    def forward(self, p, ins, aux, is_train, rng):
+        outs = jnp.split(ins[0], p["num_outputs"], axis=p["axis"])
+        if p["squeeze_axis"]:
+            outs = [jnp.squeeze(o, axis=p["axis"]) for o in outs]
+        return outs, []
+
+
+@register
+class SwapAxis(OpSpec):
+    """Swap two axes (``swapaxis-inl.h``)."""
+
+    name = "SwapAxis"
+    params = {"dim1": Param("int", 0), "dim2": Param("int", 0)}
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return [None], [None], []
+        s = list(d)
+        s[p["dim1"]], s[p["dim2"]] = s[p["dim2"]], s[p["dim1"]]
+        return [d], [tuple(s)], []
+
+    def forward(self, p, ins, aux, is_train, rng):
+        return [jnp.swapaxes(ins[0], p["dim1"], p["dim2"])], []
+
+
+@register
+class Cast(OpSpec):
+    """dtype conversion (``cast-inl.h``)."""
+
+    name = "Cast"
+    params = {"dtype": Param("str")}
+
+    def infer_shape(self, p, in_shapes):
+        return same_shape_infer(p, in_shapes)
+
+    def infer_type(self, p, in_types):
+        return [in_types[0]], [np.dtype(p["dtype"])], []
+
+    def forward(self, p, ins, aux, is_train, rng):
+        return [ins[0].astype(np.dtype(p["dtype"]))], []
+
+
+@register
+class BlockGrad(OpSpec):
+    """Identity forward, zero gradient (``block_grad-inl.h``)."""
+
+    name = "BlockGrad"
+
+    def infer_shape(self, p, in_shapes):
+        return same_shape_infer(p, in_shapes)
+
+    def forward(self, p, ins, aux, is_train, rng):
+        return [jax.lax.stop_gradient(ins[0])], []
+
+
+@register
+class Crop(OpSpec):
+    """Spatial crop to explicit size or to a reference symbol's H/W
+    (``crop-inl.h``; used by FCN skip connections). With num_args=2 the
+    second input supplies the target H/W and gets no gradient."""
+
+    name = "Crop"
+    params = {"num_args": Param("int", 1), "offset": Param("shape", (0, 0)),
+              "h_w": Param("shape", (0, 0)),
+              "center_crop": Param("bool", False)}
+
+    def arguments(self, p):
+        if p["num_args"] == 1:
+            return ["data"]
+        return ["data", "crop_like"]
+
+    def _target_hw(self, p, shapes):
+        if p["num_args"] == 2 and shapes[1] is not None:
+            return shapes[1][2], shapes[1][3]
+        if p["h_w"] != (0, 0):
+            return p["h_w"]
+        return None
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        hw = self._target_hw(p, in_shapes)
+        if d is None or hw is None:
+            return list(in_shapes), [None], []
+        return list(in_shapes), [(d[0], d[1], hw[0], hw[1])], []
+
+    def forward(self, p, ins, aux, is_train, rng):
+        x = ins[0]
+        if p["num_args"] == 2:
+            th, tw = ins[1].shape[2], ins[1].shape[3]
+        else:
+            th, tw = p["h_w"]
+        if p["center_crop"]:
+            oy = (x.shape[2] - th) // 2
+            ox = (x.shape[3] - tw) // 2
+        else:
+            oy, ox = p["offset"]
+        # crop_like (ins[1]) is used only for its static shape, so autodiff
+        # already gives it a zero gradient like the reference crop-inl.h.
+        out = jax.lax.dynamic_slice(
+            x, (0, 0, oy, ox), (x.shape[0], x.shape[1], th, tw))
+        return [out], []

@@ -1,0 +1,303 @@
+"""Weight-update optimizers.
+
+Parity: ``/root/reference/python/mxnet/optimizer.py`` (registry + SGD:163,
+SGLD:254, ccSGD:336, Adam:425, AdaGrad:550, RMSProp:586, AdaDelta:662,
+Test:718, ``get_updater``) and ``src/optimizer/sgd-inl.h`` (momentum,
+weight decay, gradient clipping, rescale).
+
+TPU-first: each optimizer's math lives in a pure ``_step(weight, grad,
+state, lr, wd)`` jax function. ``update()`` (the reference's imperative
+entry point, used by KVStore updaters and tests) applies it eagerly to
+NDArrays; the fused training path (model.py / parallel trainer) calls the
+same pure math inside one jitted train step so the whole
+forward+backward+update is a single XLA program.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+from . import random as mx_random
+
+__all__ = ["Optimizer", "SGD", "SGLD", "ccSGD", "Adam", "AdaGrad", "RMSProp",
+           "AdaDelta", "Test", "create", "get_updater", "register"]
+
+
+class Optimizer:
+    """Base optimizer with the reference's registry and lr-scale plumbing."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, rescale_grad=1.0, **kwargs):
+        if name.lower() not in Optimizer.opt_registry:
+            raise ValueError("Cannot find optimizer %s" % name)
+        return Optimizer.opt_registry[name.lower()](
+            rescale_grad=rescale_grad, **kwargs)
+
+    def __init__(self, rescale_grad=1.0, arg_names=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None):
+        self.rescale_grad = float(rescale_grad)
+        self.lr = float(learning_rate)
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = float(wd)
+        self.clip_gradient = clip_gradient
+        self.num_update = 0
+        self._index_update_count = {}
+        self.lr_scale = {}
+        self.idx2name = {}
+        if arg_names is not None:
+            self.idx2name = {i: n for i, n in enumerate(arg_names)}
+
+    def set_lr_scale(self, args_lrscale):
+        """Per-index lr multipliers (reference optimizer.py set_lr_scale)."""
+        self.lr_scale = args_lrscale.copy()
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_scale = args_lr_mult.copy()
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = 0
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        return lr * self.lr_scale.get(index, 1.0)
+
+    # --- interface -----------------------------------------------------
+    def create_state(self, index, weight):
+        raise NotImplementedError
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    # --- pure-math helpers shared by eager and fused paths --------------
+    def _clip_rescale(self, g):
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and weight decay (reference optimizer.py:163,
+    src/optimizer/sgd-inl.h:21-161)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = float(momentum)
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def _step(self, w, g, mom, lr, wd):
+        g = self._clip_rescale(g)
+        g = g + wd * w
+        if mom is None:
+            return w - lr * g, None
+        new_mom = self.momentum * mom - lr * g
+        return w + new_mom, new_mom
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray) and isinstance(grad, NDArray)
+        lr = self._get_lr(index)
+        self._update_count(index)
+        new_w, new_mom = self._step(weight._val, grad._val,
+                                    None if state is None else state._val,
+                                    lr, self.wd)
+        weight._set(new_w)
+        if state is not None:
+            state._set(new_mom)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer.py:254):
+    SGD plus gaussian noise scaled by sqrt(lr)."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        self._update_count(index)
+        g = self._clip_rescale(grad._val) + self.wd * weight._val
+        noise = mx_random.normal(0, math.sqrt(lr), weight.shape,
+                                 weight.context)
+        weight._set(weight._val - (lr / 2) * g + noise._val)
+
+
+@register
+class ccSGD(SGD):
+    """C++-implemented SGD in the reference (src/optimizer/sgd-inl.h);
+    identical math to SGD here — there is no separate engine path."""
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference optimizer.py:425; Kingma & Ba 2014) with the
+    reference's time-step bias correction."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, decay_factor=(1 - 1e-8), **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.decay_factor = decay_factor
+        self.time = 0
+        self.time_first_index = None
+
+    def create_state(self, index, weight):
+        self.time_first_index = None
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        self._update_count(index)
+        # per-weight time tracking (reference increments on the first index)
+        if self.time_first_index is None:
+            self.time_first_index = index
+            self.time = 0
+        if index == self.time_first_index:
+            self.time += 1
+        mean, var = state
+        t = self.time
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        g = self._clip_rescale(grad._val) + self.wd * weight._val
+        new_mean = self.beta1 * mean._val + (1 - self.beta1) * g
+        new_var = self.beta2 * var._val + (1 - self.beta2) * g * g
+        weight._set(weight._val - lr_t * new_mean /
+                    (jnp.sqrt(new_var) + self.epsilon))
+        mean._set(new_mean)
+        var._set(new_var)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference optimizer.py:550; Duchi et al. 2011)."""
+
+    def __init__(self, learning_rate=0.05, eps=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        self._update_count(index)
+        g = self._clip_rescale(grad._val)
+        hist = state._val + g * g
+        state._set(hist)
+        weight._set(weight._val - lr *
+                    (g / jnp.sqrt(hist + self.float_stable_eps)
+                     + self.wd * weight._val))
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp (reference optimizer.py:586; Tieleman & Hinton lecture,
+    with the Graves-style momentum terms gamma1/gamma2)."""
+
+    def __init__(self, learning_rate=0.002, gamma1=0.95, gamma2=0.9,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # n
+                zeros(weight.shape, weight.context, dtype=weight.dtype),  # g
+                zeros(weight.shape, weight.context, dtype=weight.dtype))  # delta
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        self._update_count(index)
+        n, g_avg, delta = state
+        g = self._clip_rescale(grad._val) + self.wd * weight._val
+        new_n = (1 - self.gamma1) * g * g + self.gamma1 * n._val
+        new_g = (1 - self.gamma1) * g + self.gamma1 * g_avg._val
+        new_delta = self.gamma2 * delta._val - lr * g / jnp.sqrt(
+            new_n - new_g * new_g + 1e-4)
+        n._set(new_n)
+        g_avg._set(new_g)
+        delta._set(new_delta)
+        weight._set(weight._val + new_delta)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference optimizer.py:662; Zeiler 2012)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        acc_g, acc_delta = state
+        g = self._clip_rescale(grad._val)
+        new_acc_g = self.rho * acc_g._val + (1 - self.rho) * g * g
+        current_delta = jnp.sqrt(acc_delta._val + self.epsilon) / \
+            jnp.sqrt(new_acc_g + self.epsilon) * g
+        new_acc_delta = self.rho * acc_delta._val + \
+            (1 - self.rho) * current_delta * current_delta
+        acc_g._set(new_acc_g)
+        acc_delta._set(new_acc_delta)
+        weight._set(weight._val - self.wd * weight._val - current_delta)
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer: w -= rescale*grad (reference optimizer.py:718)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight._set(weight._val - grad._val * self.rescale_grad)
+        state._set(weight._val)
+
+
+def get_updater(optimizer):
+    """Close an optimizer into updater(index, grad, weight) with lazily
+    created per-index state (reference optimizer.py get_updater)."""
+    states = {}
+
+    def updater(index, grad, weight):
+        if index not in states:
+            states[index] = optimizer.create_state(index, weight)
+        optimizer.update(index, weight, grad, states[index])
+    updater.states = states
+    updater.optimizer = optimizer
+    return updater

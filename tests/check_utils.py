@@ -1,0 +1,136 @@
+"""Gradient-checking oracle — port of
+/root/reference/tests/python/unittest/check_utils.py (finite-difference
+numeric gradients via a NumpyOp sum loss + random projection)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.operator import NumpyOp
+
+
+def reldiff(a, b):
+    diff = np.sum(np.abs(np.asarray(a, np.float64) - np.asarray(b, np.float64)))
+    norm = np.sum(np.abs(np.asarray(a, np.float64)))
+    if diff == 0:
+        return 0
+    return diff / norm
+
+
+class SumAllLoss(NumpyOp):
+    """Sum-all loss used to scalarize outputs for numeric checking."""
+
+    def __init__(self):
+        super().__init__(False)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [(1,)]
+
+    def forward(self, in_data, out_data):
+        out_data[0][:] = np.sum(in_data[0])
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        in_grad[0][:] = 1
+
+
+def numeric_grad(executor, location, eps=1e-4):
+    """Finite-difference gradient of executor.outputs[0] wrt location."""
+    args = executor.arg_arrays
+    for a, l in zip(args, location):
+        a[:] = np.asarray(l)
+    approx_grads = [np.zeros_like(l) for l in location]
+
+    executor.forward(is_train=True)
+    f_x = executor.outputs[0].asnumpy()
+
+    x_copy = [np.copy(x) for x in location]
+    for ap_grad, loc, reset in zip(approx_grads, location, x_copy):
+        for i in range(int(np.prod(loc.shape))):
+            loc.ravel()[i] += eps
+            for inp, val in zip(args, location):
+                inp[:] = val
+            executor.forward(is_train=True)
+            f_eps = executor.outputs[0].asnumpy()
+            ap_grad.ravel()[i] = (f_eps - f_x) / eps
+            loc.ravel()[i] = reset.ravel()[i]
+    return approx_grads
+
+
+rng = np.random.RandomState(1234)
+
+
+def check_numeric_gradient(sym, location, aux_states=(), numeric_eps=1e-4,
+                           check_eps=1e-2):
+    def random_projection(shape):
+        return rng.rand(*shape) + 0.1
+
+    kwargs = {name: array.shape
+              for name, array in zip(sym.list_arguments(), location)}
+    arg_shape, out_shape, aux_shape = sym.infer_shape(**kwargs)
+
+    proj = mx.sym.Variable("__random_proj")
+    out = SumAllLoss()(sym * proj)
+
+    arr_data = [mx.nd.array(l) for l in location] + [mx.nd.empty(out_shape[0])]
+    arr_grad = [mx.nd.empty(l.shape) for l in location] + \
+        [mx.nd.empty(out_shape[0])]
+    arr_aux = [mx.nd.array(l) for l in aux_states]
+
+    executor = out.bind(mx.cpu(), args=arr_data, args_grad=arr_grad,
+                        aux_states=arr_aux)
+
+    location = list(location) + [random_projection(out_shape[0])]
+    for source, inp in zip(executor.arg_arrays, location):
+        source[:] = inp
+    for g in executor.grad_arrays:
+        if g is not None:
+            g[:] = 0
+
+    assert len(executor.outputs) == 1
+    executor.forward(is_train=True)
+    executor.backward()
+    symbolic_grad = [g.asnumpy() for g in executor.grad_arrays[0:-1]]
+
+    numeric_gradients = numeric_grad(executor, location, eps=numeric_eps)
+
+    for name, numeric, symbolic in zip(out.list_arguments(),
+                                       numeric_gradients, symbolic_grad):
+        rel = reldiff(numeric, symbolic)
+        if rel > check_eps:
+            raise AssertionError(
+                "Numeric check failed for %s. relative error %f > %f"
+                % (name, rel, check_eps))
+
+
+def check_symbolic_forward(sym, location, expected, check_eps=1e-5):
+    arr_data = [mx.nd.array(l) for l in location]
+    arr_grad = [mx.nd.empty(np.asarray(l).shape) for l in location]
+    executor = sym.bind(mx.cpu(), args=arr_data, args_grad=arr_grad)
+    for source, inp in zip(executor.arg_arrays, location):
+        source[:] = inp
+    assert len(executor.outputs) == 1
+    executor.forward()
+    for expect, output in zip(expected,
+                              [x.asnumpy() for x in executor.outputs]):
+        assert reldiff(expect, output) <= check_eps
+
+
+def check_symbolic_backward(sym, location, out_grad, expected, check_eps=1e-5):
+    arr_data = [mx.nd.array(l) for l in location]
+    arr_grad = [mx.nd.empty(np.asarray(l).shape) for l in location]
+    out_grad = [mx.nd.array(j) for j in out_grad]
+    executor = sym.bind(mx.cpu(), args=arr_data, args_grad=arr_grad)
+    for source, inp in zip(executor.arg_arrays, location):
+        source[:] = inp
+    for g in executor.grad_arrays:
+        if g is not None:
+            g[:] = 0
+    executor.forward()
+    executor.backward(out_grad)
+    for expect, grad in zip(expected,
+                            [x.asnumpy() for x in executor.grad_arrays]):
+        assert reldiff(expect, grad) <= check_eps

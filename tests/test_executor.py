@@ -1,0 +1,82 @@
+"""Port of /root/reference/tests/python/unittest/test_executor.py."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def reldiff(a, b):
+    diff = np.sum(np.abs(a - b))
+    norm = np.sum(np.abs(a))
+    return diff / norm
+
+
+def check_bind_with_uniform(uf, gf, dim):
+    shape = tuple(np.random.randint(1, int(1000 ** (1.0 / dim)), size=dim))
+    lhs = mx.symbol.Variable("lhs")
+    rhs = mx.symbol.Variable("rhs")
+    ret = uf(lhs, rhs)
+    assert ret.list_arguments() == ["lhs", "rhs"]
+    lhs_arr = mx.nd.array(np.random.uniform(-10, 10, shape))
+    rhs_arr = mx.nd.array(np.random.uniform(-10, 10, shape))
+    lhs_grad = mx.nd.empty(shape)
+    rhs_grad = mx.nd.empty(shape)
+
+    executor = ret.bind(mx.Context("cpu"),
+                        args=[lhs_arr, rhs_arr],
+                        args_grad=[lhs_grad, rhs_grad])
+    exec3 = ret.bind(mx.Context("cpu"), args=[lhs_arr, rhs_arr])
+    exec4 = ret.bind(mx.Context("cpu"),
+                     args={"rhs": rhs_arr, "lhs": lhs_arr},
+                     args_grad={"lhs": lhs_grad, "rhs": rhs_grad})
+
+    executor.forward()
+    exec3.forward()
+    exec4.forward()
+    out1 = uf(lhs_arr.asnumpy(), rhs_arr.asnumpy())
+    assert reldiff(out1, executor.outputs[0].asnumpy()) < 1e-6
+    assert reldiff(out1, exec3.outputs[0].asnumpy()) < 1e-6
+    assert reldiff(out1, exec4.outputs[0].asnumpy()) < 1e-6
+    # gradient
+    out_grad = mx.nd.array(np.ones(shape))
+    lhs_grad2, rhs_grad2 = gf(out_grad.asnumpy(),
+                              lhs_arr.asnumpy(), rhs_arr.asnumpy())
+    executor.backward([out_grad])
+    assert reldiff(lhs_grad.asnumpy(), lhs_grad2) < 1e-6
+    assert reldiff(rhs_grad.asnumpy(), rhs_grad2) < 1e-6
+
+
+def test_bind():
+    np.random.seed(0)
+    nrepeat = 3
+    maxdim = 4
+    for _ in range(nrepeat):
+        for dim in range(1, maxdim):
+            check_bind_with_uniform(lambda x, y: x + y,
+                                    lambda g, x, y: (g, g), dim)
+            check_bind_with_uniform(lambda x, y: x - y,
+                                    lambda g, x, y: (g, -g), dim)
+            check_bind_with_uniform(lambda x, y: x * y,
+                                    lambda g, x, y: (y * g, x * g), dim)
+            check_bind_with_uniform(lambda x, y: x / y,
+                                    lambda g, x, y: (g / y, -x * g / (y ** 2)),
+                                    dim)
+
+
+def test_reshape():
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=4)
+
+    exe = y.simple_bind(mx.cpu(), x=(5, 4))
+    exe.arg_arrays[0][:] = 1
+    exe.arg_arrays[1][:] = mx.nd.ones((4, 4))
+    exe.arg_arrays[2][:] = 0
+
+    new_exe = exe.reshape(x=(3, 4))
+    new_exe.forward(is_train=False)
+    # sub exec forward
+    assert np.all(new_exe.outputs[0].asnumpy() == 4)
+    # shared memory
+    assert np.all(exe.outputs[0].asnumpy()[:3] == 4)
+    # base exec forward
+    exe.forward(is_train=False)
+    assert np.all(exe.outputs[0].asnumpy() == 4)

@@ -1,0 +1,45 @@
+"""Port of /root/reference/tests/python/unittest/test_infer_shape.py."""
+import pytest
+
+import mxnet_tpu as mx
+import common_models as models
+
+
+def test_mlp2_infer_shape():
+    out = models.mlp2()
+    data_shape = (100, 100)
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=data_shape)
+    arg_shape_dict = dict(zip(out.list_arguments(), arg_shapes))
+    assert len(out_shapes) == 1
+    assert out_shapes[0] == (100, 10)
+    true_shapes = {"fc2_bias": (10,),
+                   "fc2_weight": (10, 1000),
+                   "fc1_bias": (1000,),
+                   "fc1_weight": (1000, 100)}
+    for k, v in true_shapes.items():
+        assert arg_shape_dict[k] == v
+
+
+def test_mlp2_infer_error():
+    out = models.mlp2()
+    weight_shape = (1, 100)
+    data_shape = (100, 100)
+    with pytest.raises(mx.MXNetError):
+        out.infer_shape(data=data_shape, fc1_weight=weight_shape)
+
+
+def test_incomplete_infer_returns_none():
+    out = models.mlp2()
+    arg, outs, aux = out.infer_shape(fc1_bias=(1000,))
+    assert arg is None and outs is None and aux is None
+
+
+def test_conv_infer_shape():
+    sym = models.conv()
+    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(data=(4, 3, 28, 28))
+    d = dict(zip(sym.list_arguments(), arg_shapes))
+    assert d["conv1_weight"] == (32, 3, 3, 3)
+    assert out_shapes[0] == (4, 10)
+    # aux: bn1 and bn2 moving mean/var
+    assert len(aux_shapes) == 4
+    assert all(s == (32,) for s in aux_shapes)

@@ -1,0 +1,706 @@
+"""Port of /root/reference/tests/python/unittest/test_operator.py
+(numpy-reference forward checks + finite-difference gradient checks)."""
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+import mxnet_tpu as mx
+from check_utils import (check_numeric_gradient, check_symbolic_backward,
+                         check_symbolic_forward, reldiff)
+
+
+def same(a, b):
+    return np.sum(a != b) == 0
+
+
+def check_elementwise_sum_with_shape(shape, n):
+    inputs = [mx.symbol.Variable("arg%d" % i) for i in range(n)]
+    out = mx.symbol.ElementWiseSum(*inputs, name="esum")
+    arr = [mx.nd.empty(shape) for _ in range(n)]
+    arr_grad = [mx.nd.empty(shape) for _ in range(n)]
+    for i in range(n):
+        arr[i][:] = np.random.uniform(-10, 10, shape)
+    exec1 = out.bind(mx.Context("cpu"), args=arr, args_grad=arr_grad)
+    exec1.forward()
+    out1 = exec1.outputs[0].asnumpy()
+    expect = sum(a.asnumpy() for a in arr)
+    assert reldiff(expect, out1) < 1e-6
+    out_grad = mx.nd.empty(shape)
+    out_grad[:] = np.random.uniform(-10, 10, shape)
+    exec1.backward([out_grad])
+    for a in arr_grad:
+        assert same(a.asnumpy(), out_grad.asnumpy())
+
+
+def test_elementwise_sum():
+    np.random.seed(0)
+    for dim in range(1, 4):
+        shape = tuple(np.random.randint(1, int(1000 ** (1.0 / dim)), size=dim))
+        check_elementwise_sum_with_shape(shape, np.random.randint(1, 8))
+
+
+def check_slice_channel(dim, num):
+    if dim == 2:
+        shape = (2, 2)
+    else:
+        shape = (2, 2, 2, 3)
+    ins = [np.ones(shape) * i for i in range(num)]
+    e = np.hstack(ins)
+    e_nd = mx.nd.empty(e.shape)
+    e_nd[:] = e
+    data = mx.sym.Variable("data")
+    op = mx.sym.SliceChannel(data=data, num_outputs=num)
+    arg_shape, output_shape, aux_shape = op.infer_shape(data=e_nd.shape)
+    grad_nd = [mx.nd.empty(s) for s in arg_shape]
+
+    exe = op.bind(mx.cpu(), args=[e_nd], args_grad=grad_nd)
+    assert len(exe.outputs) == num
+    exe.forward()
+    for i in range(num):
+        assert reldiff(exe.outputs[i].asnumpy(), ins[i]) < 1e-5
+    # backward
+    o_nd = [exe.outputs[i] for i in range(num)]
+    for i in range(num):
+        o_nd[i] += i
+    exe.backward(o_nd)
+    assert reldiff(grad_nd[0].asnumpy(),
+                   np.hstack([ins[i] + i for i in range(num)])) < 1e-5
+
+
+def test_slice_channel():
+    check_slice_channel(2, 4)
+    check_slice_channel(4, 4)
+
+
+def check_concat_with_shape(shapes, dimension, skip_second):
+    n = len(shapes)
+    inputs = [mx.symbol.Variable("arg%d" % i) for i in range(n)]
+    out = mx.symbol.Concat(*inputs, name="conc", dim=dimension)
+    arr = [mx.nd.empty(shape) for shape in shapes]
+    for i in range(n):
+        arr[i][:] = shapes[i][dimension]
+    arr_np = [np.copy(a.asnumpy()) for a in arr]
+    arr_grad = [mx.nd.empty(shape) for shape in shapes]
+    dict_grad = {}
+    arg_names = out.list_arguments()
+    for name, g in zip(arg_names, arr_grad):
+        if not skip_second or name != "arg1":
+            dict_grad[name] = g
+
+    args = out.list_arguments()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(
+        **dict(zip(args, shapes)))
+    out_grad = mx.nd.empty(out_shapes[0])
+    exec1 = out.bind(mx.Context("cpu"), args=arr, args_grad=dict_grad)
+    exec1.forward()
+    ret = np.concatenate([a.asnumpy() for a in arr], axis=dimension)
+    assert same(exec1.outputs[0].asnumpy(), ret)
+    # backward
+    exec1.outputs[0].copyto(out_grad)
+    out_grad[:] += 1
+    exec1.backward([out_grad])
+    for i, name in enumerate(arg_names):
+        if not skip_second or name != "arg1":
+            assert same(dict_grad[name].asnumpy(), arr_np[i] + 1)
+
+
+def test_concat():
+    merge = [2, 3, 4]
+    for dimension in range(2):
+        for n in range(2, 4):
+            shapes = []
+            for i in range(n):
+                if dimension == 0:
+                    shapes.append((merge[i], 3))
+                else:
+                    shapes.append((3, merge[i]))
+            check_concat_with_shape(shapes, dimension, True)
+            check_concat_with_shape(shapes, dimension, False)
+    # 4D
+    shapes = [(2, m, 3, 3) for m in merge]
+    check_concat_with_shape(shapes, 1, False)
+
+
+def check_regression(symbol, forward, backward):
+    data = mx.symbol.Variable("data")
+    label = mx.symbol.Variable("label")
+    out = symbol(data, label)
+    shape = (3, 1)
+    arr_data = mx.random.uniform(-1, 1, shape)
+    arr_label = mx.random.uniform(0, 1, shape[0])
+    arr_grad = mx.nd.empty(shape)
+    exec1 = out.bind(mx.cpu(), args=[arr_data, arr_label],
+                     args_grad={"data": arr_grad})
+    exec1.forward()
+    out1 = exec1.outputs[0].asnumpy()
+    npout = forward(arr_data.asnumpy())
+    assert reldiff(npout, out1) < 1e-6
+    exec1.backward()
+    npout = backward(npout, arr_label.asnumpy().reshape(npout.shape))
+    assert reldiff(npout, arr_grad.asnumpy()) < 1e-6
+
+
+def test_regression():
+    check_regression(mx.symbol.LogisticRegressionOutput,
+                     lambda x: 1.0 / (1.0 + np.exp(-x)),
+                     lambda x, y: x - y)
+    check_regression(mx.symbol.LinearRegressionOutput,
+                     lambda x: x,
+                     lambda x, y: x - y)
+
+
+def test_softmax():
+    shape = (4, 5)
+    X = mx.symbol.Variable("X")
+    L = mx.symbol.Variable("L")
+    Y = mx.symbol.Softmax(data=X, label=L)
+    x = mx.random.uniform(-1, 1, shape)
+    lbl = np.random.randint(0, shape[1], (shape[0],)).astype(np.float32)
+    l = mx.nd.array(lbl)
+    grad = mx.nd.empty(shape)
+    exec1 = Y.bind(mx.cpu(), args=[x, l], args_grad={"X": grad})
+    exec1.forward()
+    p = exec1.outputs[0].asnumpy()
+    ex = np.exp(x.asnumpy() - x.asnumpy().max(axis=1, keepdims=True))
+    expect = ex / ex.sum(axis=1, keepdims=True)
+    assert reldiff(p, expect) < 1e-5
+    exec1.backward()
+    onehot = np.eye(shape[1])[lbl.astype(int)]
+    assert reldiff(grad.asnumpy(), p - onehot) < 1e-5
+
+
+def test_python_op():
+    X = mx.symbol.Variable("X")
+    op = mx.operator.NumpyOp()
+    s = op.get_symbol(X, name="numpy_op")
+
+    x = mx.ndarray.ones((10,)) * 10
+    dx = mx.ndarray.zeros((10,))
+    dy = mx.ndarray.ones((10,))
+    exec1 = s.bind(mx.cpu(), args=[x], args_grad={"X": dx})
+    exec1.forward()
+    assert reldiff(x.asnumpy(), exec1.outputs[0].asnumpy()) < 1e-5
+    exec1.backward(dy)
+    assert reldiff(dy.asnumpy(), dx.asnumpy()) < 1e-5
+
+
+def test_swapaxes():
+    data = mx.symbol.Variable("data")
+    shape = (2, 3, 4)
+    data_tmp = np.ones(shape)
+    data_tmp[0] = 1
+    data_tmp[1] = 2
+    arr_data = mx.nd.array(data_tmp)
+    swap0 = mx.symbol.SwapAxis(data=data, dim1=0, dim2=2)
+    swap = mx.symbol.SwapAxis(data=swap0, dim1=1, dim2=2)
+    exe_c = swap.bind(mx.cpu(), args=[arr_data])
+    exe_c.forward()
+    out = exe_c.outputs[0].asnumpy()
+    swap_ = np.swapaxes(np.swapaxes(data_tmp, 0, 2), 1, 2)
+    assert reldiff(out, swap_) < 1e-6
+
+
+def test_scalarop():
+    data = mx.symbol.Variable("data")
+    shape = (3, 4)
+    data_tmp = np.ones(shape) * 5
+    test = 2 / (4 - ((1 + data + 1) * 2 / 5) - 0.2)
+    npout_1 = (4 - ((1 + data_tmp + 1) * 2 / 5) - 0.2)
+    npout = 2 / npout_1
+    check_symbolic_forward(test, [data_tmp], [npout])
+    npout_grad = 2. * 2 / 5
+    npout_grad = 2 * npout_grad / (npout_1 * npout_1)
+    check_symbolic_backward(test, [data_tmp], [np.ones(shape) * 2],
+                            [npout_grad])
+
+
+def test_scalar_pow():
+    data = mx.symbol.Variable("data")
+    shape = (1, 1)
+    data_tmp = np.ones(shape)
+    test = data ** 2
+    check_numeric_gradient(test, [data_tmp])
+    check_symbolic_forward(test, [data_tmp], [data_tmp ** 2])
+    check_symbolic_backward(test, [data_tmp], [np.ones(shape)], [2 * data_tmp])
+
+
+def test_symbol_pow():
+    shape = (1, 1)
+    data = mx.symbol.Variable("data")
+    data_tmp = np.ones(shape) * 2
+    exp = mx.symbol.Variable("exp")
+    exp_tmp = np.ones(shape) * 3
+    test = data ** exp
+    check_numeric_gradient(test, [data_tmp, exp_tmp])
+    check_symbolic_forward(test, [data_tmp, exp_tmp], [data_tmp ** exp_tmp])
+    data_dir = data_tmp ** (exp_tmp - 1) * exp_tmp
+    exp_dir = data_tmp ** exp_tmp * np.log(data_tmp)
+    check_symbolic_backward(test, [data_tmp, exp_tmp], [np.ones(shape)],
+                            [data_dir, exp_dir])
+
+
+def test_pow_fn():
+    shape = (3, 4)
+    exp = mx.symbol.Variable("exp")
+    y = mx.sym.pow(2, exp)
+    x = np.ones(shape) * 3
+    check_numeric_gradient(y, [x])
+    check_symbolic_forward(y, [x], [2 ** x])
+    check_symbolic_backward(y, [x], [np.ones(shape)], [np.log(2) * 2 ** x])
+
+
+def test_embedding():
+    in_dim = 10
+    out_dim = 4
+    batch = 24
+    data = mx.sym.Variable("data")
+    embed = mx.sym.Embedding(data=data, input_dim=in_dim, output_dim=out_dim,
+                             name="embed")
+    exe_test = embed.simple_bind(mx.cpu(), data=(batch,))
+    arg_map = dict(zip(embed.list_arguments(), exe_test.arg_arrays))
+    grad_map = dict(zip(embed.list_arguments(), exe_test.grad_arrays))
+    np_data = np.random.randint(low=0, high=in_dim, size=batch)
+    np_weight = np.random.uniform(-0.01, 0.01, arg_map["embed_weight"].shape)
+    np_onehot = np.zeros((batch, in_dim))
+    np_onehot[np.arange(batch), np_data] = 1.0
+    arg_map["data"][:] = np_data
+    arg_map["embed_weight"][:] = np_weight
+    exe_test.forward()
+    assert reldiff(exe_test.outputs[0].asnumpy(),
+                   np.dot(np_onehot, np_weight)) < 1e-6
+    np_grad = np.random.uniform(-1, 1, exe_test.outputs[0].shape)
+    grad = mx.nd.zeros(np_grad.shape)
+    grad[:] = np_grad
+    exe_test.backward([grad])
+    assert reldiff(grad_map["embed_weight"].asnumpy(),
+                   np.dot(np_onehot.T, np_grad)) < 1e-6
+
+
+def test_binary_op_duplicate_input():
+    data = mx.symbol.Variable("data")
+    shape = (3, 4)
+    data_tmp = np.full(shape, 5.0)
+    arr_data = mx.nd.array(data_tmp)
+    arr_grad = mx.nd.empty(shape)
+    arr_grad[:] = 3
+    out_grad = mx.nd.empty(shape)
+    out_grad[:] = 1
+    square = data * data
+    exe_square = square.bind(mx.cpu(), args=[arr_data], args_grad=[arr_grad])
+    exe_square.forward()
+    assert reldiff(exe_square.outputs[0].asnumpy(), data_tmp * data_tmp) < 1e-6
+    exe_square.backward(out_grad)
+    assert reldiff(arr_grad.asnumpy(), 2.0 * data_tmp) < 1e-6
+
+
+def test_sign():
+    data = mx.symbol.Variable("data")
+    shape = (3, 4)
+    data_tmp = np.full(shape, 5.0)
+    arr_data = mx.nd.array(data_tmp)
+    arr_grad = mx.nd.empty(shape)
+    arr_grad[:] = 3
+    test = mx.sym.sign(data)
+    exe_test = test.bind(mx.cpu(), args=[arr_data], args_grad=[arr_grad])
+    exe_test.forward()
+    assert reldiff(exe_test.outputs[0].asnumpy(), np.sign(data_tmp)) < 1e-6
+    out_grad = mx.nd.empty(shape)
+    out_grad[:] = 2
+    exe_test.backward(out_grad)
+    assert reldiff(arr_grad.asnumpy(), np.zeros(shape)) < 1e-6
+
+
+def test_round_ceil_floor():
+    data = mx.symbol.Variable("data")
+    shape = (3, 4)
+    data_tmp = np.full(shape, 5.543)
+    arr_data = mx.nd.array(data_tmp)
+    test = mx.sym.round(data) + mx.sym.ceil(data) + mx.sym.floor(data)
+    exe_test = test.bind(mx.cpu(), args=[arr_data])
+    exe_test.forward()
+    npout = np.round(data_tmp) + np.ceil(data_tmp) + np.floor(data_tmp)
+    assert reldiff(exe_test.outputs[0].asnumpy(), npout) < 1e-6
+
+
+def test_rsqrt_cos_sin():
+    data = mx.symbol.Variable("data")
+    shape = (3, 4)
+    data_tmp = np.full(shape, 5.0)
+    arr_data = mx.nd.array(data_tmp)
+    arr_grad = mx.nd.empty(shape)
+    arr_grad[:] = 3
+    test = mx.sym.rsqrt(data) + mx.sym.cos(data) + mx.sym.sin(data)
+    exe_test = test.bind(mx.cpu(), args=[arr_data], args_grad=[arr_grad])
+    exe_test.forward()
+    npout = 1 / np.sqrt(data_tmp) + np.cos(data_tmp) + np.sin(data_tmp)
+    assert reldiff(exe_test.outputs[0].asnumpy(), npout) < 1e-6
+    out_grad = mx.nd.empty(shape)
+    out_grad[:] = 2
+    npout_grad = out_grad.asnumpy()
+    npout_grad = npout_grad * -(1.0 / (2.0 * data_tmp * np.sqrt(data_tmp))) \
+        + npout_grad * -1 * np.sin(data_tmp) + npout_grad * np.cos(data_tmp)
+    exe_test.backward(out_grad)
+    assert reldiff(arr_grad.asnumpy(), npout_grad) < 1e-6
+
+
+def test_maximum_minimum():
+    data1 = mx.symbol.Variable("data")
+    data2 = mx.symbol.Variable("data")
+    shape = (3, 4)
+    data_tmp1 = np.full(shape, 2.0)
+    data_tmp2 = np.full(shape, 3.0)
+    arr_data1 = mx.nd.array(data_tmp1)
+    arr_data2 = mx.nd.array(data_tmp2)
+    arr_grad1 = mx.nd.empty(shape)
+    arr_grad2 = mx.nd.empty(shape)
+
+    test = mx.sym.maximum(data1, data2) + mx.sym.minimum(data1, data2)
+    exe_test = test.bind(mx.cpu(), args=[arr_data1, arr_data2],
+                         args_grad=[arr_grad1, arr_grad2])
+    exe_test.forward()
+    npout = np.maximum(data_tmp1, data_tmp2) + np.minimum(data_tmp1, data_tmp2)
+    assert reldiff(exe_test.outputs[0].asnumpy(), npout) < 1e-6
+    out_grad = mx.nd.empty(shape)
+    out_grad[:] = 2
+    exe_test.backward(out_grad)
+    npout_grad = np.full(shape, 2.0)
+    mask1 = (data_tmp1 > data_tmp2).astype("float")
+    mask2 = (data_tmp1 < data_tmp2).astype("float")
+    npout_grad1 = npout_grad * mask1 + npout_grad * mask2
+    npout_grad2 = (npout_grad - npout_grad * mask1) + \
+        (npout_grad - npout_grad * mask2)
+    assert reldiff(arr_grad1.asnumpy(), npout_grad1) < 1e-6
+    assert reldiff(arr_grad2.asnumpy(), npout_grad2) < 1e-6
+
+
+def test_maximum_minimum_scalar():
+    data1 = mx.symbol.Variable("data")
+    shape = (3, 4)
+    data_tmp1 = np.full(shape, 2.0)
+    arr_data1 = mx.nd.array(data_tmp1)
+    arr_grad1 = mx.nd.empty(shape)
+
+    test = mx.sym.maximum(data1, 3) + mx.sym.maximum(9, data1) + \
+        mx.sym.minimum(5, data1) + mx.sym.minimum(data1, 4)
+    exe_test = test.bind(mx.cpu(), args=[arr_data1], args_grad=[arr_grad1])
+    exe_test.forward()
+    npout = np.maximum(data_tmp1, 3) + np.maximum(9, data_tmp1) + \
+        np.minimum(5, data_tmp1) + np.minimum(data_tmp1, 4)
+    assert reldiff(exe_test.outputs[0].asnumpy(), npout) < 1e-6
+    out_grad = mx.nd.empty(shape)
+    out_grad[:] = 2
+    exe_test.backward(out_grad)
+    npout_grad = np.full(shape, 2.0)
+    mask1 = (data_tmp1 > 3).astype("float")
+    mask2 = (9 > data_tmp1).astype("float")
+    mask3 = (5 < data_tmp1).astype("float")
+    mask4 = (data_tmp1 < 4).astype("float")
+    npout_grad1 = npout_grad * mask1 + (npout_grad - npout_grad * mask2) + \
+        (npout_grad - npout_grad * mask3) + npout_grad * mask4
+    assert reldiff(arr_grad1.asnumpy(), npout_grad1) < 1e-6
+
+
+def test_abs():
+    data = mx.symbol.Variable("data")
+    shape = (3, 4)
+    data_tmp = np.full(shape, 5.0)
+    arr_data = mx.nd.array(data_tmp)
+    arr_grad = mx.nd.empty(shape)
+    arr_grad[:] = 3
+    test = mx.sym.abs(data)
+    exe_test = test.bind(mx.cpu(), args=[arr_data], args_grad=[arr_grad])
+    exe_test.forward()
+    assert reldiff(exe_test.outputs[0].asnumpy(), abs(data_tmp)) < 1e-6
+    out_grad = mx.nd.empty(shape)
+    out_grad[:] = 2
+    exe_test.backward(out_grad)
+    assert reldiff(arr_grad.asnumpy(),
+                   out_grad.asnumpy() * np.sign(data_tmp)) < 1e-6
+
+
+def check_deconvolution_forward_backward(input_shape, num_filter, kernel,
+                                         stride, pad):
+    assert input_shape[1] == num_filter
+    data = mx.sym.Variable(name="data")
+    conv = mx.sym.Convolution(
+        data=data, kernel=kernel, stride=stride, pad=pad,
+        num_filter=num_filter, no_bias="true", name="conv")
+    deconv = mx.sym.Deconvolution(
+        data=conv, kernel=kernel, stride=stride, pad=pad,
+        num_filter=num_filter, no_bias="true", name="deconv")
+
+    arg_names = deconv.list_arguments()
+    arg_shapes, out_shapes, _ = deconv.infer_shape(data=input_shape)
+    input_data = mx.random.uniform(-5, 5, input_shape)
+    out_grad = input_data
+    args = {"data": input_data}
+    args["conv_weight"] = args["deconv_weight"] = mx.random.normal(
+        0, 1, (num_filter, input_shape[1]) + kernel)
+    args_grad = [mx.nd.empty(s) for s in arg_shapes]
+
+    exe = deconv.bind(mx.cpu(), args=args, args_grad=args_grad)
+    exe.forward()
+    out = exe.outputs[0].asnumpy()
+    exe.backward(out_grad)
+    assert reldiff(out, args_grad[0].asnumpy()) < 1e-5
+
+
+def check_deconvolution_gradient(input_shape, num_filter, pad):
+    stride = (1, 1)
+    kernel = (2 * pad[0] + 1, 2 * pad[1] + 1)
+    data_conv = mx.sym.Variable(name="data_conv")
+    conv = mx.sym.Convolution(
+        data=data_conv, kernel=kernel, stride=stride, pad=pad,
+        num_filter=num_filter, no_bias="true", name="conv")
+    data_deconv = mx.sym.Variable(name="data_deconv")
+    deconv = mx.sym.Deconvolution(
+        data=data_deconv, kernel=kernel, stride=stride, pad=pad,
+        num_filter=num_filter, no_bias="true", name="deconv")
+
+    conv_data = mx.random.uniform(-5, 5, input_shape)
+    conv_args = {"data_conv": conv_data,
+                 "conv_weight": mx.random.normal(
+                     0, 1, (num_filter, input_shape[1]) + kernel)}
+    conv_args_grad = [mx.nd.zeros(conv_data.shape),
+                      mx.nd.zeros((num_filter, input_shape[1]) + kernel)]
+    exe_conv = conv.bind(mx.cpu(), args=conv_args, args_grad=conv_args_grad)
+    exe_conv.forward()
+    conv_out_grad = mx.random.normal(0, 2, exe_conv.outputs[0].shape)
+    exe_conv.backward(conv_out_grad)
+
+    deconv_data = conv_out_grad
+    deconv_args = {"data_deconv": deconv_data,
+                   "deconv_weight": conv_args["conv_weight"]}
+    deconv_args_grad = [mx.nd.zeros(deconv_data.shape),
+                        mx.nd.zeros((num_filter, input_shape[1]) + kernel)]
+    exe_deconv = deconv.bind(mx.cpu(), args=deconv_args,
+                             args_grad=deconv_args_grad)
+    exe_deconv.forward()
+    deconv_out_grad = conv_data[:]
+    exe_deconv.backward(deconv_out_grad)
+    assert reldiff(conv_args_grad[1].asnumpy(),
+                   deconv_args_grad[1].asnumpy()) < 1e-5
+
+
+def test_deconvolution():
+    check_deconvolution_forward_backward(
+        input_shape=(1, 1, 5, 5), num_filter=1, kernel=(3, 3),
+        stride=(1, 1), pad=(1, 1))
+    check_deconvolution_forward_backward(
+        input_shape=(8, 3, 28, 28), num_filter=3, kernel=(3, 3),
+        stride=(1, 1), pad=(1, 1))
+    check_deconvolution_gradient(
+        input_shape=(1, 3, 5, 5), num_filter=3, pad=(1, 1))
+
+
+def check_nearest_upsampling_with_shape(shapes, scale, root_scale):
+    arr = {"arg_%d" % i: mx.random.uniform(-10.0, 10.0, shape)
+           for i, shape in enumerate(shapes)}
+    arr_grad = {"arg_%d" % i: mx.nd.zeros(shape)
+                for i, shape in enumerate(shapes)}
+    up = mx.sym.UpSampling(
+        *[mx.sym.Variable("arg_%d" % i) for i in range(len(shapes))],
+        sample_type="nearest", scale=root_scale)
+    exe = up.bind(mx.cpu(), args=arr, args_grad=arr_grad)
+    exe.forward(is_train=True)
+    exe.backward(exe.outputs)
+    for k in range(len(shapes)):
+        name = "arg_%d" % k
+        assert_allclose(arr[name].asnumpy() * root_scale ** 2 *
+                        scale ** (2 * k),
+                        arr_grad[name].asnumpy(), rtol=1e-4)
+
+
+def test_nearest_upsampling():
+    for root_scale in [1, 2]:
+        for scale in [1, 2]:
+            for num_shape in [1, 2]:
+                base = 2
+                shapes = [(1, 3, base * root_scale * scale ** (num_shape - 1 - i),
+                           base * root_scale * scale ** (num_shape - 1 - i))
+                          for i in range(num_shape)]
+                check_nearest_upsampling_with_shape(shapes, scale, root_scale)
+
+
+def test_batchnorm_training():
+    for shape in [(2, 3), (2, 3, 2, 2)]:
+        data_tmp = np.random.normal(size=shape)
+        s = (shape[1],)
+        gamma = np.ones(s)
+        beta = np.ones(s)
+        gamma[1] = 3
+        beta[0] = 3
+        rolling_mean = np.random.uniform(size=s)
+        rolling_std = np.random.uniform(size=s)
+
+        data = mx.symbol.Variable("data")
+        test = mx.symbol.BatchNorm(data, fix_gamma=False)
+        check_numeric_gradient(test, [data_tmp, gamma, beta],
+                               [rolling_mean, rolling_std],
+                               numeric_eps=1e-3, check_eps=5e-2)
+
+        gamma = np.ones(s)
+        test = mx.symbol.BatchNorm(data, fix_gamma=True)
+        check_numeric_gradient(test, [data_tmp, gamma, beta],
+                               [rolling_mean, rolling_std],
+                               numeric_eps=1e-3, check_eps=5e-2)
+
+
+def test_convolution_grouping():
+    num_filter = 4
+    num_group = 2
+    kernel = (3, 3)
+    shape = (1, 4, 9, 9)
+
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    b = mx.sym.Variable("b")
+    y1 = mx.sym.Convolution(data=x, weight=w, bias=b, num_filter=num_filter,
+                            num_group=num_group, kernel=kernel)
+    xslice = mx.sym.SliceChannel(data=x, num_outputs=num_group, axis=1)
+    wslice = mx.sym.SliceChannel(data=w, num_outputs=num_group, axis=0)
+    bslice = mx.sym.SliceChannel(data=b, num_outputs=num_group, axis=0)
+    y2 = mx.sym.Concat(*[
+        mx.sym.Convolution(data=xslice[i], weight=wslice[i], bias=bslice[i],
+                           num_filter=num_filter // num_group, kernel=kernel)
+        for i in range(num_group)])
+
+    exe1 = y1.simple_bind(mx.cpu(), x=shape)
+    exe2 = y2.simple_bind(
+        mx.cpu(), x=shape,
+        w=(num_filter, shape[1] // num_group, kernel[0], kernel[1]),
+        b=(num_filter,))
+    for arr1, arr2 in zip(exe1.arg_arrays, exe2.arg_arrays):
+        arr1[:] = np.random.normal(size=arr1.shape)
+        arr2[:] = arr1
+    exe1.forward(is_train=True)
+    exe1.backward(exe1.outputs[0])
+    exe2.forward(is_train=True)
+    exe2.backward(exe2.outputs[0])
+    for arr1, arr2 in zip(exe1.outputs + exe1.grad_arrays,
+                          exe2.outputs + exe2.grad_arrays):
+        np.testing.assert_allclose(arr1.asnumpy(), arr2.asnumpy(), rtol=1e-3)
+
+
+def test_convolution_vs_numpy():
+    """CPU-reference conv check (direct numpy correlation)."""
+    np.random.seed(3)
+    x = np.random.randn(2, 3, 7, 7).astype(np.float32)
+    w = np.random.randn(4, 3, 3, 3).astype(np.float32)
+    b = np.random.randn(4).astype(np.float32)
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=4,
+                              stride=(2, 2), pad=(1, 1), name="c")
+    exe = conv.bind(mx.cpu(), args=[mx.nd.array(x), mx.nd.array(w),
+                                    mx.nd.array(b)])
+    exe.forward()
+    out = exe.outputs[0].asnumpy()
+    # numpy reference
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    expect = np.zeros_like(out)
+    for n in range(2):
+        for f in range(4):
+            for i in range(out.shape[2]):
+                for j in range(out.shape[3]):
+                    patch = xp[n, :, i * 2:i * 2 + 3, j * 2:j * 2 + 3]
+                    expect[n, f, i, j] = np.sum(patch * w[f]) + b[f]
+    assert reldiff(out, expect) < 1e-5
+
+
+def test_pooling_vs_numpy():
+    np.random.seed(4)
+    x = np.random.randn(2, 3, 6, 6).astype(np.float32)
+    for pool_type in ["max", "avg", "sum"]:
+        data = mx.sym.Variable("data")
+        pool = mx.sym.Pooling(data=data, kernel=(2, 2), stride=(2, 2),
+                              pool_type=pool_type)
+        exe = pool.bind(mx.cpu(), args=[mx.nd.array(x)])
+        exe.forward()
+        out = exe.outputs[0].asnumpy()
+        expect = np.zeros_like(out)
+        for i in range(3):
+            for j in range(3):
+                win = x[:, :, i * 2:i * 2 + 2, j * 2:j * 2 + 2]
+                if pool_type == "max":
+                    expect[:, :, i, j] = win.max(axis=(2, 3))
+                elif pool_type == "avg":
+                    expect[:, :, i, j] = win.mean(axis=(2, 3))
+                else:
+                    expect[:, :, i, j] = win.sum(axis=(2, 3))
+        assert reldiff(out, expect) < 1e-5
+
+
+def test_fullyconnected_numeric_grad():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    x = np.random.uniform(-1, 1, (2, 4))
+    w = np.random.uniform(-1, 1, (3, 4))
+    b = np.random.uniform(-1, 1, (3,))
+    check_numeric_gradient(fc, [x, w, b])
+
+
+def test_activation_lrn_numeric():
+    data = mx.sym.Variable("data")
+    x = np.random.uniform(0.5, 1.5, (2, 4, 3, 3))
+    for act in ["relu", "sigmoid", "tanh", "softrelu"]:
+        sym = mx.sym.Activation(data=data, act_type=act)
+        check_numeric_gradient(sym, [x], numeric_eps=1e-3, check_eps=3e-2)
+    lrn = mx.sym.LRN(data=data, nsize=3)
+    check_numeric_gradient(lrn, [x], numeric_eps=1e-3, check_eps=3e-2)
+
+
+def test_leaky_relu_variants():
+    data = mx.sym.Variable("data")
+    x = np.random.uniform(-2, 2, (3, 4)).astype(np.float32)
+    leaky = mx.sym.LeakyReLU(data=data, act_type="leaky", slope=0.1)
+    check_symbolic_forward(leaky, [x], [np.where(x > 0, x, 0.1 * x)])
+    elu = mx.sym.LeakyReLU(data=data, act_type="elu", slope=0.3)
+    check_symbolic_forward(elu, [x], [np.where(x > 0, x, 0.3 * (np.exp(x) - 1))])
+    # prelu has a learnable gamma
+    prelu = mx.sym.LeakyReLU(data=data, act_type="prelu", name="pr")
+    xs = np.random.uniform(-2, 2, (3, 4)).astype(np.float32)
+    gamma = np.full((4,), 0.25, dtype=np.float32)
+    check_symbolic_forward(prelu, [xs, gamma],
+                           [np.where(xs > 0, xs, 0.25 * xs)])
+
+
+def test_blockgrad_stops_gradient():
+    data = mx.sym.Variable("data")
+    blocked = mx.sym.BlockGrad(data=data) * mx.sym.Variable("w")
+    x = np.ones((2, 2))
+    wv = np.full((2, 2), 3.0)
+    check_symbolic_backward(blocked, [x, wv], [np.ones((2, 2))],
+                            [np.zeros((2, 2)), np.ones((2, 2))])
+
+
+def test_dropout():
+    data = mx.sym.Variable("data")
+    drop = mx.sym.Dropout(data=data, p=0.5, name="drop")
+    x = np.ones((200, 200), dtype=np.float32)
+    exe = drop.bind(mx.cpu(), args=[mx.nd.array(x)],
+                    args_grad=[mx.nd.zeros(x.shape)])
+    # inference: identity
+    exe.forward(is_train=False)
+    assert reldiff(exe.outputs[0].asnumpy(), x) < 1e-6
+    # train: ~half dropped, kept scaled by 2
+    exe.forward(is_train=True)
+    out = exe.outputs[0].asnumpy()
+    frac = (out == 0).mean()
+    assert 0.4 < frac < 0.6
+    kept = out[out != 0]
+    assert np.allclose(kept, 2.0)
+    # backward mask matches forward mask
+    exe.backward([mx.nd.array(np.ones_like(x))])
+    g = exe.grad_arrays[0].asnumpy()
+    assert same((g != 0), (out != 0))
+
+
+def test_reshape_flatten():
+    data = mx.sym.Variable("data")
+    rs = mx.sym.Reshape(data=data, target_shape=(6, 2))
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    # note: target_shape excludes batch dim in the 2015 API
+    check_symbolic_forward(rs[0] if isinstance(rs, list) else rs,
+                           [x.reshape(2, 12)], [x.reshape(2, 6, 2)])
+    fl = mx.sym.Flatten(data=data)
+    check_symbolic_forward(fl, [x], [x.reshape(2, 12)])

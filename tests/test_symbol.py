@@ -1,0 +1,77 @@
+"""Port of /root/reference/tests/python/unittest/test_symbol.py."""
+import copy
+import os
+import pickle as pkl
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import common_models as models
+
+
+def test_symbol_basic():
+    for m in [models.mlp2()]:
+        m.list_arguments()
+        m.list_outputs()
+
+
+def test_symbol_compose():
+    data = mx.symbol.Variable("data")
+    net1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net1 = mx.symbol.FullyConnected(data=net1, name="fc2", num_hidden=100)
+    assert net1.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                     "fc2_weight", "fc2_bias"]
+
+    net2 = mx.symbol.FullyConnected(name="fc3", num_hidden=10)
+    net2 = mx.symbol.Activation(data=net2, act_type="relu")
+    net2 = mx.symbol.FullyConnected(data=net2, name="fc4", num_hidden=20)
+
+    composed = net2(fc3_data=net1, name="composed")
+    multi_out = mx.symbol.Group([composed, net1])
+    assert len(multi_out.list_outputs()) == 2
+
+
+def test_symbol_copy():
+    data = mx.symbol.Variable("data")
+    data_2 = copy.deepcopy(data)
+    data_3 = copy.copy(data)
+    assert data.tojson() == data_2.tojson()
+    assert data.tojson() == data_3.tojson()
+
+
+def test_symbol_internal():
+    data = mx.symbol.Variable("data")
+    oldfc = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net1 = mx.symbol.FullyConnected(data=oldfc, name="fc2", num_hidden=100)
+    internal = net1.get_internals()
+    fc1 = internal["fc1_output"]
+    assert fc1.list_arguments() == oldfc.list_arguments()
+
+
+def test_symbol_pickle():
+    mlist = [models.mlp2(), models.conv()]
+    data = pkl.dumps(mlist)
+    mlist2 = pkl.loads(data)
+    for x, y in zip(mlist, mlist2):
+        assert x.tojson() == y.tojson()
+
+
+def test_symbol_saveload(tmp_path):
+    sym = models.mlp2()
+    fname = str(tmp_path / "tmp_sym.json")
+    sym.save(fname)
+    data2 = mx.symbol.load(fname)
+    assert sym.tojson() == data2.tojson()
+
+
+def test_symbol_infer_type():
+    data = mx.symbol.Variable("data")
+    f32data = mx.symbol.Cast(data=data, dtype="float32")
+    fc1 = mx.symbol.FullyConnected(data=f32data, name="fc1", num_hidden=128)
+    mlp = mx.symbol.SoftmaxOutput(data=fc1, name="softmax")
+
+    arg, out, aux = mlp.infer_type(data=np.float16)
+    assert arg == [np.float16, np.float32, np.float32, np.float32]
+    assert out == [np.float32]
+    assert aux == []
