@@ -38,5 +38,14 @@ from . import metric  # noqa: E402
 from . import initializer  # noqa: E402
 from .initializer import Uniform, Normal, Orthogonal, Xavier, MSRAPrelu  # noqa: E402
 from . import lr_scheduler  # noqa: E402
+from . import io  # noqa: E402
+from . import kvstore  # noqa: E402
+from . import kvstore as kv  # noqa: E402
+from . import executor_manager  # noqa: E402
+from . import callback  # noqa: E402
+from . import monitor  # noqa: E402
+from .monitor import Monitor  # noqa: E402
+from . import model  # noqa: E402
+from .model import FeedForward  # noqa: E402
 
 __version__ = "0.1.0"

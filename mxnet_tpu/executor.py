@@ -68,6 +68,7 @@ class Executor:
         self._ctx = ctx
         self._group2ctx = group2ctx or {}
         self._monitor_callback = None
+        self._monitor_should_run = None
 
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
@@ -204,7 +205,8 @@ class Executor:
         self._step += 1
         rng = jax.random.fold_in(self._base_key, self._step)
         self._last_inputs = (arg_vals, aux_vals, rng)
-        if self._monitor_callback is not None:
+        if self._monitor_callback is not None and (
+                self._monitor_should_run is None or self._monitor_should_run()):
             self._run_monitor(arg_vals, aux_vals, is_train, rng)
         if is_train:
             if self._jit_train is None:
@@ -330,8 +332,12 @@ class Executor:
     # ------------------------------------------------------------------
     # debugging / monitor (reference: MXExecutorSetMonitorCallback +
     # monitor.py; fires the callback with every node output)
-    def set_monitor_callback(self, callback):
+    def set_monitor_callback(self, callback, should_run=None):
+        """Install a per-node-output callback. ``should_run`` (optional
+        0-arg predicate) gates the expensive eager debug evaluation so a
+        Monitor with interval N only pays for sampled batches."""
         self._monitor_callback = callback
+        self._monitor_should_run = should_run
 
     def _run_monitor(self, arg_vals, aux_vals, is_train, rng):
         _, _, env = self._eval_graph(list(arg_vals), list(aux_vals),

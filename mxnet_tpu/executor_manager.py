@@ -1,0 +1,237 @@
+"""Data-parallel executor management.
+
+Parity: ``/root/reference/python/mxnet/executor_manager.py`` —
+``_split_input_slice`` work-load slicing, parameter name checking,
+``DataParallelExecutorGroup`` (one executor per device, batch sliced
+across them) and ``DataParallelExecutorManager`` (+ bucketing support).
+
+TPU-first note: on a TPU pod the fused pjit trainer
+(``mxnet_tpu/parallel``) supersedes this host-side slicing — XLA shards
+the batch over the mesh and inserts psum. This module keeps the reference
+execution model for API parity and for heterogeneous `ctx` lists on one
+process; slices run as separate XLA dispatches that the runtime pipelines.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .context import cpu
+
+__all__ = ["DataParallelExecutorManager", "DataParallelExecutorGroup",
+           "_split_input_slice", "_check_arguments", "_load_data",
+           "_load_label"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Split batch into per-device slices proportional to work load
+    (reference executor_manager.py:11-43)."""
+    total_work_load = sum(work_load_list)
+    batch_num_list = [round(work_load * batch_size / total_work_load)
+                      for work_load in work_load_list]
+    batch_num_sum = sum(batch_num_list)
+    if batch_num_sum < batch_size:
+        batch_num_list[-1] += batch_size - batch_num_sum
+    slices = []
+    end = 0
+    for batch_num in batch_num_list:
+        begin = int(min(end, batch_size))
+        end = int(min(begin + batch_num, batch_size))
+        if begin >= end:
+            raise ValueError("Too many slices such that some splits are empty")
+        slices.append(slice(begin, end))
+    return slices
+
+
+def _check_arguments(symbol):
+    """Reject duplicated argument/aux names (reference :46-73)."""
+    arg_set = set()
+    for name in symbol.list_arguments():
+        if name in arg_set:
+            raise ValueError("Find duplicated argument name \"%s\"" % name)
+        arg_set.add(name)
+    aux_set = set()
+    for name in symbol.list_auxiliary_states():
+        if name in aux_set:
+            raise ValueError("Find duplicated auxiliary param name \"%s\""
+                             % name)
+        aux_set.add(name)
+
+
+def _load_general(data, targets):
+    """Load a batch's arrays into per-device target slices (:76-86)."""
+    for d_src, d_targets in zip(data, targets):
+        for slice_idx, d_dst in d_targets:
+            if d_src.shape == d_dst.shape:
+                d_src.copyto(d_dst)
+            else:
+                d_src[slice_idx].copyto(d_dst)
+
+
+def _load_data(batch, targets):
+    _load_general(batch.data, targets)
+
+
+def _load_label(batch, targets):
+    _load_general(batch.label, targets)
+
+
+class DataParallelExecutorGroup:
+    """One executor per device over sliced batches (reference :146-228)."""
+
+    def __init__(self, sym, arg_names, param_names, ctx, slices, train_data,
+                 shared_group=None):
+        _check_arguments(sym)
+        self.arg_names = arg_names
+        self.param_names = param_names
+        data_shapes = dict(train_data.provide_data + train_data.provide_label)
+
+        self.train_execs = []
+        for i, ctxi in enumerate(ctx):
+            shapes = {}
+            for k, v in data_shapes.items():
+                shapes[k] = (slices[i].stop - slices[i].start,) + tuple(v[1:])
+            arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+            if arg_shapes is None:
+                raise MXNetError("cannot infer shapes for executor group")
+            grad_req = {name: ("write" if name in param_names else "null")
+                        for name in arg_names}
+            if shared_group is None:
+                exec_args = [nd.zeros(s, ctxi) for s in arg_shapes]
+            else:
+                base = shared_group.train_execs[i]
+                exec_args = []
+                for name, s in zip(arg_names, arg_shapes):
+                    if name in param_names:
+                        exec_args.append(base.arg_dict[name])
+                    else:
+                        exec_args.append(nd.zeros(s, ctxi))
+            grads = {name: nd.zeros(s, ctxi)
+                     for name, s in zip(arg_names, arg_shapes)
+                     if name in param_names}
+            train_exec = sym.bind(ctxi, exec_args, grads, grad_req)
+            self.train_execs.append(train_exec)
+
+        self.data_names = [x[0] for x in train_data.provide_data]
+        self.label_names = [x[0] for x in train_data.provide_label]
+        self.data_arrays = [
+            [(slices[i], e.arg_dict[name])
+             for i, e in enumerate(self.train_execs)]
+            for name in self.data_names]
+        self.label_arrays = [
+            [(slices[i], e.arg_dict[name])
+             for i, e in enumerate(self.train_execs)]
+            for name in self.label_names]
+        self.param_idx = [i for i, name in enumerate(arg_names)
+                          if name in param_names]
+        self.param_names = [arg_names[i] for i in self.param_idx]
+        self.param_arrays = [[e.arg_arrays[i] for e in self.train_execs]
+                             for i in self.param_idx]
+        self.grad_arrays = [[e.grad_arrays[i] for e in self.train_execs]
+                            for i in self.param_idx]
+        self.aux_arrays = [[e.aux_arrays[i] for e in self.train_execs]
+                           for i in range(len(sym.list_auxiliary_states()))]
+        self.slices = slices
+
+    def load_data_batch(self, data_batch):
+        _load_data(data_batch, self.data_arrays)
+        _load_label(data_batch, self.label_arrays)
+
+    def forward(self, is_train=False):
+        for texec in self.train_execs:
+            texec.forward(is_train=is_train)
+
+    def backward(self):
+        for texec in self.train_execs:
+            texec.backward()
+
+    def update_metric(self, metric, labels):
+        for texec, islice in zip(self.train_execs, self.slices):
+            labels_slice = [label[islice] for label in labels]
+            metric.update(labels_slice, texec.outputs)
+
+
+class DataParallelExecutorManager:
+    """Manage executor groups incl. bucketing (reference :230-372)."""
+
+    def __init__(self, symbol, ctx, train_data, arg_names, param_names,
+                 aux_names, work_load_list=None, logger=None,
+                 sym_gen=None):
+        if logger is None:
+            logger = logging
+        self.arg_names = arg_names
+        self.param_names = param_names
+        self.aux_names = aux_names
+        self.ctx = ctx
+        batch_size = train_data.batch_size
+        if work_load_list is None:
+            work_load_list = [1] * len(ctx)
+        assert isinstance(work_load_list, list) and \
+            len(work_load_list) == len(ctx)
+        self.slices = _split_input_slice(batch_size, work_load_list)
+        self.symbol = symbol
+        self.sym_gen = sym_gen
+        self.curr_execgrp = None
+        self.execgrp = DataParallelExecutorGroup(
+            symbol, arg_names, param_names, ctx, self.slices, train_data)
+        if self.sym_gen is not None:
+            self.execgrp_bucket = {train_data.default_bucket_key: self.execgrp}
+
+    def install_monitor(self, monitor):
+        if self.sym_gen is not None:
+            raise NotImplementedError(
+                "Monitoring is not implemented for bucketing")
+        for train_exec in self.execgrp.train_execs:
+            monitor.install(train_exec)
+
+    def set_params(self, arg_params, aux_params):
+        for texec in self.execgrp.train_execs:
+            texec.copy_params_from(arg_params, aux_params)
+
+    def copy_to(self, arg_params, aux_params):
+        """Copy (averaged over devices) params out (reference :300-310)."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            weight = sum(w.copyto(cpu()) for w in block) / len(block)
+            weight.copyto(arg_params[name])
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            weight = sum(w.copyto(cpu()) for w in block) / len(block)
+            weight.copyto(aux_params[name])
+
+    @property
+    def param_arrays(self):
+        return self.curr_execgrp.param_arrays
+
+    @property
+    def grad_arrays(self):
+        return self.curr_execgrp.grad_arrays
+
+    @property
+    def aux_arrays(self):
+        return self.curr_execgrp.aux_arrays
+
+    def load_data_batch(self, data_batch):
+        if self.sym_gen is not None:
+            key = data_batch.bucket_key
+            if key not in self.execgrp_bucket:
+                # create new bucket entry sharing params with the default
+                symbol = self.sym_gen(key)
+                execgrp = DataParallelExecutorGroup(
+                    symbol, self.arg_names, self.param_names, self.ctx,
+                    self.slices, data_batch, shared_group=self.execgrp)
+                self.execgrp_bucket[key] = execgrp
+            self.curr_execgrp = self.execgrp_bucket[key]
+        else:
+            self.curr_execgrp = self.execgrp
+        self.curr_execgrp.load_data_batch(data_batch)
+
+    def forward(self, is_train=False):
+        self.curr_execgrp.forward(is_train=is_train)
+
+    def backward(self):
+        self.curr_execgrp.backward()
+
+    def update_metric(self, metric, labels):
+        self.curr_execgrp.update_metric(metric, labels)

@@ -1,0 +1,188 @@
+"""KVStore: the data-parallel gradient aggregation API.
+
+Parity: ``/root/reference/python/mxnet/kvstore.py`` +
+``include/mxnet/kvstore.h`` (Init/Push/Pull with int or list keys,
+aggregation across device copies, pluggable updater, node-role predicates)
+and the C++ backends ``src/kvstore/kvstore_local.h`` (pinned-host reduce),
+``kvstore_device.h`` (GPU reduce) and ``kvstore_dist.h`` (ps-lite).
+
+TPU-first design
+----------------
+The reference moves gradients through hand-written reductions (OMP CPU
+loops, GPU ElementwiseSum P2P) and a ZMQ parameter server. On TPU the
+fast path is *in-program*: the fused data-parallel train step (see
+``mxnet_tpu/parallel``) shards the batch over a ``jax.sharding.Mesh`` and
+lets XLA insert ``psum`` over ICI — no KVStore object in the loop at all.
+
+This module keeps the KVStore *API* as a compatibility facade:
+
+* ``local``/``device`` (and the ``local_allreduce_*`` aliases): aggregation
+  of per-device NDArray copies inside one process. The reduce is a single
+  jnp tree-sum — XLA's fusion replaces kvstore_local.h's chunked OMP loops.
+* ``dist_sync``/``dist_async``: same semantics over jax.distributed
+  process groups. On a single process it degrades to local (the way the
+  reference's dist kvstore with one worker does); multi-host uses
+  ``jax.experimental.multihost_utils`` allreduce over DCN.
+* ``_set_updater``: weight update runs where the reference's "update on
+  kvstore" runs (here: on the aggregated value before broadcast).
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctype_key_value(key, vals):
+    """Normalize (key, values) to (list[int], list[list[NDArray]])."""
+    if isinstance(key, (int, np.integer)):
+        key = [int(key)]
+        vals = [vals]
+    else:
+        key = [int(k) for k in key]
+    norm = []
+    for v in vals:
+        if isinstance(v, NDArray):
+            norm.append([v])
+        else:
+            norm.append(list(v))
+    return key, norm
+
+
+class KVStore:
+    """In-process key→NDArray store with aggregation semantics."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._is_dist = kv_type.startswith("dist")
+        # NOTE: dist_async degrades to synchronous collectives here — the
+        # reference's async path exists because ps-lite servers can apply
+        # updates out of lockstep; with in-program DCN collectives there is
+        # no server to be async against.
+
+    # ------------------------------------------------------------------
+    def init(self, key, value):
+        """Initialize key(s) once (reference kvstore.py init)."""
+        key, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(key, vals):
+            if k in self._store:
+                raise MXNetError("key %d already initialized" % k)
+            v = vlist[0]
+            self._store[k] = v.copyto(v.context)
+
+    def push(self, key, value, priority=0):
+        """Push value(s); multiple device copies of one key are summed
+        (reference kvstore_local.h MergePushValue). With an updater set,
+        the aggregate is applied via updater(key, merged, stored) instead
+        of overwriting — matching reference local-update semantics."""
+        import jax
+        key, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(key, vals):
+            if k not in self._store:
+                raise MXNetError("key %d not initialized" % k)
+            # device copies live on different chips: gather to the store's
+            # device before reducing (reference kvstore_local.h copies each
+            # device grad into pinned host merge buffers)
+            dev = self._store[k].context.jax_device()
+            merged = jax.device_put(vlist[0]._val, dev)
+            for v in vlist[1:]:
+                merged = merged + jax.device_put(v._val, dev)
+            if self._is_dist and _num_processes() > 1:
+                merged = _allreduce_dcn(merged)
+            merged_nd = NDArray._from_jax(merged, self._store[k].context)
+            if self._updater is not None:
+                self._updater(k, merged_nd, self._store[k])
+            else:
+                self._store[k]._set(merged)
+
+    def pull(self, key, out=None, priority=0):
+        """Pull current value into out array(s) — broadcast to all device
+        copies (reference kvstore_local.h Pull → CopyFromTo fan-out)."""
+        assert out is not None
+        key, outs = _ctype_key_value(key, out)
+        for k, olist in zip(key, outs):
+            if k not in self._store:
+                raise MXNetError("key %d not initialized" % k)
+            import jax
+            src = self._store[k]
+            for o in olist:
+                o._set(jax.device_put(src._val, o.context.jax_device()))
+
+    # ------------------------------------------------------------------
+    def _set_updater(self, updater):
+        """Install updater(key, recv, local) (reference _set_updater)."""
+        self._updater = updater
+
+    set_updater = _set_updater
+
+    def set_optimizer(self, optimizer):
+        """Use an optimizer as the updater. In dist mode the reference
+        pickles the optimizer to server processes (kvstore.py →
+        kvstore_server.py:36-40) — mirrored here to keep the same
+        serializability contract; local mode uses the object directly like
+        the reference's local path."""
+        if self._is_dist:
+            optimizer = pickle.loads(pickle.dumps(optimizer))
+        self._set_updater(opt.get_updater(optimizer))
+
+    # --- node roles (reference kvstore.h:154-178; DMLC_ROLE env) --------
+    @property
+    def rank(self):
+        return _process_index() if self._is_dist else 0
+
+    @property
+    def num_workers(self):
+        return _num_processes() if self._is_dist else 1
+
+    def barrier(self):
+        """Global barrier (reference Postoffice::Barrier)."""
+        if self._is_dist and _num_processes() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    def send_command_to_servers(self, head, body):
+        """No-op in-process (reference SendCommandToServers RPC)."""
+
+    def __del__(self):
+        pass
+
+
+def _num_processes():
+    import jax
+    return jax.process_count()
+
+
+def _process_index():
+    import jax
+    return jax.process_index()
+
+
+def _allreduce_dcn(val):
+    """Cross-process sum over DCN (replaces ps-lite ZPush/ZPull)."""
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(val).sum(axis=0)
+
+
+def create(name="local"):
+    """Create a KVStore (reference kvstore.py create / kvstore.cc:17-49).
+
+    local / local_update_cpu / local_allreduce_cpu / device /
+    local_allreduce_device → in-process aggregation;
+    dist / dist_sync / dist_async → multi-process collectives.
+    """
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    known = ("local", "local_update_cpu", "local_allreduce_cpu", "device",
+             "local_allreduce_device", "dist", "dist_sync", "dist_async")
+    if name not in known:
+        raise MXNetError("unknown KVStore type %s" % name)
+    return KVStore(name)

@@ -6,7 +6,7 @@ decorator helper; ``create`` by-name factory.
 """
 from __future__ import annotations
 
-import numpy as np
+import numpy
 
 from .base import MXNetError
 from .ndarray import NDArray
@@ -16,7 +16,7 @@ __all__ = ["EvalMetric", "Accuracy", "F1", "MAE", "MSE", "RMSE",
 
 
 def _as_numpy(x):
-    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+    return x.asnumpy() if isinstance(x, NDArray) else numpy.asarray(x)
 
 
 class EvalMetric:
@@ -50,8 +50,8 @@ class Accuracy(EvalMetric):
             raise MXNetError("labels and preds length mismatch")
         for label, pred in zip(labels, preds):
             pred = _as_numpy(pred)
-            label = _as_numpy(label).astype(np.int32)
-            pred_label = np.argmax(pred, axis=1)
+            label = _as_numpy(label).astype(numpy.int32)
+            pred_label = numpy.argmax(pred, axis=1)
             self.sum_metric += int((pred_label.flat == label.flat).sum())
             self.num_inst += len(pred_label.flat)
 
@@ -65,14 +65,14 @@ class F1(EvalMetric):
     def update(self, labels, preds):
         for label, pred in zip(labels, preds):
             pred = _as_numpy(pred)
-            label = _as_numpy(label).astype(np.int32)
-            pred_label = np.argmax(pred, axis=1)
-            if len(np.unique(label)) > 2:
+            label = _as_numpy(label).astype(numpy.int32)
+            pred_label = numpy.argmax(pred, axis=1)
+            if len(numpy.unique(label)) > 2:
                 raise MXNetError("F1 currently only supports binary"
                                  " classification.")
-            tp = np.sum((pred_label == 1) & (label == 1))
-            fp = np.sum((pred_label == 1) & (label == 0))
-            fn = np.sum((pred_label == 0) & (label == 1))
+            tp = numpy.sum((pred_label == 1) & (label == 1))
+            fp = numpy.sum((pred_label == 1) & (label == 0))
+            fn = numpy.sum((pred_label == 0) & (label == 1))
             precision = tp / (tp + fp) if tp + fp > 0 else 0.0
             recall = tp / (tp + fn) if tp + fn > 0 else 0.0
             if precision + recall > 0:
@@ -90,7 +90,7 @@ class MAE(EvalMetric):
             pred = _as_numpy(pred)
             if len(label.shape) == 1:
                 label = label.reshape(label.shape[0], 1)
-            self.sum_metric += np.abs(label - pred).mean()
+            self.sum_metric += numpy.abs(label - pred).mean()
             self.num_inst += 1
 
 
@@ -118,7 +118,7 @@ class RMSE(EvalMetric):
             pred = _as_numpy(pred)
             if len(label.shape) == 1:
                 label = label.reshape(label.shape[0], 1)
-            self.sum_metric += np.sqrt(((label - pred) ** 2.0).mean())
+            self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
             self.num_inst += 1
 
 
@@ -131,8 +131,8 @@ class CrossEntropy(EvalMetric):
             label = _as_numpy(label).ravel()
             pred = _as_numpy(pred)
             assert label.shape[0] == pred.shape[0]
-            prob = pred[np.arange(label.shape[0]), label.astype(np.int64)]
-            self.sum_metric += (-np.log(np.maximum(prob, 1e-30))).sum()
+            prob = pred[numpy.arange(label.shape[0]), label.astype(numpy.int64)]
+            self.sum_metric += (-numpy.log(numpy.maximum(prob, 1e-30))).sum()
             self.num_inst += label.shape[0]
 
 

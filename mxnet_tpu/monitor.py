@@ -1,0 +1,88 @@
+"""Debugging monitor.
+
+Parity: ``/root/reference/python/mxnet/monitor.py`` — install a callback on
+executors firing per-node output statistics every `interval` batches
+(mechanism: ``Executor::SetMonitorCallback``, symbolic.h:362-369 →
+graph_executor.cc:803-817). Here the executor's monitor path evaluates the
+graph node-by-node (the NaiveEngine-style debug path) so every internal
+output can be observed.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Collect per-node output stats during training."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return nd.norm(x) / (x.size ** 0.5)
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def stat_helper(self, name, arr):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def install(self, exe):
+        """Install on an executor (reference monitor.py install:53). The
+        should_run gate means only batches inside a tic()/toc() window pay
+        for the eager per-node evaluation."""
+        exe.set_monitor_callback(self.stat_helper,
+                                 should_run=lambda: self.activated)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch if interval elapsed (:65)."""
+        if self.step % self.interval == 0:
+            for exe in self.exes:
+                for array in exe.arg_arrays:
+                    array.wait_to_read()
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting, return stats (:77-112)."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for array in exe.arg_arrays:
+                array.wait_to_read()
+        for exe in self.exes:
+            for name, array in zip(exe._arg_names, exe.arg_arrays):
+                self.stat_helper(name, array)
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ",".join("%f" % v.asnumpy().ravel()[0] for v in v_list)
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Print stats (reference toc_print)."""
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

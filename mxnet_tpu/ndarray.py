@@ -140,6 +140,13 @@ class NDArray:
         if value.shape != self._shape:
             value = jnp.broadcast_to(value, self._shape)
         value = value.astype(self.dtype)
+        # keep the chunk pinned to its device (multi-chip copies route
+        # through here like the reference's CopyFromTo cross-dev kernels)
+        try:
+            if value.device != self._chunk.buf.device:
+                value = jax.device_put(value, self._chunk.buf.device)
+        except AttributeError:
+            pass  # sharded arrays: placement handled by sharding
         if self._is_whole:
             self._chunk.buf = value  # keep natural shape; readers adapt
         else:

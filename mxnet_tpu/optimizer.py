@@ -46,7 +46,9 @@ class Optimizer:
             rescale_grad=rescale_grad, **kwargs)
 
     def __init__(self, rescale_grad=1.0, arg_names=None, wd=0.0,
-                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None):
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None):
+        self.sym = sym  # used by ccSGD in the reference; kept for parity
         self.rescale_grad = float(rescale_grad)
         self.lr = float(learning_rate)
         self.lr_scheduler = lr_scheduler
