@@ -1,0 +1,99 @@
+"""ResNets (He et al. 2015).
+
+* :func:`get_resnet_cifar` — the 6n+2 CIFAR net (reference
+  ``symbol_resnet-28-small.py``: conv3x3-16 stem, three stages of n
+  residual units at 16/32/64 filters, global-avg-pool, fc).
+* :func:`get_resnet` — ImageNet ResNet-18/34/50/101/152. ResNet-50 is the
+  BASELINE.json north-star benchmark model, so this is the framework's
+  flagship: bench.py and __graft_entry__ build it through this function.
+
+TPU notes: all convs are NCHW symbols lowered to ``lax.conv_general_dilated``
+— XLA lays them out for the MXU and fuses the BN+ReLU chains into the conv
+epilogues, which is exactly the fusion the reference needed cuDNN for.
+"""
+from .. import symbol as sym
+
+
+def _conv_bn(data, num_filter, kernel, stride, pad, name, act=True,
+             eps=2e-5, momentum=0.9):
+    c = sym.Convolution(data, num_filter=num_filter, kernel=kernel,
+                        stride=stride, pad=pad, no_bias=True,
+                        name=name + "_conv")
+    b = sym.BatchNorm(c, eps=eps, momentum=momentum, fix_gamma=False,
+                      name=name + "_bn")
+    if act:
+        return sym.Activation(b, act_type="relu", name=name + "_relu")
+    return b
+
+
+def residual_unit(data, num_filter, stride, dim_match, name,
+                  bottleneck=True):
+    """Post-activation residual unit (v1). ``dim_match=False`` projects the
+    shortcut with a strided 1x1 conv+BN."""
+    if bottleneck:
+        mid = num_filter // 4
+        body = _conv_bn(data, mid, (1, 1), (1, 1), (0, 0), name + "_a")
+        body = _conv_bn(body, mid, (3, 3), stride, (1, 1), name + "_b")
+        body = _conv_bn(body, num_filter, (1, 1), (1, 1), (0, 0),
+                        name + "_c", act=False)
+    else:
+        body = _conv_bn(data, num_filter, (3, 3), stride, (1, 1),
+                        name + "_a")
+        body = _conv_bn(body, num_filter, (3, 3), (1, 1), (1, 1),
+                        name + "_b", act=False)
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = _conv_bn(data, num_filter, (1, 1), stride, (0, 0),
+                            name + "_sc", act=False)
+    return sym.Activation(body + shortcut, act_type="relu",
+                          name=name + "_out")
+
+
+_UNITS = {
+    18: ([2, 2, 2, 2], False),
+    34: ([3, 4, 6, 3], False),
+    50: ([3, 4, 6, 3], True),
+    101: ([3, 4, 23, 3], True),
+    152: ([3, 8, 36, 3], True),
+}
+
+
+def get_resnet(num_classes=1000, num_layers=50):
+    """ImageNet ResNet. Input is NCHW 3x224x224."""
+    units, bottleneck = _UNITS[num_layers]
+    filters = [256, 512, 1024, 2048] if bottleneck else [64, 128, 256, 512]
+    data = sym.Variable("data")
+    body = _conv_bn(data, 64, (7, 7), (2, 2), (3, 3), "stem")
+    body = sym.Pooling(body, pool_type="max", kernel=(3, 3), stride=(2, 2),
+                       name="stem_pool")
+    for si, (n, f) in enumerate(zip(units, filters), start=1):
+        for ui in range(n):
+            stride = (2, 2) if si > 1 and ui == 0 else (1, 1)
+            body = residual_unit(body, f, stride, ui > 0,
+                                 "stage%d_unit%d" % (si, ui + 1),
+                                 bottleneck)
+    pool = sym.Pooling(body, pool_type="avg", kernel=(1, 1), global_pool=True,
+                       name="global_pool")
+    flat = sym.Flatten(pool)
+    fc = sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def get_resnet_cifar(num_classes=10, n=3, image_hw=28):
+    """CIFAR 6n+2 ResNet (n=3 -> 20 layers); reference
+    symbol_resnet-28-small.py trains on 28x28 crops."""
+    data = sym.Variable("data")
+    body = _conv_bn(data, 16, (3, 3), (1, 1), (1, 1), "stem")
+    for si, f in enumerate([16, 32, 64], start=1):
+        for ui in range(n):
+            stride = (2, 2) if si > 1 and ui == 0 else (1, 1)
+            body = residual_unit(body, f, stride, not (ui == 0 and si > 1),
+                                 "stage%d_unit%d" % (si, ui + 1),
+                                 bottleneck=False)
+    final_hw = image_hw // 4
+    pool = sym.Pooling(body, pool_type="avg", kernel=(final_hw, final_hw),
+                       name="global_pool")
+    flat = sym.Flatten(pool)
+    fc = sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(fc, name="softmax")

@@ -330,7 +330,11 @@ class Pooling(OpSpec):
     params = {"kernel": Param("shape"),
               "pool_type": Param("str", "max"),
               "stride": Param("shape", (1, 1)),
-              "pad": Param("shape", (0, 0))}
+              "pad": Param("shape", (0, 0)),
+              # pool over the whole spatial extent regardless of kernel
+              # (later-MXNet extension; lets ImageNet heads stay
+              # shape-agnostic under ceil-mode stage arithmetic)
+              "global_pool": Param("bool", False)}
 
     @staticmethod
     def _osize(h, k, s, p):
@@ -344,6 +348,8 @@ class Pooling(OpSpec):
         d = in_shapes[0]
         if d is None:
             return [None], [None], []
+        if p["global_pool"]:
+            return [d], [(d[0], d[1], 1, 1)], []
         kh, kw = p["kernel"]
         if kh > d[2] + 2 * p["pad"][0] or kw > d[3] + 2 * p["pad"][1]:
             raise MXNetError("Pooling: kernel size exceeds input")
@@ -353,9 +359,13 @@ class Pooling(OpSpec):
 
     def forward(self, p, ins, aux, is_train, rng):
         x = ins[0]
-        kh, kw = p["kernel"]
-        sh, sw = p["stride"]
-        ph, pw = p["pad"]
+        if p["global_pool"]:
+            kh, kw = x.shape[2], x.shape[3]
+            sh, sw, ph, pw = 1, 1, 0, 0
+        else:
+            kh, kw = p["kernel"]
+            sh, sw = p["stride"]
+            ph, pw = p["pad"]
         oh = self._osize(x.shape[2], kh, sh, ph)
         ow = self._osize(x.shape[3], kw, sw, pw)
         # right/bottom padding extended so ceil-mode windows fit

@@ -1,0 +1,129 @@
+"""Model-zoo tests: every family builds, infers shapes, and runs a
+forward/backward pass (reference analogue: tests/python/common/models.py
+fixtures + the symbol construction exercised all over the unittest suite)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def _forward(net, data_shape, label_shape=None, check_backward=True):
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=data_shape)
+    assert arg_shapes is not None
+    exe = net.simple_bind(mx.cpu(), grad_req="write", data=data_shape)
+    for name, arr in exe.arg_dict.items():
+        if name == "data":
+            arr[:] = np.random.uniform(-1, 1, arr.shape)
+        elif "label" in name:
+            arr[:] = np.zeros(arr.shape)
+        else:
+            arr[:] = np.random.uniform(-0.05, 0.05, arr.shape)
+    outs = exe.forward(is_train=True)
+    for o, s in zip(outs, out_shapes):
+        assert tuple(o.shape) == tuple(s)
+        assert np.isfinite(o.asnumpy()).all()
+    if check_backward:
+        exe.backward()
+        g = exe.grad_dict.get("data")
+        if g is not None:
+            assert np.isfinite(g.asnumpy()).all()
+    return outs
+
+
+def test_mlp():
+    out = _forward(models.get_mlp(), (8, 784))
+    probs = out[0].asnumpy()
+    assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_lenet():
+    _forward(models.get_lenet(), (4, 1, 28, 28))
+
+
+def test_resnet_cifar():
+    _forward(models.get_resnet_cifar(n=1), (2, 3, 28, 28))
+
+
+def test_resnet50():
+    net = models.get_resnet(num_layers=50)
+    # param count sanity: published ResNet-50 has ~25.5M params
+    arg_shapes, _, aux_shapes = net.infer_shape(data=(1, 3, 224, 224))
+    n_params = sum(int(np.prod(s)) for s in arg_shapes) - 3 * 224 * 224 - 1
+    assert 24e6 < n_params < 27e6, n_params
+    _forward(net, (1, 3, 224, 224), check_backward=False)
+
+
+def test_resnet18():
+    _forward(models.get_resnet(num_layers=18, num_classes=100),
+             (1, 3, 224, 224), check_backward=False)
+
+
+def test_inception_bn_small():
+    _forward(models.get_inception_bn_small(), (2, 3, 28, 28))
+
+
+def test_inception_bn():
+    net = models.get_inception_bn()
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes == [(1, 1000)]
+
+
+def test_googlenet():
+    net = models.get_googlenet()
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes == [(1, 1000)]
+
+
+def test_inception_v3():
+    net = models.get_inception_v3()
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 299, 299))
+    assert out_shapes == [(1, 1000)]
+
+
+def test_alexnet():
+    net = models.get_alexnet()
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes == [(1, 1000)]
+
+
+def test_vgg16():
+    net = models.get_vgg(num_layers=16)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes == [(1, 1000)]
+
+
+def test_lstm_unroll():
+    seq_len, batch = 4, 2
+    net = models.lstm_unroll(num_lstm_layer=1, seq_len=seq_len,
+                             input_size=50, num_hidden=16, num_embed=8,
+                             num_label=50)
+    shapes = {"data": (batch, seq_len),
+              "l0_init_c": (batch, 16), "l0_init_h": (batch, 16)}
+    arg_shapes, out_shapes, _ = net.infer_shape(**shapes)
+    assert len(out_shapes) == seq_len
+    assert all(s == (batch, 50) for s in out_shapes)
+    exe = net.simple_bind(mx.cpu(), grad_req="write", **shapes)
+    for name, arr in exe.arg_dict.items():
+        if name == "data" or "label" in name:
+            arr[:] = np.zeros(arr.shape)
+        else:
+            arr[:] = np.random.uniform(-0.1, 0.1, arr.shape)
+    outs = exe.forward(is_train=True)
+    assert np.allclose(outs[0].asnumpy().sum(axis=1), 1.0, atol=1e-4)
+    exe.backward()
+
+
+@pytest.mark.parametrize("variant", ["32s", "16s", "8s"])
+def test_fcn(variant):
+    net = models.get_fcn_symbol(num_classes=21, variant=variant)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes == [(1, 21, 224, 224)]
+
+
+def test_get_symbol_registry():
+    net = models.get_symbol("lenet", num_classes=10)
+    _, out_shapes, _ = net.infer_shape(data=(2, 1, 28, 28))
+    assert out_shapes == [(2, 10)]
+    with pytest.raises(ValueError):
+        models.get_symbol("nope")
