@@ -1,0 +1,62 @@
+"""Headline benchmark: Inception-BN-28-small on CIFAR-10-shaped data.
+
+Reference baseline: 842 img/s on 1x GTX 980, batch 128
+(example/image-classification/README.md:206; BASELINE.md). This measures
+the fused ParallelTrainer step (forward+backward+SGD update in one XLA
+program) on whatever single accelerator is visible, synthetic data.
+
+Prints ONE JSON line: {"metric","value","unit","vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_PER_SEC = 842.0  # 1x GTX 980
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.models import get_inception_bn_small
+
+    batch = 128
+    sym = get_inception_bn_small(num_classes=10)
+    shapes = {"data": (batch, 3, 28, 28), "softmax_label": (batch,)}
+    mesh = par.data_parallel_mesh(1)
+    trainer = par.ParallelTrainer(
+        sym, shapes, optimizer="sgd", mesh=mesh,
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4})
+    trainer.init_params()
+
+    rng = np.random.RandomState(0)
+    data = rng.randn(*shapes["data"]).astype(np.float32)
+    label = rng.randint(0, 10, (batch,)).astype(np.float32)
+    batch_dict = {"data": data, "softmax_label": label}
+
+    # warmup / compile
+    for _ in range(3):
+        outs = trainer.step(batch_dict)
+    jax.block_until_ready(outs)
+
+    steps = 30
+    tic = time.perf_counter()
+    for _ in range(steps):
+        outs = trainer.step(batch_dict)
+    jax.block_until_ready(outs)
+    toc = time.perf_counter()
+
+    img_per_sec = batch * steps / (toc - tic)
+    print(json.dumps({
+        "metric": "cifar10_inception-bn-28-small_train_throughput",
+        "value": round(img_per_sec, 1),
+        "unit": "img/s",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -47,5 +47,7 @@ from . import monitor  # noqa: E402
 from .monitor import Monitor  # noqa: E402
 from . import model  # noqa: E402
 from .model import FeedForward  # noqa: E402
+from . import parallel  # noqa: E402
+from .parallel import ParallelTrainer  # noqa: E402
 
 __version__ = "0.1.0"

@@ -168,25 +168,21 @@ class Adam(Optimizer):
         self.beta2 = beta2
         self.epsilon = epsilon
         self.decay_factor = decay_factor
-        self.time = 0
-        self.time_first_index = None
 
     def create_state(self, index, weight):
-        self.time_first_index = None
         return (zeros(weight.shape, weight.context, dtype=weight.dtype),
                 zeros(weight.shape, weight.context, dtype=weight.dtype))
 
     def update(self, index, weight, grad, state):
         lr = self._get_lr(index)
         self._update_count(index)
-        # per-weight time tracking (reference increments on the first index)
-        if self.time_first_index is None:
-            self.time_first_index = index
-            self.time = 0
-        if index == self.time_first_index:
-            self.time += 1
+        # t = this weight's update round. The reference tracks one shared
+        # ``time`` ("all parameters share the same time", optimizer.py:519)
+        # whose lazy-create_state bookkeeping can desynchronize it across
+        # params; the per-index count realizes the documented intent and is
+        # what the fused (parallel.optim) path uses, so both paths agree.
         mean, var = state
-        t = self.time
+        t = self._index_update_count[index]
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
         lr_t = lr * math.sqrt(coef2) / coef1

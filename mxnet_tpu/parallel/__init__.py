@@ -1,0 +1,28 @@
+"""Parallelism subsystem: device meshes, sharding rules, and the fused
+pjit training step.
+
+This package is the TPU-native replacement for the reference's entire
+distributed stack (SURVEY.md §2.5): the dependency engine's multi-device
+scheduling, ``DataParallelExecutorManager`` (python/mxnet/executor_manager.py),
+the hand-written kvstore reductions (src/kvstore/kvstore_local.h:180-235),
+and ps-lite RPC (src/kvstore/kvstore_dist.h). Instead of per-device executors
+pushing grads through a parameter server, the *whole* training step —
+forward, backward, gradient all-reduce, optimizer update — is one XLA
+program compiled over a ``jax.sharding.Mesh``; XLA inserts the collectives
+(psum over the ``dp`` axis, all-gather/reduce-scatter for tensor-parallel
+params) and they ride ICI.
+
+Axes convention (used across the framework):
+  dp — data parallel (batch dim)        tp — tensor/model parallel
+  pp — pipeline parallel                sp — sequence/context parallel
+  ep — expert parallel
+"""
+from .mesh import build_mesh, data_parallel_mesh, local_mesh  # noqa: F401
+from .shard import ShardingRules, P  # noqa: F401
+from .graph import make_graph_fn  # noqa: F401
+from .optim import make_functional  # noqa: F401
+from .trainer import ParallelTrainer  # noqa: F401
+from . import collectives  # noqa: F401
+from .ring import (ring_attention, blockwise_attention,  # noqa: F401
+                   ring_self_attention)
+from .pipeline import pipeline_spmd  # noqa: F401

@@ -1,0 +1,41 @@
+"""Named collectives over mesh axes.
+
+TPU-native replacement for the reference's hand-written reductions:
+``ReduceSumCPU`` (src/kvstore/kvstore_local.h:180-235, OMP 4-way unrolled),
+GPU ``ElementwiseSum`` P2P reduction (src/kvstore/kvstore_device.h:65-90),
+and ps-lite ZPush/ZPull RPC (src/kvstore/kvstore_dist.h:62-141). Inside a
+``shard_map``/``pjit`` region these lower to XLA collective HLOs that ride
+ICI (all-reduce, all-gather, reduce-scatter, collective-permute).
+
+These are thin aliases so framework code reads uniformly; user Pallas
+kernels and the ring-attention implementation build on ``ppermute``.
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+__all__ = ["psum", "pmean", "pmax", "all_gather", "reduce_scatter",
+           "ppermute", "all_to_all", "axis_index", "axis_size"]
+
+psum = lax.psum
+pmean = lax.pmean
+pmax = lax.pmax
+ppermute = lax.ppermute
+all_to_all = lax.all_to_all
+axis_index = lax.axis_index
+
+
+def axis_size(axis_name):
+    return lax.psum(1, axis_name)
+
+
+def all_gather(x, axis_name, *, axis=0, tiled=True):
+    """Gather shards along ``axis`` from every device on ``axis_name``."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, *, scatter_dimension=0, tiled=True):
+    """Sum across ``axis_name`` then scatter slices of ``scatter_dimension``."""
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension, tiled=tiled)

@@ -1,0 +1,64 @@
+"""Device mesh construction.
+
+The reference's device model is a flat list of GPUs driven by per-device
+executors (``python/mxnet/model.py:118-308``); placement is explicit
+(``ctx=[mx.gpu(0), mx.gpu(1)]``). The TPU-native model is a named
+``jax.sharding.Mesh`` over which one program is partitioned. These helpers
+build meshes with the framework's canonical axis names (dp/tp/pp/sp/ep).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ..base import MXNetError
+
+__all__ = ["build_mesh", "data_parallel_mesh", "local_mesh"]
+
+
+def build_mesh(axes=None, devices=None):
+    """Build a Mesh from {axis_name: size}.
+
+    A single axis may be -1 ("use all remaining devices"). Axis order is
+    significant for ICI locality: put the fastest-varying (most
+    communication-heavy, e.g. ``tp``) axis LAST so neighbouring devices on
+    the physical torus land in the same tensor-parallel group.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if axes is None:
+        axes = {"dp": n}
+    names = tuple(axes.keys())
+    sizes = [int(s) for s in axes.values()]
+    n_wild = sum(1 for s in sizes if s == -1)
+    if n_wild > 1:
+        raise MXNetError("build_mesh: at most one axis may be -1")
+    fixed = math.prod(s for s in sizes if s != -1)
+    if n_wild == 1:
+        if n % fixed != 0:
+            raise MXNetError("build_mesh: %d devices not divisible by %d"
+                             % (n, fixed))
+        sizes = [n // fixed if s == -1 else s for s in sizes]
+    total = math.prod(sizes)
+    if total > n:
+        raise MXNetError("build_mesh: mesh needs %d devices, have %d"
+                         % (total, n))
+    dev_array = np.array(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def data_parallel_mesh(n_devices=None, name="dp"):
+    """Pure data-parallel mesh over all (or the first n) local devices."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return build_mesh({name: len(devices)}, devices)
+
+
+def local_mesh():
+    """The default 1-axis mesh over every visible device."""
+    return data_parallel_mesh()

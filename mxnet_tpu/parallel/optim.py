@@ -1,0 +1,148 @@
+"""Functional (pure, traceable) optimizer adapters.
+
+The reference runs weight updates either on the Python thread
+(``optimizer.py get_updater``) or inside kvstore servers
+(``src/optimizer/sgd-inl.h`` engine-scheduled updates). The fused TPU path
+needs the update math *inside* the jitted train step, so each
+``mxnet_tpu.optimizer.Optimizer`` maps to an ``(init_fn, update_fn)`` pair
+of pure functions over pytrees:
+
+    state            = init_fn(weight)
+    new_w, new_state = update_fn(weight, grad, state, lr, t, rng)
+
+``t`` is the 1-based update count (traced scalar — Adam bias correction),
+``rng`` a per-step PRNG key (SGLD noise). Math mirrors
+``mxnet_tpu/optimizer.py`` exactly so the eager and fused paths agree; the
+eager path stays the oracle in tests.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+
+__all__ = ["make_functional"]
+
+
+def _clip_rescale(opt, g):
+    g = g * opt.rescale_grad
+    if opt.clip_gradient is not None:
+        g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
+    return g
+
+
+def _sgd(opt):
+    def init(w):
+        return jnp.zeros_like(w) if opt.momentum != 0.0 else ()
+
+    def update(w, g, state, lr, t, rng):
+        g = _clip_rescale(opt, g) + opt.wd * w
+        if opt.momentum == 0.0:
+            return w - lr * g, ()
+        mom = opt.momentum * state - lr * g
+        return w + mom, mom
+    return init, update
+
+
+def _sgld(opt):
+    def init(w):
+        return ()
+
+    def update(w, g, state, lr, t, rng):
+        g = _clip_rescale(opt, g) + opt.wd * w
+        noise = jnp.sqrt(lr) * jax.random.normal(rng, w.shape, w.dtype)
+        return w - (lr / 2) * g + noise, ()
+    return init, update
+
+
+def _adam(opt):
+    def init(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(w, g, state, lr, t, rng):
+        mean, var = state
+        t = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        coef1 = 1.0 - opt.beta1 ** t
+        coef2 = 1.0 - opt.beta2 ** t
+        lr_t = lr * jnp.sqrt(coef2) / coef1
+        g = _clip_rescale(opt, g) + opt.wd * w
+        new_mean = opt.beta1 * mean + (1 - opt.beta1) * g
+        new_var = opt.beta2 * var + (1 - opt.beta2) * g * g
+        new_w = w - lr_t * new_mean / (jnp.sqrt(new_var) + opt.epsilon)
+        return new_w, (new_mean, new_var)
+    return init, update
+
+
+def _adagrad(opt):
+    def init(w):
+        return jnp.zeros_like(w)
+
+    def update(w, g, state, lr, t, rng):
+        g = _clip_rescale(opt, g)
+        hist = state + g * g
+        new_w = w - lr * (g / jnp.sqrt(hist + opt.float_stable_eps)
+                          + opt.wd * w)
+        return new_w, hist
+    return init, update
+
+
+def _rmsprop(opt):
+    def init(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(w, g, state, lr, t, rng):
+        n, g_avg, delta = state
+        g = _clip_rescale(opt, g) + opt.wd * w
+        new_n = (1 - opt.gamma1) * g * g + opt.gamma1 * n
+        new_g = (1 - opt.gamma1) * g + opt.gamma1 * g_avg
+        new_delta = opt.gamma2 * delta - lr * g / jnp.sqrt(
+            new_n - new_g * new_g + 1e-4)
+        return w + new_delta, (new_n, new_g, new_delta)
+    return init, update
+
+
+def _adadelta(opt):
+    def init(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(w, g, state, lr, t, rng):
+        acc_g, acc_delta = state
+        g = _clip_rescale(opt, g)
+        new_acc_g = opt.rho * acc_g + (1 - opt.rho) * g * g
+        cur = jnp.sqrt(acc_delta + opt.epsilon) / \
+            jnp.sqrt(new_acc_g + opt.epsilon) * g
+        new_acc_delta = opt.rho * acc_delta + (1 - opt.rho) * cur * cur
+        return w - opt.wd * w - cur, (new_acc_g, new_acc_delta)
+    return init, update
+
+
+def _test(opt):
+    def init(w):
+        return ()
+
+    def update(w, g, state, lr, t, rng):
+        return w - g * opt.rescale_grad, ()
+    return init, update
+
+
+_FACTORIES = {
+    opt_mod.SGD: _sgd,          # ccSGD is a subclass; dispatch walks MRO
+    opt_mod.SGLD: _sgld,
+    opt_mod.Adam: _adam,
+    opt_mod.AdaGrad: _adagrad,
+    opt_mod.RMSProp: _rmsprop,
+    opt_mod.AdaDelta: _adadelta,
+    opt_mod.Test: _test,
+}
+
+
+def make_functional(optimizer):
+    """(init_fn, update_fn) for an Optimizer instance (dispatch over MRO,
+    so e.g. ccSGD — an SGD subclass — resolves to the SGD math)."""
+    for klass in type(optimizer).__mro__:
+        if klass in _FACTORIES:
+            return _FACTORIES[klass](optimizer)
+    raise MXNetError("no functional adapter for optimizer %s"
+                     % type(optimizer).__name__)

@@ -1,0 +1,70 @@
+"""SPMD pipeline parallelism over the ``pp`` mesh axis.
+
+The reference's only model parallelism is graph partitioning by
+``ctx_group`` with copy nodes between devices
+(``src/symbol/graph_executor.cc:341-458``) — each device runs a different
+sub-graph, serially per batch. The TPU-native form is an SPMD GPipe
+schedule: every device runs the SAME program holding its own stage's
+parameters; activations advance one stage per tick via
+``lax.ppermute``, and microbatches stream through to fill the pipeline
+(bubble = (S-1)/(M+S-1)).
+
+Constraint (standard for SPMD pipelining): all stages must map equal
+activation shapes — true for the repeated-block middle of deep nets,
+which is where pipelining pays.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["pipeline_spmd"]
+
+
+def pipeline_spmd(stage_fn, stage_params, x_microbatches, axis_name="pp"):
+    """Run a GPipe pipeline inside a ``shard_map`` over ``axis_name``.
+
+    stage_fn(params, x) -> y        one stage's computation (shape-preserving
+                                    across stages)
+    stage_params                    THIS stage's parameter pytree (i.e. the
+                                    caller shard_maps params with stage dim
+                                    sharded over ``axis_name``)
+    x_microbatches : [M, mb, ...]   microbatched input, replicated; only
+                                    stage 0 reads it
+    returns        : [M, mb, ...]   valid on the LAST stage (zeros elsewhere);
+                                    callers typically ppermute/psum it out.
+    """
+    S = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    M = x_microbatches.shape[0]
+    perm_fwd = None  # built lazily: needs concrete S
+
+    # S is a traced-constant under shard_map (mesh size is static), so
+    # Python arithmetic on it is fine only when it's concrete; shard_map
+    # gives a concrete int.
+    n = int(S) if not hasattr(S, "aval") else None
+    if n is None:
+        raise ValueError("pipeline_spmd must run inside shard_map "
+                         "(axis size must be static)")
+    perm_fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    state0 = jnp.zeros_like(x_microbatches[0])
+    out0 = jnp.zeros_like(x_microbatches)
+
+    def body(t, carry):
+        state_in, out = carry
+        mb = jnp.clip(t, 0, M - 1)
+        x_first = lax.dynamic_index_in_dim(x_microbatches, mb,
+                                           keepdims=False)
+        x = jnp.where(idx == 0, x_first, state_in)
+        y = stage_fn(stage_params, x)
+        w = t - (n - 1)
+        valid = (idx == n - 1) & (w >= 0) & (w < M)
+        wclip = jnp.clip(w, 0, M - 1)
+        written = lax.dynamic_update_index_in_dim(out, y, wclip, 0)
+        out = jnp.where(valid, written, out)
+        state_next = lax.ppermute(y, axis_name, perm_fwd)
+        return state_next, out
+
+    _, out = lax.fori_loop(0, M + n - 1, body, (state0, out0))
+    return out

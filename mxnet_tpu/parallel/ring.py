@@ -1,0 +1,168 @@
+"""Long-context attention: blockwise (flash) and ring sequence parallelism.
+
+The reference predates attention; its only long-sequence machinery is
+explicit RNN unrolling + bucketing (SURVEY.md §5 "Long-context"). For the
+TPU framework long context is first-class: sequences are sharded over the
+``sp`` mesh axis and attention runs as a ring — each device holds a query
+block, and key/value blocks rotate around the ring via
+``lax.ppermute`` (one ICI hop per step) while a numerically-stable
+streaming-softmax accumulator (the flash-attention recurrence) folds each
+block in. Compute on block t overlaps the transfer of block t+1, so ICI
+latency hides behind the MXU matmuls.
+
+All math accumulates in float32 regardless of input dtype (bf16 in,
+f32 softmax state) — the standard TPU recipe.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+
+from .shard import P
+
+__all__ = ["blockwise_attention", "ring_attention", "ring_self_attention"]
+
+
+def _block_update(q, k, v, o, l, m, mask, scale):
+    """Fold one K/V block into the streaming-softmax state.
+
+    q: [B,Tq,H,D]  k,v: [B,Tk,H,D]  o: [B,Tq,H,D] f32
+    l,m: [B,H,Tq] f32.  mask: [Tq,Tk] bool or None (True = attend).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
+    new_m = jnp.maximum(m, jnp.max(s, axis=-1))
+    # guard fully-masked rows: exp(-inf - -inf) -> use safe max
+    safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+    p = jnp.exp(s - safe_m[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None, :, :], p, 0.0)
+    correction = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m) - safe_m)
+    correction = jnp.where(jnp.isneginf(m), 0.0, correction)
+    new_l = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)
+    new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return new_o, new_l, new_m
+
+
+def _finalize(o, l):
+    l = jnp.maximum(l, 1e-30)
+    return o / l.transpose(0, 2, 1)[..., None]
+
+
+def blockwise_attention(q, k, v, *, causal=False, block_size=512,
+                        scale=None):
+    """Memory-efficient attention on one device: K/V consumed in blocks by
+    ``lax.scan`` over the flash recurrence, so peak memory is O(T·block)
+    instead of O(T²). Shapes: [B,T,H,D] each; returns [B,T,H,D] in q.dtype.
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    nblk = -(-Tk // block_size)
+    pad = nblk * block_size - Tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = k.reshape(B, nblk, block_size, H, D).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(B, nblk, block_size, H, D).transpose(1, 0, 2, 3, 4)
+    qpos = jnp.arange(Tq)
+
+    o0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+    m0 = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
+
+    def body(carry, blk):
+        o, l, m, i = carry
+        kblk, vblk = blk
+        kpos = i * block_size + jnp.arange(block_size)
+        mask = kpos[None, :] < Tk  # padding mask
+        if causal:
+            mask = mask & (qpos[:, None] >= kpos[None, :])
+        else:
+            mask = jnp.broadcast_to(mask, (Tq, block_size))
+        o, l, m = _block_update(q, kblk, vblk, o, l, m, mask, scale)
+        return (o, l, m, i + 1), None
+
+    (o, l, m, _), _ = lax.scan(body, (o0, l0, m0, 0), (kb, vb))
+    return _finalize(o, l).astype(q.dtype)
+
+
+def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
+    """Per-shard body (runs inside shard_map over ``axis_name``).
+
+    q,k,v: LOCAL sequence shards [B, T/n, H, D]. K/V rotate the ring;
+    streaming softmax folds each arriving block in.
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    qpos = my * Tq + jnp.arange(Tq)
+
+    o0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+    m0 = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
+
+    def body(i, carry):
+        o, l, m, kcur, vcur = carry
+        src = (my - i) % n  # ring position whose K/V block we now hold
+        kpos = src * Tk + jnp.arange(Tk)
+        if causal:
+            mask = qpos[:, None] >= kpos[None, :]
+        else:
+            mask = None
+        o, l, m = _block_update(q, kcur, vcur, o, l, m, mask, scale)
+        # rotate K/V one hop (overlapped with the next block's compute by
+        # XLA's async collective-permute)
+        knext = lax.ppermute(kcur, axis_name, perm)
+        vnext = lax.ppermute(vcur, axis_name, perm)
+        return o, l, m, knext, vnext
+
+    o, l, m, _, _ = lax.fori_loop(0, n, body, (o0, l0, m0, k, v))
+    return _finalize(o, l).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, *, axis_name="sp", causal=False,
+                   scale=None, batch_axis=None):
+    """Ring attention over the ``axis_name`` mesh axis.
+
+    q,k,v: GLOBAL [B,T,H,D] arrays (T sharded over ``axis_name`` by the
+    returned computation). Peak per-device memory is O(T/n · T/n) per block
+    pair; total sequence length scales linearly with ring size.
+    """
+    spec = P(batch_axis, axis_name, None, None)
+    fn = functools.partial(_ring_attention_local, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    mapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return mapped(q, k, v)
+
+
+def ring_self_attention(x, wq, wk, wv, wo, mesh, *, num_heads,
+                        axis_name="sp", causal=True, batch_axis="dp"):
+    """Full self-attention block with ring-parallel sequence dim.
+
+    x: [B,T,E] (T sharded on ``axis_name``); wq/wk/wv/wo: [E,E].
+    QKV/output projections are position-wise, so they need no
+    communication under sequence sharding; only the ring rotates K/V.
+    """
+    B, T, E = x.shape
+    D = E // num_heads
+    q = (x @ wq).reshape(B, T, num_heads, D)
+    k = (x @ wk).reshape(B, T, num_heads, D)
+    v = (x @ wv).reshape(B, T, num_heads, D)
+    o = ring_attention(q, k, v, mesh, axis_name=axis_name, causal=causal,
+                       batch_axis=batch_axis)
+    return o.reshape(B, T, E) @ wo

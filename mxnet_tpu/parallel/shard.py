@@ -1,0 +1,100 @@
+"""Sharding rules: parameter/batch PartitionSpecs over a mesh.
+
+TPU-native replacement for the reference's placement machinery: per-device
+executor groups (``python/mxnet/executor_manager.py:146-228``), the
+``ctx_group`` attribute + ``group2ctx`` bind argument, and
+``GraphExecutor::AssignContext``'s copy-node insertion
+(``src/symbol/graph_executor.cc:341-458``). Instead of assigning whole ops
+to devices and copying activations between them, arrays carry named
+``PartitionSpec``s and XLA partitions every op and inserts the transfers
+(as ICI collectives) itself.
+
+Rules are (regex, PartitionSpec) pairs matched against parameter names —
+the same name-pattern dispatch idiom the reference uses for initializers
+(``python/mxnet/initializer.py``) and lr scales.
+"""
+from __future__ import annotations
+
+import re
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+__all__ = ["ShardingRules", "P"]
+
+
+class ShardingRules:
+    """Maps names+shapes to NamedShardings over a mesh.
+
+    Parameters
+    ----------
+    mesh : jax.sharding.Mesh
+    param_rules : list of (name_regex, PartitionSpec)
+        First match wins. Unmatched params are fully replicated. Any spec
+        axis that does not divide the corresponding dim (or names an axis
+        absent from the mesh) is dropped (falls back to replication on
+        that dim) so one rule set works across mesh sizes.
+    data_axes : tuple of axis names to shard the leading (batch) dim of
+        every data/label input over. Defaults to ("dp",) when the mesh has
+        a dp axis, else no sharding.
+    """
+
+    def __init__(self, mesh, param_rules=(), data_axes=None):
+        self.mesh = mesh
+        self.param_rules = [(re.compile(pat), spec)
+                            for pat, spec in param_rules]
+        if data_axes is None:
+            data_axes = tuple(a for a in ("dp",) if a in mesh.shape)
+        self.data_axes = tuple(a for a in data_axes if a in mesh.shape)
+
+    # -- spec resolution -------------------------------------------------
+    def _fit_spec(self, spec, shape):
+        """Drop spec entries that don't divide the shape / exist in mesh."""
+        out = []
+        for i, names in enumerate(spec):
+            if names is None or i >= len(shape):
+                out.append(None)
+                continue
+            group = names if isinstance(names, tuple) else (names,)
+            keep = []
+            size = 1
+            for ax in group:
+                if ax not in self.mesh.shape:
+                    continue
+                size *= self.mesh.shape[ax]
+                keep.append(ax)
+            if keep and shape[i] % size == 0:
+                out.append(tuple(keep) if len(keep) > 1 else keep[0])
+            else:
+                out.append(None)
+        while out and out[-1] is None:
+            out.pop()
+        return P(*out)
+
+    def param_spec(self, name, shape):
+        for pat, spec in self.param_rules:
+            if pat.search(name):
+                return self._fit_spec(spec, shape)
+        return P()
+
+    def data_spec(self, name, shape):
+        if not self.data_axes:
+            return P()
+        axes = self.data_axes
+        size = 1
+        for ax in axes:
+            size *= self.mesh.shape[ax]
+        if not shape or shape[0] % size != 0:
+            return P()
+        return P(axes if len(axes) > 1 else axes[0])
+
+    # -- NamedSharding helpers ------------------------------------------
+    def param_sharding(self, name, shape):
+        return NamedSharding(self.mesh, self.param_spec(name, shape))
+
+    def data_sharding(self, name, shape):
+        return NamedSharding(self.mesh, self.data_spec(name, shape))
+
+    def replicated(self):
+        return NamedSharding(self.mesh, P())

@@ -1,0 +1,284 @@
+"""ParallelTrainer: the fused, sharded training step.
+
+TPU-native replacement for the reference's training machinery
+(``python/mxnet/model.py:118-308`` `_train_multi_device` +
+``executor_manager.py`` DataParallelExecutorManager + kvstore reductions):
+one ``jax.jit``-compiled program per step computes forward, backward,
+gradient aggregation, and the optimizer update, partitioned over a
+``jax.sharding.Mesh``. The batch is sharded over the ``dp`` axis; params
+are placed by ``ShardingRules`` (replicated for pure data parallel,
+sharded over ``tp`` for tensor parallelism). XLA's SPMD partitioner
+inserts the gradient all-reduce the reference implements by hand in
+``src/kvstore/kvstore_local.h:135-235``.
+
+Loss semantics match the symbolic Executor: head gradients are ones, and
+loss ops (SoftmaxOutput etc.) define their own fused gradients that ignore
+the head cotangent and *sum* over the batch — so the optimizer's
+``rescale_grad=1/global_batch`` gives identical updates to the reference's
+multi-device loop, bit-for-bit modulo reduction order.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from .. import optimizer as opt_mod
+from .. import metric as metric_mod
+from ..initializer import Uniform
+from .graph import make_graph_fn
+from .mesh import local_mesh
+from .shard import ShardingRules, P
+from .optim import make_functional
+
+__all__ = ["ParallelTrainer"]
+
+
+def _as_jnp(v):
+    if isinstance(v, NDArray):
+        return v._val
+    return jnp.asarray(v)
+
+
+class ParallelTrainer:
+    """Compile a Symbol into a sharded train/eval step over a mesh.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        Loss-headed graph (e.g. SoftmaxOutput head), as for FeedForward.
+    input_shapes : dict name -> shape
+        GLOBAL (unsharded) shapes of data/label inputs, batch first.
+    optimizer : str or Optimizer
+        If a string, created with ``rescale_grad=1/global_batch`` like
+        FeedForward.fit (reference model.py:456-465).
+    mesh : jax.sharding.Mesh, default: 1-axis dp mesh over all devices.
+    rules : ShardingRules, default: dp-shard data, replicate params.
+    """
+
+    def __init__(self, symbol, input_shapes, optimizer="sgd", mesh=None,
+                 rules=None, initializer=None, seed=None, optimizer_params=None):
+        self.symbol = symbol
+        self.mesh = mesh if mesh is not None else local_mesh()
+        self.rules = rules if rules is not None else ShardingRules(self.mesh)
+        self.input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+
+        arg_names = symbol.list_arguments()
+        self.arg_names = arg_names
+        self.param_names = [n for n in arg_names
+                            if n not in self.input_shapes]
+        self.aux_names = symbol.list_auxiliary_states()
+
+        arg_shapes, out_shapes, aux_shapes = \
+            symbol.infer_shape(**self.input_shapes)
+        if arg_shapes is None:
+            raise MXNetError("ParallelTrainer: cannot infer shapes from %s"
+                             % (self.input_shapes,))
+        self.arg_shapes = dict(zip(arg_names, arg_shapes))
+        self.out_shapes = out_shapes
+        self.aux_shapes = aux_shapes
+
+        # optimizer ------------------------------------------------------
+        batch_size = next(iter(self.input_shapes.values()))[0]
+        self.global_batch = batch_size
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer,
+                                       rescale_grad=1.0 / batch_size,
+                                       **(optimizer_params or {}))
+        self.optimizer = optimizer
+        self._opt_init, self._opt_update = make_functional(optimizer)
+
+        # shardings ------------------------------------------------------
+        self._param_sh = {n: self.rules.param_sharding(n, self.arg_shapes[n])
+                          for n in self.param_names}
+        self._data_sh = {n: self.rules.data_sharding(n, s)
+                         for n, s in self.input_shapes.items()}
+        self._repl = self.rules.replicated()
+
+        # state ----------------------------------------------------------
+        self._graph_fn = make_graph_fn(symbol)
+        self.params = None
+        self.opt_state = None
+        self.aux = None
+        self._t = 0
+        self._rng = jax.random.PRNGKey(
+            np.random.randint(0, 2**31 - 1) if seed is None else seed)
+        self._jit_step = None
+        self._jit_eval = None
+        if initializer is None:
+            initializer = Uniform(0.01)
+        self._initializer = initializer
+
+    # ------------------------------------------------------------------
+    def init_params(self, arg_params=None, aux_params=None):
+        """Initialize (or load) params and place them on the mesh."""
+        params = {}
+        for name in self.param_names:
+            shape = self.arg_shapes[name]
+            if arg_params and name in arg_params:
+                val = _as_jnp(arg_params[name])
+            else:
+                arr = nd.zeros(shape)
+                self._initializer(name, arr)
+                val = arr._val
+            params[name] = jax.device_put(val, self._param_sh[name])
+        aux = []
+        for name, shape in zip(self.aux_names, self.aux_shapes):
+            if aux_params and name in aux_params:
+                val = _as_jnp(aux_params[name])
+            else:
+                arr = nd.zeros(shape)
+                self._initializer(name, arr)
+                val = arr._val
+            aux.append(jax.device_put(val, self._repl))
+        with self.mesh:
+            opt_state = jax.jit(
+                lambda p: {k: self._opt_init(v) for k, v in p.items()},
+                out_shardings=None)(params)
+        self.params = params
+        self.aux = aux
+        self.opt_state = opt_state
+        self._t = 0
+        return self
+
+    # ------------------------------------------------------------------
+    def _step_impl(self, params, opt_state, aux, batch, lr, t, rng):
+        def fwd(p):
+            vals = [p[n] if n in p else batch[n] for n in self.arg_names]
+            outs, new_aux = self._graph_fn(vals, list(aux), True, rng)
+            return tuple(outs), tuple(new_aux)
+
+        outs, vjp_fn, new_aux = jax.vjp(fwd, params, has_aux=True)
+        head_grads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+        (grads,) = vjp_fn(head_grads)
+        new_params, new_state = {}, {}
+        for name in self.param_names:
+            w, s = self._opt_update(params[name], grads[name],
+                                    opt_state[name], lr, t, rng)
+            new_params[name] = w
+            new_state[name] = s
+        return new_params, new_state, list(new_aux), list(outs)
+
+    def _build_step(self):
+        in_sh = (self._param_sh, None, None,
+                 self._data_sh, self._repl, self._repl, self._repl)
+        out_sh = (self._param_sh, None, None, None)
+        return jax.jit(self._step_impl, in_shardings=in_sh,
+                       out_shardings=out_sh, donate_argnums=(0, 1, 2))
+
+    def _build_eval(self):
+        def run(params, aux, batch, rng):
+            vals = [params[n] if n in params else batch[n]
+                    for n in self.arg_names]
+            outs, _ = self._graph_fn(vals, list(aux), False, rng)
+            return list(outs)
+        in_sh = (self._param_sh, None, self._data_sh, self._repl)
+        return jax.jit(run, in_shardings=in_sh)
+
+    def _shard_batch(self, batch, what):
+        """Place global batch arrays onto the mesh (resharding committed
+        host/single-device arrays — the h2d infeed edge)."""
+        try:
+            return {k: jax.device_put(_as_jnp(batch[k]), self._data_sh[k])
+                    for k in self.input_shapes}
+        except KeyError as e:
+            raise MXNetError("%s: missing input %s" % (what, e))
+
+    # ------------------------------------------------------------------
+    def step(self, batch):
+        """One fused train step. ``batch``: dict of global arrays
+        (numpy/NDArray/jax) keyed by input names. Returns outputs list."""
+        if self.params is None:
+            self.init_params()
+        if self._jit_step is None:
+            self._jit_step = self._build_step()
+        batch = self._shard_batch(batch, "step")
+        self._t += 1
+        if self.optimizer.lr_scheduler is not None:
+            lr = self.optimizer.lr_scheduler(self._t)
+        else:
+            lr = self.optimizer.lr
+        rng = jax.random.fold_in(self._rng, self._t)
+        with self.mesh:
+            self.params, self.opt_state, self.aux, outs = self._jit_step(
+                self.params, self.opt_state, self.aux, batch,
+                jnp.float32(lr), jnp.int32(self._t), rng)
+        return outs
+
+    def forward(self, batch):
+        """Inference forward (no aux update); returns outputs list."""
+        if self.params is None:
+            self.init_params()
+        if self._jit_eval is None:
+            self._jit_eval = self._build_eval()
+        batch = self._shard_batch(batch, "forward")
+        rng = jax.random.fold_in(self._rng, 0)
+        with self.mesh:
+            return self._jit_eval(self.params, self.aux, batch, rng)
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            num_epoch=1, batch_end_callback=None, epoch_end_callback=None,
+            logger=None):
+        """Epoch loop over a DataIter, mirroring FeedForward.fit's protocol
+        (metrics, Speedometer-style callbacks) on the fused step."""
+        from ..model import BatchEndParam, _run_callbacks
+        if logger is None:
+            logger = logging
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        data_names = [x[0] for x in train_data.provide_data]
+        label_names = [x[0] for x in train_data.provide_label]
+        for epoch in range(num_epoch):
+            train_data.reset()
+            eval_metric.reset()
+            tic = time.time()
+            for nbatch, dbatch in enumerate(train_data):
+                batch = dict(zip(data_names, dbatch.data))
+                batch.update(zip(label_names, dbatch.label))
+                outs = self.step(batch)
+                out_nds = [nd.array(np.asarray(o)) for o in outs]
+                eval_metric.update(dbatch.label, out_nds)
+                if batch_end_callback is not None:
+                    _run_callbacks(batch_end_callback, BatchEndParam(
+                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
+                        locals=locals()))
+            logger.info("Epoch[%d] Train-%s=%f time=%.3f", epoch,
+                        *eval_metric.get(), time.time() - tic)
+            if epoch_end_callback is not None:
+                ap, xp = self.get_params()
+                for cb in (epoch_end_callback
+                           if isinstance(epoch_end_callback, list)
+                           else [epoch_end_callback]):
+                    cb(epoch, self.symbol, ap, xp)
+            if eval_data is not None:
+                eval_metric.reset()
+                eval_data.reset()
+                for dbatch in eval_data:
+                    batch = dict(zip(data_names, dbatch.data))
+                    batch.update(zip(label_names, dbatch.label))
+                    outs = self.forward(batch)
+                    out_nds = [nd.array(np.asarray(o)) for o in outs]
+                    eval_metric.update(dbatch.label, out_nds)
+                logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                            *eval_metric.get())
+        return self
+
+    # ------------------------------------------------------------------
+    def get_params(self):
+        """Gathered host copies as (arg_params, aux_params) NDArray dicts —
+        checkpoint-compatible with FeedForward/save_checkpoint."""
+        arg_params = {n: nd.array(np.asarray(v))
+                      for n, v in self.params.items()}
+        aux_params = {n: nd.array(np.asarray(v))
+                      for n, v in zip(self.aux_names, self.aux)}
+        return arg_params, aux_params
+
+    def set_params(self, arg_params, aux_params=None):
+        return self.init_params(arg_params, aux_params)
