@@ -1,0 +1,182 @@
+// C ABI for the native runtime components, loaded from Python via ctypes.
+//
+// Mirrors the reference's C API conventions (include/mxnet/c_api.h): every
+// function returns 0 on success / -1 on failure, with the message
+// retrievable from MXTGetLastError() (per-thread, like
+// src/c_api/c_api_error.h's ring buffer).
+#include <cstring>
+#include <exception>
+#include <string>
+
+#include "image_iter.h"
+#include "recordio.h"
+
+namespace {
+thread_local std::string last_error;
+int Fail(const char* what) {
+  last_error = what;
+  return -1;
+}
+int Fail(const std::exception& e) { return Fail(e.what()); }
+}  // namespace
+
+#define API_BEGIN() try {
+#define API_END()                     \
+  }                                   \
+  catch (const std::exception& e) {   \
+    return Fail(e);                   \
+  }                                   \
+  catch (...) { return Fail("unknown C++ exception"); } \
+  return 0;
+
+extern "C" {
+
+const char* MXTGetLastError() { return last_error.c_str(); }
+
+// ---- RecordIO ----------------------------------------------------------
+int MXTRecordIOWriterCreate(const char* path, void** out) {
+  API_BEGIN();
+  auto* w = new mxtpu::RecordIOWriter(path);
+  if (!w->is_open()) {
+    delete w;
+    return Fail("cannot open file for writing");
+  }
+  *out = w;
+  API_END();
+}
+
+int MXTRecordIOWriterWriteRecord(void* handle, const char* buf, size_t size) {
+  API_BEGIN();
+  static_cast<mxtpu::RecordIOWriter*>(handle)->WriteRecord(buf, size);
+  API_END();
+}
+
+int MXTRecordIOWriterTell(void* handle, uint64_t* pos) {
+  API_BEGIN();
+  *pos = static_cast<mxtpu::RecordIOWriter*>(handle)->tell();
+  API_END();
+}
+
+int MXTRecordIOWriterFree(void* handle) {
+  API_BEGIN();
+  delete static_cast<mxtpu::RecordIOWriter*>(handle);
+  API_END();
+}
+
+struct ReaderHandle {
+  mxtpu::RecordIOReader reader;
+  std::string buf;
+  explicit ReaderHandle(const char* p) : reader(p) {}
+};
+
+int MXTRecordIOReaderCreate(const char* path, void** out) {
+  API_BEGIN();
+  auto* r = new ReaderHandle(path);
+  if (!r->reader.is_open()) {
+    delete r;
+    return Fail("cannot open file for reading");
+  }
+  *out = r;
+  API_END();
+}
+
+// *out == nullptr at EOF.
+int MXTRecordIOReaderReadRecord(void* handle, const char** out, size_t* size) {
+  API_BEGIN();
+  auto* r = static_cast<ReaderHandle*>(handle);
+  if (r->reader.NextRecord(&r->buf)) {
+    *out = r->buf.data();
+    *size = r->buf.size();
+  } else {
+    *out = nullptr;
+    *size = 0;
+  }
+  API_END();
+}
+
+int MXTRecordIOReaderSeek(void* handle, uint64_t pos) {
+  API_BEGIN();
+  static_cast<ReaderHandle*>(handle)->reader.Seek(pos);
+  API_END();
+}
+
+int MXTRecordIOReaderTell(void* handle, uint64_t* pos) {
+  API_BEGIN();
+  *pos = static_cast<ReaderHandle*>(handle)->reader.Tell();
+  API_END();
+}
+
+int MXTRecordIOReaderFree(void* handle) {
+  API_BEGIN();
+  delete static_cast<ReaderHandle*>(handle);
+  API_END();
+}
+
+// ---- Image record iterator --------------------------------------------
+int MXTImRecIterCreate(const char* rec_path, int batch_size, int channels,
+                       int height, int width, int label_width, float mean_r,
+                       float mean_g, float mean_b, float scale,
+                       int resize_shorter, int rand_crop, int rand_mirror,
+                       int shuffle, unsigned seed, int num_parts,
+                       int part_index, int num_threads, int prefetch,
+                       int round_batch, void** out) {
+  API_BEGIN();
+  mxtpu::ImRecParams p;
+  p.rec_path = rec_path;
+  p.batch_size = batch_size;
+  p.channels = channels;
+  p.height = height;
+  p.width = width;
+  p.label_width = label_width;
+  p.mean_r = mean_r;
+  p.mean_g = mean_g;
+  p.mean_b = mean_b;
+  p.scale = scale;
+  p.resize_shorter = resize_shorter;
+  p.rand_crop = rand_crop != 0;
+  p.rand_mirror = rand_mirror != 0;
+  p.shuffle = shuffle != 0;
+  p.seed = seed;
+  p.num_parts = num_parts;
+  p.part_index = part_index;
+  p.num_threads = num_threads;
+  p.prefetch = prefetch;
+  p.round_batch = round_batch != 0;
+  auto* it = new mxtpu::ImageRecordIter(p);
+  if (!it->ok()) {
+    delete it;
+    return Fail("cannot open .rec (missing, empty, or empty shard)");
+  }
+  *out = it;
+  API_END();
+}
+
+int MXTImRecIterNext(void* handle, float* data, float* label, int* pad,
+                     int* has_batch) {
+  API_BEGIN();
+  *has_batch = static_cast<mxtpu::ImageRecordIter*>(handle)->Next(
+                   data, label, pad)
+                   ? 1
+                   : 0;
+  API_END();
+}
+
+int MXTImRecIterReset(void* handle) {
+  API_BEGIN();
+  static_cast<mxtpu::ImageRecordIter*>(handle)->Reset();
+  API_END();
+}
+
+int MXTImRecIterNumRecords(void* handle, int64_t* out) {
+  API_BEGIN();
+  *out = static_cast<mxtpu::ImageRecordIter*>(handle)->num_records();
+  API_END();
+}
+
+int MXTImRecIterFree(void* handle) {
+  API_BEGIN();
+  delete static_cast<mxtpu::ImageRecordIter*>(handle);
+  API_END();
+}
+
+}  // extern "C"

@@ -1,0 +1,250 @@
+#include "image_iter.h"
+
+#include <algorithm>
+#include <cstring>
+#include <random>
+#include <stdexcept>
+
+#include <opencv2/core.hpp>
+#include <opencv2/imgcodecs.hpp>
+#include <opencv2/imgproc.hpp>
+
+#include "recordio.h"
+
+namespace mxtpu {
+
+// Image record payload header (bit-compatible with the reference's
+// src/io/image_recordio.h Header: uint32 flag, float label,
+// uint64 image_id[2]; flag>0 => flag extra float labels follow).
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t image_id[2];
+};
+static_assert(sizeof(IRHeader) == 24, "IRHeader layout");
+
+ImageRecordIter::ImageRecordIter(const ImRecParams& p) : p_(p) {
+  std::vector<uint64_t> all = ScanRecordOffsets(p_.rec_path);
+  if (all.empty()) return;
+  // strided shard assignment (reference: num_parts/part_index on the
+  // InputSplit; strided keeps shards balanced for sorted .rec files)
+  for (size_t i = p_.part_index; i < all.size(); i += p_.num_parts)
+    my_offsets_.push_back(all[i]);
+  if (my_offsets_.empty()) return;
+  size_t dsz = (size_t)p_.batch_size * p_.channels * p_.height * p_.width;
+  for (int i = 0; i < std::max(2, p_.prefetch); ++i) {
+    ring_.emplace_back(new Batch());
+    ring_.back()->data.resize(dsz);
+    ring_.back()->label.resize((size_t)p_.batch_size * p_.label_width);
+  }
+  ok_ = true;
+  StartEpoch();
+}
+
+ImageRecordIter::~ImageRecordIter() { StopWorkers(); }
+
+void ImageRecordIter::StartEpoch() {
+  stopping_ = false;
+  next_produce_ = 0;
+  next_consume_ = 0;
+  int n = (int)my_offsets_.size();
+  total_batches_ = p_.round_batch ? (n + p_.batch_size - 1) / p_.batch_size
+                                  : n / p_.batch_size;
+  if (total_batches_ == 0) total_batches_ = 1;  // tiny shard: one padded batch
+  for (auto& b : ring_) { b->state = Batch::FREE; b->id = -1; }
+  producer_ = std::thread(&ImageRecordIter::ProducerLoop, this);
+  int nw = std::max(1, p_.num_threads);
+  for (int i = 0; i < nw; ++i)
+    workers_.emplace_back(&ImageRecordIter::WorkerLoop, this);
+}
+
+void ImageRecordIter::StopWorkers() {
+  {
+    std::unique_lock<std::mutex> lk(mu_);
+    stopping_ = true;
+  }
+  cv_task_.notify_all();
+  cv_state_.notify_all();
+  if (producer_.joinable()) producer_.join();
+  cv_task_.notify_all();
+  for (auto& w : workers_)
+    if (w.joinable()) w.join();
+  workers_.clear();
+  std::queue<Task>().swap(tasks_);
+}
+
+void ImageRecordIter::Reset() {
+  StopWorkers();
+  ++epoch_;
+  StartEpoch();
+}
+
+void ImageRecordIter::ProducerLoop() {
+  // epoch order: shard offsets, shuffled deterministically per epoch
+  std::vector<uint64_t> order = my_offsets_;
+  if (p_.shuffle) {
+    std::mt19937_64 rng(((uint64_t)p_.seed << 20) + epoch_);
+    std::shuffle(order.begin(), order.end(), rng);
+  }
+  int n = (int)order.size();
+  for (int bid = 0; bid < total_batches_; ++bid) {
+    Batch* b = ring_[bid % ring_.size()].get();
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_state_.wait(lk, [&] { return stopping_ || b->state == Batch::FREE; });
+      if (stopping_) return;
+      b->state = Batch::FILLING;
+      b->id = bid;
+      int start = bid * p_.batch_size;
+      int count = std::min(p_.batch_size, n - start);
+      if (count <= 0) count = 0;
+      b->pad = p_.batch_size - count;
+      b->remaining.store(p_.batch_size);
+      for (int s = 0; s < p_.batch_size; ++s) {
+        // round-over padding wraps to the epoch's beginning (reference
+        // BatchLoader batch.pad semantics)
+        int idx = (start + s) % std::max(n, 1);
+        Task t;
+        t.batch = b;
+        t.slot = s;
+        t.offset = order[idx];
+        t.rng_tag = ((uint64_t)epoch_ << 40) ^ ((uint64_t)bid << 16) ^ s
+                    ^ ((uint64_t)p_.seed << 52);
+        tasks_.push(t);
+      }
+    }
+    cv_task_.notify_all();
+  }
+}
+
+void ImageRecordIter::WorkerLoop() {
+  RecordIOReader reader(p_.rec_path);
+  std::string rec;
+  while (true) {
+    Task t;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_task_.wait(lk, [&] { return stopping_ || !tasks_.empty(); });
+      if (stopping_) return;
+      t = tasks_.front();
+      tasks_.pop();
+    }
+    reader.Seek(t.offset);
+    if (!reader.NextRecord(&rec)) continue;
+    try {
+      DecodeInto(rec, t.batch, t.slot, t.rng_tag);
+    } catch (...) {
+      // bad image: leave slot zeroed (reference logs & skips)
+      size_t isz = (size_t)p_.channels * p_.height * p_.width;
+      std::memset(t.batch->data.data() + (size_t)t.slot * isz, 0,
+                  isz * sizeof(float));
+    }
+    if (t.batch->remaining.fetch_sub(1) == 1) {
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        t.batch->state = Batch::READY;
+      }
+      cv_state_.notify_all();
+    }
+  }
+}
+
+void ImageRecordIter::DecodeInto(const std::string& rec, Batch* b, int slot,
+                                 uint64_t rng_tag) {
+  IRHeader hdr;
+  if (rec.size() < sizeof(hdr)) throw std::runtime_error("short record");
+  std::memcpy(&hdr, rec.data(), sizeof(hdr));
+  const uint8_t* payload = (const uint8_t*)rec.data() + sizeof(hdr);
+  size_t payload_size = rec.size() - sizeof(hdr);
+  // labels
+  float* lab = b->label.data() + (size_t)slot * p_.label_width;
+  for (int i = 0; i < p_.label_width; ++i) lab[i] = 0.f;
+  if (hdr.flag > 0) {
+    size_t nl = hdr.flag;
+    if (payload_size < nl * 4) throw std::runtime_error("short labels");
+    const float* extra = (const float*)payload;
+    for (int i = 0; i < p_.label_width && i < (int)nl; ++i) lab[i] = extra[i];
+    payload += nl * 4;
+    payload_size -= nl * 4;
+  } else {
+    lab[0] = hdr.label;
+  }
+  // decode
+  cv::Mat buf(1, (int)payload_size, CV_8U, (void*)payload);
+  cv::Mat img = cv::imdecode(buf, p_.channels == 1 ? cv::IMREAD_GRAYSCALE
+                                                   : cv::IMREAD_COLOR);
+  if (img.empty()) throw std::runtime_error("imdecode failed");
+  std::mt19937 rng((uint32_t)(rng_tag ^ (rng_tag >> 32)));
+  // resize shorter edge
+  if (p_.resize_shorter > 0) {
+    int shorter = std::min(img.rows, img.cols);
+    if (shorter != p_.resize_shorter) {
+      double s = (double)p_.resize_shorter / shorter;
+      cv::resize(img, img, cv::Size(), s, s,
+                 s < 1 ? cv::INTER_AREA : cv::INTER_LINEAR);
+    }
+  }
+  // guarantee croppable size
+  if (img.rows < p_.height || img.cols < p_.width) {
+    cv::resize(img, img, cv::Size(std::max(img.cols, p_.width),
+                                  std::max(img.rows, p_.height)),
+               0, 0, cv::INTER_LINEAR);
+  }
+  // crop
+  int y0, x0;
+  if (p_.rand_crop) {
+    y0 = std::uniform_int_distribution<int>(0, img.rows - p_.height)(rng);
+    x0 = std::uniform_int_distribution<int>(0, img.cols - p_.width)(rng);
+  } else {
+    y0 = (img.rows - p_.height) / 2;
+    x0 = (img.cols - p_.width) / 2;
+  }
+  cv::Mat crop = img(cv::Rect(x0, y0, p_.width, p_.height));
+  bool mirror = p_.rand_mirror &&
+                std::uniform_int_distribution<int>(0, 1)(rng) == 1;
+  // normalize into NCHW float, RGB channel order (reference
+  // iter_normalize.h stores RGB and subtracts per-channel mean)
+  size_t isz = (size_t)p_.channels * p_.height * p_.width;
+  float* out = b->data.data() + (size_t)slot * isz;
+  float means[3] = {p_.mean_r, p_.mean_g, p_.mean_b};
+  int H = p_.height, W = p_.width, C = p_.channels;
+  for (int y = 0; y < H; ++y) {
+    const uint8_t* row = crop.ptr<uint8_t>(y);
+    for (int x = 0; x < W; ++x) {
+      int sx = mirror ? (W - 1 - x) : x;
+      if (C == 1) {
+        out[(size_t)y * W + x] = (row[sx] - means[0]) * p_.scale;
+      } else {
+        // OpenCV is BGR; emit RGB planes
+        const uint8_t* px = row + sx * 3;
+        out[(size_t)0 * H * W + y * W + x] = (px[2] - means[0]) * p_.scale;
+        out[(size_t)1 * H * W + y * W + x] = (px[1] - means[1]) * p_.scale;
+        out[(size_t)2 * H * W + y * W + x] = (px[0] - means[2]) * p_.scale;
+      }
+    }
+  }
+}
+
+bool ImageRecordIter::Next(float* data_out, float* label_out, int* pad_out) {
+  if (!ok_) return false;
+  if (next_consume_ >= total_batches_) return false;
+  Batch* b = ring_[next_consume_ % ring_.size()].get();
+  {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_state_.wait(lk, [&] {
+      return stopping_ ||
+             (b->state == Batch::READY && b->id == next_consume_);
+    });
+    if (stopping_) return false;
+    std::memcpy(data_out, b->data.data(), b->data.size() * sizeof(float));
+    std::memcpy(label_out, b->label.data(), b->label.size() * sizeof(float));
+    if (pad_out) *pad_out = b->pad;
+    b->state = Batch::FREE;
+    b->id = -1;
+  }
+  cv_state_.notify_all();
+  ++next_consume_;
+  return true;
+}
+
+}  // namespace mxtpu

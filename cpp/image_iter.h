@@ -1,0 +1,96 @@
+// Threaded image-record iterator: the native data pipeline.
+//
+// TPU-native equivalent of the reference pipeline Parser -> BatchLoader ->
+// Normalize -> Prefetcher (src/io/iter_image_recordio.cc:398+,
+// iter_batchloader.h, iter_normalize.h, iter_prefetcher.h): one producer
+// thread streams records from a .rec file (sharded by num_parts/part_index,
+// optionally shuffled per epoch), N decode threads JPEG-decode + augment +
+// normalize directly into per-batch float buffers, and Next() hands
+// completed batches to the host loop in order. Decode overlaps both disk IO
+// and device compute, keeping the TPU infeed fed.
+#ifndef MXNET_TPU_IMAGE_ITER_H_
+#define MXNET_TPU_IMAGE_ITER_H_
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace mxtpu {
+
+struct ImRecParams {
+  std::string rec_path;
+  int batch_size = 1;
+  int channels = 3, height = 224, width = 224;  // output shape (C,H,W)
+  int label_width = 1;
+  float mean_r = 0.f, mean_g = 0.f, mean_b = 0.f;
+  float scale = 1.f;
+  int resize_shorter = 0;    // 0 = no resize
+  bool rand_crop = false;    // else center crop
+  bool rand_mirror = false;
+  bool shuffle = false;
+  uint32_t seed = 0;
+  int num_parts = 1, part_index = 0;
+  int num_threads = 4;
+  int prefetch = 4;          // batches in flight
+  bool round_batch = true;   // pad last batch (reports pad count)
+};
+
+class ImageRecordIter {
+ public:
+  explicit ImageRecordIter(const ImRecParams& p);
+  ~ImageRecordIter();
+  bool ok() const { return ok_; }
+  // Copy next batch into caller buffers (data: B*C*H*W floats, label:
+  // B*label_width floats). Returns false at epoch end.
+  bool Next(float* data_out, float* label_out, int* pad_out);
+  void Reset();
+  int64_t num_records() const { return (int64_t)my_offsets_.size(); }
+
+ private:
+  struct Batch {
+    std::vector<float> data, label;
+    std::atomic<int> remaining{0};
+    int pad = 0;
+    int id = -1;
+    enum State { FREE, FILLING, READY } state = FREE;
+  };
+  struct Task {
+    Batch* batch;
+    int slot;
+    uint64_t offset;
+    uint64_t rng_tag;  // deterministic per-sample augmentation seed
+    bool stop = false;
+  };
+
+  void StartEpoch();
+  void StopWorkers();
+  void ProducerLoop();
+  void WorkerLoop();
+  void DecodeInto(const std::string& rec, Batch* b, int slot,
+                  uint64_t rng_tag);
+
+  ImRecParams p_;
+  bool ok_ = false;
+  std::vector<uint64_t> my_offsets_;  // this shard's records
+  uint64_t epoch_ = 0;
+
+  std::vector<std::unique_ptr<Batch>> ring_;
+  std::queue<Task> tasks_;
+  std::mutex mu_;
+  std::condition_variable cv_task_, cv_state_;
+  bool stopping_ = false;
+  int next_produce_ = 0;  // batch id producer fills next
+  int next_consume_ = 0;  // batch id Next() returns next
+  int total_batches_ = 0;
+  std::thread producer_;
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace mxtpu
+#endif  // MXNET_TPU_IMAGE_ITER_H_

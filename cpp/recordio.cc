@@ -1,0 +1,113 @@
+#include "recordio.h"
+
+#include <cstring>
+#include <stdexcept>
+
+namespace mxtpu {
+
+RecordIOWriter::RecordIOWriter(const std::string& path) {
+  fp_ = std::fopen(path.c_str(), "wb");
+}
+RecordIOWriter::~RecordIOWriter() {
+  if (fp_) std::fclose(fp_);
+}
+
+void RecordIOWriter::WriteRecord(const void* buf, size_t size) {
+  if (size >= (1U << 29U)) throw std::runtime_error("record too large");
+  const char* pbegin = static_cast<const char*>(buf);
+  const uint32_t umagic = kRecMagic;
+  uint32_t len = static_cast<uint32_t>(size);
+  uint32_t lower_align = (len >> 2U) << 2U;
+  uint32_t upper_align = ((len + 3U) >> 2U) << 2U;
+  uint32_t dptr = 0;
+  // split payload wherever the magic word appears on a 4-byte stride
+  for (uint32_t i = 0; i < lower_align; i += 4) {
+    if (std::memcmp(pbegin + i, &umagic, 4) == 0) {
+      uint32_t lrec = EncodeLRec(dptr == 0 ? 1U : 2U, i - dptr);
+      std::fwrite(&umagic, 4, 1, fp_);
+      std::fwrite(&lrec, 4, 1, fp_);
+      if (i != dptr) std::fwrite(pbegin + dptr, 1, i - dptr, fp_);
+      bytes_written_ += 8 + (i - dptr);
+      dptr = i + 4;
+    }
+  }
+  uint32_t lrec = EncodeLRec(dptr != 0 ? 3U : 0U, len - dptr);
+  std::fwrite(&umagic, 4, 1, fp_);
+  std::fwrite(&lrec, 4, 1, fp_);
+  if (len != dptr) std::fwrite(pbegin + dptr, 1, len - dptr, fp_);
+  bytes_written_ += 8 + (len - dptr);
+  uint32_t zero = 0;
+  if (upper_align != len) {
+    std::fwrite(&zero, 1, upper_align - len, fp_);
+    bytes_written_ += upper_align - len;
+  }
+}
+
+RecordIOReader::RecordIOReader(const std::string& path) {
+  fp_ = std::fopen(path.c_str(), "rb");
+}
+RecordIOReader::~RecordIOReader() {
+  if (fp_) std::fclose(fp_);
+}
+
+void RecordIOReader::Seek(uint64_t pos) {
+#if defined(_WIN32)
+  std::fseek(fp_, static_cast<long>(pos), SEEK_SET);
+#else
+  fseeko(fp_, static_cast<off_t>(pos), SEEK_SET);
+#endif
+}
+
+uint64_t RecordIOReader::Tell() {
+#if defined(_WIN32)
+  return static_cast<uint64_t>(std::ftell(fp_));
+#else
+  return static_cast<uint64_t>(ftello(fp_));
+#endif
+}
+
+bool RecordIOReader::NextRecord(std::string* out) {
+  out->clear();
+  const uint32_t umagic = kRecMagic;
+  bool in_multi = false;
+  while (true) {
+    uint32_t magic, lrec;
+    if (std::fread(&magic, 4, 1, fp_) != 1) return false;  // EOF
+    if (magic != umagic) throw std::runtime_error("recordio: bad magic");
+    if (std::fread(&lrec, 4, 1, fp_) != 1)
+      throw std::runtime_error("recordio: truncated header");
+    uint32_t cflag = DecodeFlag(lrec);
+    uint32_t len = DecodeLength(lrec);
+    uint32_t upper_align = ((len + 3U) >> 2U) << 2U;
+    if (in_multi) {
+      // chunks were split at a magic occurrence: restore it
+      out->append(reinterpret_cast<const char*>(&umagic), 4);
+    }
+    size_t cur = out->size();
+    out->resize(cur + len);
+    if (len && std::fread(&(*out)[cur], 1, len, fp_) != len)
+      throw std::runtime_error("recordio: truncated payload");
+    if (upper_align != len) {
+      char pad[4];
+      if (std::fread(pad, 1, upper_align - len, fp_) != upper_align - len)
+        throw std::runtime_error("recordio: truncated pad");
+    }
+    if (cflag == 0U || cflag == 3U) return true;
+    in_multi = true;
+  }
+}
+
+std::vector<uint64_t> ScanRecordOffsets(const std::string& path) {
+  RecordIOReader reader(path);
+  std::vector<uint64_t> offsets;
+  if (!reader.is_open()) return offsets;
+  std::string rec;
+  while (true) {
+    uint64_t pos = reader.Tell();
+    if (!reader.NextRecord(&rec)) break;
+    offsets.push_back(pos);
+  }
+  return offsets;
+}
+
+}  // namespace mxtpu

@@ -1,0 +1,63 @@
+// RecordIO: dmlc-compatible splittable binary record format.
+//
+// Re-implementation (from format spec, not a copy) of the container the
+// reference uses for packed image datasets (vendored dmlc-core recordio;
+// consumed by src/io/iter_image_recordio.cc and python/mxnet/recordio.py).
+// Format: every chunk is [kMagic:u32][lrec:u32][payload][pad to 4B] where
+// lrec encodes cflag = lrec>>29 and length = lrec & ((1<<29)-1). Payloads
+// containing the magic word are split at those positions (cflag 1/2/3 =
+// first/middle/last chunk); readers rejoin chunks re-inserting the magic.
+// This keeps files resync-able from arbitrary offsets (distributed input
+// splits).
+#ifndef MXNET_TPU_RECORDIO_H_
+#define MXNET_TPU_RECORDIO_H_
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+static const uint32_t kRecMagic = 0xced7230a;
+
+inline uint32_t EncodeLRec(uint32_t cflag, uint32_t length) {
+  return (cflag << 29U) | length;
+}
+inline uint32_t DecodeFlag(uint32_t rec) { return (rec >> 29U) & 7U; }
+inline uint32_t DecodeLength(uint32_t rec) { return rec & ((1U << 29U) - 1U); }
+
+class RecordIOWriter {
+ public:
+  explicit RecordIOWriter(const std::string& path);
+  ~RecordIOWriter();
+  bool is_open() const { return fp_ != nullptr; }
+  // Write one logical record (splitting at embedded magics).
+  void WriteRecord(const void* buf, size_t size);
+  uint64_t tell() const { return bytes_written_; }
+
+ private:
+  std::FILE* fp_;
+  uint64_t bytes_written_ = 0;
+};
+
+class RecordIOReader {
+ public:
+  explicit RecordIOReader(const std::string& path);
+  ~RecordIOReader();
+  bool is_open() const { return fp_ != nullptr; }
+  // Read next logical record; false at EOF.
+  bool NextRecord(std::string* out);
+  void Seek(uint64_t pos);
+  uint64_t Tell();
+
+ private:
+  std::FILE* fp_;
+};
+
+// Scan a .rec file, returning the byte offset of every logical record
+// (offset of its first chunk header). Used for shuffling + sharding.
+std::vector<uint64_t> ScanRecordOffsets(const std::string& path);
+
+}  // namespace mxtpu
+#endif  // MXNET_TPU_RECORDIO_H_

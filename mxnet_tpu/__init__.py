@@ -49,5 +49,8 @@ from . import model  # noqa: E402
 from .model import FeedForward  # noqa: E402
 from . import parallel  # noqa: E402
 from .parallel import ParallelTrainer  # noqa: E402
+from . import recordio  # noqa: E402
+from . import image_io  # noqa: E402
+from .image_io import ImageRecordIter  # noqa: E402
 
 __version__ = "0.1.0"

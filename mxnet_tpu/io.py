@@ -398,3 +398,12 @@ class CSVIter(NDArrayIter):
         super().__init__(
             data, label, batch_size=batch_size,
             last_batch_handle="pad" if round_batch else "discard")
+
+
+def _imagerecorditer(*args, **kwargs):
+    """mx.io.ImageRecordIter (native pipeline; see image_io.py)."""
+    from .image_io import ImageRecordIter as _IRI
+    return _IRI(*args, **kwargs)
+
+
+ImageRecordIter = _imagerecorditer

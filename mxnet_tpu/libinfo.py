@@ -1,0 +1,49 @@
+"""Locate and load the native runtime library (parity:
+``python/mxnet/libinfo.py`` + ``base.py`` _LIB loading).
+
+The native library is optional: every consumer has a pure-Python fallback,
+so an unbuilt tree still works (build with ``make -C cpp``).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+_LIB = None
+_TRIED = False
+
+
+def find_lib_path():
+    cur = os.path.dirname(os.path.abspath(__file__))
+    candidates = [
+        os.environ.get("MXNET_TPU_LIBRARY", ""),
+        os.path.join(cur, "lib", "libmxnet_tpu.so"),
+        os.path.join(cur, "..", "cpp", "libmxnet_tpu.so"),
+    ]
+    return [p for p in candidates if p and os.path.exists(p)]
+
+
+def get_lib():
+    """The loaded CDLL or None if unavailable."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    paths = find_lib_path()
+    if not paths:
+        return None
+    try:
+        lib = ctypes.CDLL(paths[0])
+        lib.MXTGetLastError.restype = ctypes.c_char_p
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def check_call(ret):
+    if ret != 0:
+        lib = get_lib()
+        msg = lib.MXTGetLastError().decode() if lib else "native call failed"
+        from .base import MXNetError
+        raise MXNetError(msg)

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder/list into a RecordIO file.
+
+Parity: ``tools/im2rec.cc`` + ``tools/make_list.py`` in the reference.
+Usage:
+  python tools/im2rec.py make-list  <imgdir> <prefix> [--recursive] [--train-ratio R]
+  python tools/im2rec.py pack       <listfile> <imgdir> <out.rec> [--quality Q]
+                                    [--resize N] [--color {1,0,-1}]
+
+List format (reference make_list.py): ``index\\tlabel\\trelative_path``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(args):
+    paths = []
+    if args.recursive:
+        # each subdirectory = one class (sorted for stable label ids)
+        classes = sorted(d for d in os.listdir(args.imgdir)
+                         if os.path.isdir(os.path.join(args.imgdir, d)))
+        for label, cls in enumerate(classes):
+            d = os.path.join(args.imgdir, cls)
+            for f in sorted(os.listdir(d)):
+                if f.lower().endswith(EXTS):
+                    paths.append((os.path.join(cls, f), float(label)))
+        print("classes:", {c: i for i, c in enumerate(classes)})
+    else:
+        for f in sorted(os.listdir(args.imgdir)):
+            if f.lower().endswith(EXTS):
+                paths.append((f, 0.0))
+    if args.shuffle:
+        random.Random(args.seed).shuffle(paths)
+    n_train = int(len(paths) * args.train_ratio)
+    chunks = [("train", paths[:n_train]), ("val", paths[n_train:])] \
+        if args.train_ratio < 1.0 else [("", paths)]
+    for suffix, chunk in chunks:
+        if not chunk:
+            continue
+        name = args.prefix + ("_%s" % suffix if suffix else "") + ".lst"
+        with open(name, "w") as f:
+            for i, (p, label) in enumerate(chunk):
+                f.write("%d\t%f\t%s\n" % (i, label, p))
+        print("wrote %s (%d items)" % (name, len(chunk)))
+
+
+def pack(args):
+    import cv2
+    import numpy as np
+    from mxnet_tpu import recordio
+
+    writer = recordio.MXIndexedRecordIO(
+        os.path.splitext(args.out)[0] + ".idx", args.out, "w")
+    n = 0
+    with open(args.listfile) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx, label, path = int(parts[0]), parts[1:-1], parts[-1]
+            img = cv2.imread(os.path.join(args.imgdir, path), args.color)
+            if img is None:
+                print("skip unreadable:", path, file=sys.stderr)
+                continue
+            if args.resize > 0:
+                shorter = min(img.shape[:2])
+                s = args.resize / shorter
+                img = cv2.resize(img, None, fx=s, fy=s)
+            if img.ndim == 3:
+                img = img[:, :, ::-1]  # BGR->RGB (pack_img expects RGB)
+            labels = [float(x) for x in label]
+            header = recordio.IRHeader(
+                0, labels[0] if len(labels) == 1 else np.array(labels), idx, 0)
+            writer.write_idx(idx, recordio.pack_img(
+                header, img, quality=args.quality))
+            n += 1
+    writer.close()
+    print("packed %d images -> %s" % (n, args.out))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ml = sub.add_parser("make-list")
+    ml.add_argument("imgdir")
+    ml.add_argument("prefix")
+    ml.add_argument("--recursive", action="store_true")
+    ml.add_argument("--train-ratio", type=float, default=1.0)
+    ml.add_argument("--shuffle", action="store_true", default=True)
+    ml.add_argument("--seed", type=int, default=0)
+    ml.set_defaults(fn=make_list)
+    pk = sub.add_parser("pack")
+    pk.add_argument("listfile")
+    pk.add_argument("imgdir")
+    pk.add_argument("out")
+    pk.add_argument("--quality", type=int, default=95)
+    pk.add_argument("--resize", type=int, default=0)
+    pk.add_argument("--color", type=int, default=1)
+    pk.set_defaults(fn=pack)
+    args = p.parse_args()
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()
