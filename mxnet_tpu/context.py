@@ -78,13 +78,20 @@ class Context:
         """
         import jax
 
+        # local_devices, not devices: in multi-process runs the global list
+        # leads with other processes' (non-addressable) devices; a Context
+        # always names a device THIS process can allocate on (the
+        # reference's Context is likewise process-local, base.h:90-175)
         if self.device_type in ("tpu", "gpu"):
-            devs = jax.devices()
+            devs = jax.local_devices()
         else:
             try:
-                devs = jax.devices("cpu")
+                devs = [d for d in jax.local_devices()
+                        if d.platform == "cpu"]
+                if not devs:
+                    raise RuntimeError
             except RuntimeError:
-                devs = jax.devices()
+                devs = jax.local_devices()
         if self.device_id < len(devs):
             return devs[self.device_id]
         # Out-of-range ids resolve to device 0 rather than erroring: tests

@@ -64,6 +64,9 @@ class KVStore:
         self._store = {}
         self._updater = None
         self._is_dist = kv_type.startswith("dist")
+        if self._is_dist:
+            from . import distributed
+            distributed.initialize()  # no-op if single-process/already up
         # NOTE: dist_async degrades to synchronous collectives here — the
         # reference's async path exists because ps-lite servers can apply
         # updates out of lockstep; with in-program DCN collectives there is
@@ -167,9 +170,14 @@ def _process_index():
 
 
 def _allreduce_dcn(val):
-    """Cross-process sum over DCN (replaces ps-lite ZPush/ZPull)."""
+    """Cross-process sum over DCN (replaces ps-lite ZPush/ZPull).
+
+    Takes the host-value path (process_allgather over numpy) because
+    KVStore arrays are per-process host-resident NDArrays, not arrays on a
+    shared global mesh — the fused parallel trainer is the in-program path.
+    """
     from jax.experimental import multihost_utils
-    return multihost_utils.process_allgather(val).sum(axis=0)
+    return multihost_utils.process_allgather(np.asarray(val)).sum(axis=0)
 
 
 def create(name="local"):

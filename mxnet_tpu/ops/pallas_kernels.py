@@ -1,0 +1,216 @@
+"""Hand-written Pallas TPU kernels for the hot ops.
+
+The reference's analogue layer is the cuDNN-backed operator variants
+(``src/operator/cudnn_*``, selected at CreateOp when available) and NVRTC
+runtime kernels (``src/common/mxrtc.cc``). Here the default path is XLA
+fusion; these kernels cover what XLA does not fuse well:
+
+* ``flash_attention`` — streaming-softmax attention tiled for VMEM: one
+  pass over K/V blocks per query block, f32 accumulators, MXU matmuls.
+  O(T) memory instead of O(T²). Gradient comes from ``jax.custom_vjp``
+  with a blockwise (lax.scan) backward, so training works everywhere.
+* ``fused_linear`` — matmul + bias + activation epilogue in one kernel
+  (the reference fuses this per-op in mshadow: fully_connected-inl.h).
+
+Kernels run on TPU; on CPU (tests) they run under the Pallas interpreter,
+keeping the backend-consistency oracle (SURVEY.md §4.3) meaningful.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "fused_linear"]
+
+
+def _use_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+
+def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
+                     seq_k, causal, scale):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+    bq, d = q.shape
+    nkb = pl.cdiv(seq_k, block_k)
+    if causal:
+        # only blocks up to the diagonal contribute
+        hi = (qi + 1) * block_q
+        nkb = jnp.minimum(nkb, pl.cdiv(hi, block_k))
+
+    def body(j, carry):
+        o, l, m = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+        kpos = j * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        mask = kpos < seq_k  # K padding
+        if causal:
+            mask = mask & (qpos >= kpos)
+        s = jnp.where(mask, s, -jnp.inf)
+        new_m = jnp.maximum(m, jnp.max(s, axis=1))
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        p = jnp.where(mask, jnp.exp(s - safe_m[:, None]), 0.0)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        new_l = l * corr + jnp.sum(p, axis=1)
+        new_o = o * corr[:, None] + jnp.dot(p, v,
+                                            preferred_element_type=jnp.float32)
+        return new_o, new_l, new_m
+
+    o0 = jnp.zeros((bq, d), jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
+    o, l, m = lax.fori_loop(0, nkb, body, (o0, l0, m0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    """q,k,v: [BH, T, D] (T padded to block multiples)."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    grid = (bh, tq // block_q)
+    return pl.pallas_call(
+        functools.partial(_attn_fwd_kernel, block_q=block_q,
+                          block_k=block_k, seq_k=tk, causal=causal,
+                          scale=scale),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _reference_attention(q, k, v, causal, scale):
+    """Blockwise-exact attention in plain JAX — supplies the VJP and the
+    numerical oracle. [BH, T, D] layout, f32 accumulation."""
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    tq, tk = q.shape[1], k.shape[1]
+    if causal:
+        mask = lax.broadcasted_iota(jnp.int32, (tq, tk), 0) >= \
+            lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(mask[None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_core_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_core_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _reference_attention(a, b, c, causal,
+                                                          scale), q, k, v)
+    return vjp(g)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q, k, v, *, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=None):
+    """Fused attention. q,k,v: [B, T, H, D]; returns [B, T, H, D].
+
+    Pads T to block multiples internally (padded keys masked out, padded
+    queries dropped). Use inside jit; differentiable.
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    block_q = min(block_q, _round_up(tq, 8))
+    block_k = min(block_k, _round_up(tk, 8))
+
+    def to_bh(x, t):
+        x = x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        tp = _round_up(t, max(block_q, block_k))
+        if tp != t:
+            x = jnp.pad(x, ((0, 0), (0, tp - t), (0, 0)))
+        return x
+
+    qb, kb, vb = to_bh(q, tq), to_bh(k, tk), to_bh(v, tk)
+    out = _flash_core(qb, kb, vb, causal, scale, block_q, block_k, interpret)
+    out = out[:, :tq]
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# fused linear (matmul + bias + activation epilogue)
+
+_ACTS = {
+    "linear": lambda x: x,
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "gelu": jax.nn.gelu,
+}
+
+
+def _linear_kernel(x_ref, w_ref, b_ref, o_ref, *, act):
+    acc = jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32)
+    acc = acc + b_ref[:].astype(jnp.float32)
+    o_ref[:] = _ACTS[act](acc).astype(o_ref.dtype)
+
+
+def fused_linear(x, w, b, act="linear", *, block_m=256, block_n=256,
+                 interpret=None):
+    """act(x @ w + b) in one kernel. x: [M, K], w: [K, N], b: [N].
+
+    The epilogue (bias+activation) runs on the accumulator while it is
+    still in VMEM — one HBM round-trip instead of three.
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    if act not in _ACTS:
+        raise ValueError("unknown activation %r" % act)
+    m, kdim = x.shape
+    n = w.shape[1]
+    bm = min(block_m, _round_up(m, 8))
+    bn = min(block_n, _round_up(n, 128))
+    mp, np_ = _round_up(m, bm), _round_up(n, bn)
+    xp = jnp.pad(x, ((0, mp - m), (0, 0))) if mp != m else x
+    wp = jnp.pad(w, ((0, 0), (0, np_ - n))) if np_ != n else w
+    bp = jnp.pad(b, (0, np_ - n)) if np_ != n else b
+    bp = bp.reshape(1, np_)
+    out = pl.pallas_call(
+        functools.partial(_linear_kernel, act=act),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), x.dtype),
+        grid=(mp // bm, np_ // bn),
+        in_specs=[
+            pl.BlockSpec((bm, kdim), lambda i, j: (i, 0)),
+            pl.BlockSpec((kdim, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        interpret=interpret,
+    )(xp, wp, bp)
+    return out[:m, :n]

@@ -116,6 +116,17 @@ class ParallelTrainer:
         self._initializer = initializer
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _place(val, sharding):
+        """Place a host value with a sharding; works in multi-process runs
+        where the sharding spans non-addressable devices (every process
+        holds the full host value — the replicated-init convention)."""
+        if jax.process_count() == 1:
+            return jax.device_put(val, sharding)
+        val = np.asarray(val)
+        return jax.make_array_from_callback(val.shape, sharding,
+                                            lambda idx: val[idx])
+
     def init_params(self, arg_params=None, aux_params=None):
         """Initialize (or load) params and place them on the mesh."""
         params = {}
@@ -127,7 +138,7 @@ class ParallelTrainer:
                 arr = nd.zeros(shape)
                 self._initializer(name, arr)
                 val = arr._val
-            params[name] = jax.device_put(val, self._param_sh[name])
+            params[name] = self._place(val, self._param_sh[name])
         aux = []
         for name, shape in zip(self.aux_names, self.aux_shapes):
             if aux_params and name in aux_params:
@@ -136,7 +147,7 @@ class ParallelTrainer:
                 arr = nd.zeros(shape)
                 self._initializer(name, arr)
                 val = arr._val
-            aux.append(jax.device_put(val, self._repl))
+            aux.append(self._place(val, self._repl))
         with self.mesh:
             opt_state = jax.jit(
                 lambda p: {k: self._opt_init(v) for k, v in p.items()},
@@ -182,13 +193,26 @@ class ParallelTrainer:
         return jax.jit(run, in_shardings=in_sh)
 
     def _shard_batch(self, batch, what):
-        """Place global batch arrays onto the mesh (resharding committed
-        host/single-device arrays — the h2d infeed edge)."""
+        """Place batch arrays onto the mesh (the h2d infeed edge).
+
+        Single process: arrays are GLOBAL batches, resharded by device_put.
+        Multi-process: each process passes its LOCAL slice of the global
+        batch (the reference's per-worker ``num_parts/part_index`` data
+        sharding) and the global array is assembled across processes.
+        """
+        out = {}
+        multiproc = jax.process_count() > 1
         try:
-            return {k: jax.device_put(_as_jnp(batch[k]), self._data_sh[k])
-                    for k in self.input_shapes}
+            for k in self.input_shapes:
+                v = _as_jnp(batch[k])
+                if multiproc:
+                    out[k] = jax.make_array_from_process_local_data(
+                        self._data_sh[k], np.asarray(v))
+                else:
+                    out[k] = jax.device_put(v, self._data_sh[k])
         except KeyError as e:
             raise MXNetError("%s: missing input %s" % (what, e))
+        return out
 
     # ------------------------------------------------------------------
     def step(self, batch):
@@ -271,12 +295,21 @@ class ParallelTrainer:
         return self
 
     # ------------------------------------------------------------------
+    def _to_host(self, v):
+        """Gather a (possibly cross-process sharded) array to host."""
+        if not v.is_fully_replicated and jax.process_count() > 1:
+            from jax.sharding import NamedSharding
+            with self.mesh:
+                v = jax.jit(lambda x: x,
+                            out_shardings=NamedSharding(self.mesh, P()))(v)
+        return np.asarray(v)
+
     def get_params(self):
         """Gathered host copies as (arg_params, aux_params) NDArray dicts —
         checkpoint-compatible with FeedForward/save_checkpoint."""
-        arg_params = {n: nd.array(np.asarray(v))
+        arg_params = {n: nd.array(self._to_host(v))
                       for n, v in self.params.items()}
-        aux_params = {n: nd.array(np.asarray(v))
+        aux_params = {n: nd.array(self._to_host(v))
                       for n, v in zip(self.aux_names, self.aux)}
         return arg_params, aux_params
 

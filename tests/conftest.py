@@ -23,3 +23,7 @@ if os.environ.get("MXNET_TPU_TEST_ON_TPU") != "1":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: multi-process / long tests")

@@ -1,0 +1,82 @@
+"""Multi-process worker for test_dist.py (run via tools/launch.py).
+
+The reference's nightly dist test (tests/nightly/dist_sync_kvstore.py)
+asserts exact BSP reduction values across real worker processes on one
+machine; this is the same oracle over jax.distributed + gloo collectives.
+Each check prints an OK line the parent asserts on.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from mxnet_tpu import distributed
+
+distributed.initialize()  # from MXNET_TPU_* env set by tools/launch.py
+
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+
+rank = distributed.rank()
+n = distributed.num_workers()
+assert n > 1, "launch with tools/launch.py -n 2+"
+
+
+def check_kvstore():
+    """push/pull BSP exact values: sum of (rank+1) = n(n+1)/2."""
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == rank and kv.num_workers == n
+    shape = (4, 3)
+    kv.init(9, mx.nd.zeros(shape))
+    kv.push(9, mx.nd.ones(shape) * (rank + 1))
+    out = mx.nd.zeros(shape)
+    kv.pull(9, out)
+    expect = n * (n + 1) / 2
+    np.testing.assert_allclose(out.asnumpy(), expect)
+    # second round on a big (range-partitioned in the reference) array
+    big = (1200,)
+    kv.init(99, mx.nd.zeros(big))
+    kv.push(99, mx.nd.ones(big) * (rank + 1))
+    out = mx.nd.zeros(big)
+    kv.pull(99, out)
+    np.testing.assert_allclose(out.asnumpy(), expect)
+    print("OK kvstore rank=%d" % rank, flush=True)
+
+
+def check_trainer():
+    """Cross-process dp training step matches the single-process oracle
+    (the oracle value is computed by the pytest parent and compared via
+    printed parameter checksum)."""
+    sym_data = mx.symbol.Variable("data")
+    fc = mx.symbol.FullyConnected(data=sym_data, name="fc", num_hidden=4)
+    sym = mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+
+    global_batch = 16
+    local = global_batch // n
+    mesh = par.build_mesh({"dp": len(jax.devices())})
+    trainer = par.ParallelTrainer(
+        sym, {"data": (global_batch, 8), "softmax_label": (global_batch,)},
+        optimizer="sgd", mesh=mesh,
+        optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    rng = np.random.RandomState(123)
+    w = rng.uniform(-0.1, 0.1, (4, 8)).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    trainer.init_params({"fc_weight": mx.nd.array(w),
+                         "fc_bias": mx.nd.array(b)})
+    data = rng.randn(global_batch, 8).astype(np.float32)
+    label = (rng.randint(0, 4, (global_batch,))).astype(np.float32)
+    sl = slice(rank * local, (rank + 1) * local)
+    for _ in range(3):
+        trainer.step({"data": data[sl], "softmax_label": label[sl]})
+    params, _ = trainer.get_params()
+    csum = float(np.abs(params["fc_weight"].asnumpy()).sum())
+    print("OK trainer rank=%d csum=%.6f" % (rank, csum), flush=True)
+
+
+check_kvstore()
+check_trainer()
+distributed.barrier("done")
+print("OK all rank=%d" % rank, flush=True)

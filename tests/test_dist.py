@@ -1,0 +1,57 @@
+"""Multi-process distributed tests: real processes on localhost
+(the reference's nightly strategy — tools/launch.py local tracker +
+exact-value assertions; SURVEY.md §4.5)."""
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_dist_sync_two_workers():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # worker sets its own platform config
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--local-devices", "4", "--",
+         sys.executable, os.path.join(ROOT, "tests", "dist_worker.py")],
+        capture_output=True, text=True, timeout=420, env=env)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    assert out.count("OK kvstore") == 2, out[-4000:]
+    assert out.count("OK all") == 2, out[-4000:]
+    # both workers converge to identical parameters (BSP determinism)…
+    csums = [float(m) for m in re.findall(r"csum=([0-9.]+)", out)]
+    assert len(csums) == 2 and abs(csums[0] - csums[1]) < 1e-5, csums
+
+    # …and to the same parameters as a single-process run on the same
+    # global batch (the cross-process step is semantically one program)
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+
+    sym_data = mx.symbol.Variable("data")
+    fc = mx.symbol.FullyConnected(data=sym_data, name="fc", num_hidden=4)
+    sym = mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+    rng = np.random.RandomState(123)
+    w = rng.uniform(-0.1, 0.1, (4, 8)).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    mesh = par.data_parallel_mesh()
+    trainer = par.ParallelTrainer(
+        sym, {"data": (16, 8), "softmax_label": (16,)},
+        optimizer="sgd", mesh=mesh,
+        optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    trainer.init_params({"fc_weight": mx.nd.array(w),
+                         "fc_bias": mx.nd.array(b)})
+    data = rng.randn(16, 8).astype(np.float32)
+    label = (rng.randint(0, 4, (16,))).astype(np.float32)
+    for _ in range(3):
+        trainer.step({"data": data, "softmax_label": label})
+    params, _ = trainer.get_params()
+    oracle = float(np.abs(params["fc_weight"].asnumpy()).sum())
+    assert abs(csums[0] - oracle) < 1e-4, (csums[0], oracle)

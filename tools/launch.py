@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Launch a multi-process (multi-host-style) training job.
+
+Parity: the reference's ``tools/launch.py`` (ps-lite trackers spawning
+scheduler/server/worker processes with DMLC_* envs). Here every process is
+a worker in one JAX distributed runtime; this launcher assigns
+``MXNET_TPU_COORDINATOR`` / ``MXNET_TPU_RANK`` / ``MXNET_TPU_NUM_WORKERS``.
+
+Local mode (the reference's ``--launcher local`` — also how multi-host is
+tested on one machine):
+  python tools/launch.py -n 4 [--local-devices 2] -- python train.py ...
+
+SSH/cluster mode: run the same command on every host with RANK set by your
+scheduler; on real TPU pods JAX auto-detects and no launcher is needed.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("--local-devices", type=int, default=None,
+                   help="virtual CPU devices per process (local testing)")
+    p.add_argument("--coordinator", default=None,
+                   help="host:port (default: localhost with a free port)")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args()
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no command given")
+    coord = args.coordinator or ("localhost:%d" % _free_port())
+
+    procs = []
+    for r in range(args.num_workers):
+        env = dict(os.environ)
+        env["MXNET_TPU_COORDINATOR"] = coord
+        env["MXNET_TPU_NUM_WORKERS"] = str(args.num_workers)
+        env["MXNET_TPU_RANK"] = str(r)
+        if args.local_devices:
+            env["MXNET_TPU_LOCAL_DEVICES"] = str(args.local_devices)
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for pr in procs:
+        pr.wait()
+        rc = rc or pr.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
