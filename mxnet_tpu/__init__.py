@@ -52,5 +52,10 @@ from .parallel import ParallelTrainer  # noqa: E402
 from . import recordio  # noqa: E402
 from . import image_io  # noqa: E402
 from .image_io import ImageRecordIter  # noqa: E402
+from . import distributed  # noqa: E402
+from . import visualization  # noqa: E402
+from . import rtc  # noqa: E402
+from . import predict  # noqa: E402
+from .predict import Predictor  # noqa: E402
 
 __version__ = "0.1.0"

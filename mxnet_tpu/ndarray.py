@@ -626,19 +626,30 @@ def save(fname, data):
             fo.write(enc)
 
 
+def _load_stream(fi):
+    magic, _ = struct.unpack("<QQ", fi.read(16))
+    if magic != _LIST_MAGIC:
+        raise MXNetError("Invalid NDArray file format")
+    (count,) = struct.unpack("<Q", fi.read(8))
+    arrays = [_load_one(fi) for _ in range(count)]
+    (nkeys,) = struct.unpack("<Q", fi.read(8))
+    if nkeys == 0:
+        return arrays
+    names = []
+    for _ in range(nkeys):
+        (ln,) = struct.unpack("<Q", fi.read(8))
+        names.append(fi.read(ln).decode("utf-8"))
+    return dict(zip(names, arrays))
+
+
 def load(fname):
     """Load a list or dict saved by :func:`save` (or the reference)."""
     with open(fname, "rb") as fi:
-        magic, _ = struct.unpack("<QQ", fi.read(16))
-        if magic != _LIST_MAGIC:
-            raise MXNetError("Invalid NDArray file format")
-        (count,) = struct.unpack("<Q", fi.read(8))
-        arrays = [_load_one(fi) for _ in range(count)]
-        (nkeys,) = struct.unpack("<Q", fi.read(8))
-        if nkeys == 0:
-            return arrays
-        names = []
-        for _ in range(nkeys):
-            (ln,) = struct.unpack("<Q", fi.read(8))
-            names.append(fi.read(ln).decode("utf-8"))
-        return dict(zip(names, arrays))
+        return _load_stream(fi)
+
+
+def load_buffer(data):
+    """Load from in-memory .params bytes (reference
+    MXNDArrayLoadFromBuffer / predict API param bytes)."""
+    import io as _io
+    return _load_stream(_io.BytesIO(data))

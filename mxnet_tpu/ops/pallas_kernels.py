@@ -47,12 +47,17 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
     bq, d = q.shape
     nkb = pl.cdiv(seq_k, block_k)
     if causal:
-        # only blocks up to the diagonal contribute
-        hi = (qi + 1) * block_q
-        nkb = jnp.minimum(nkb, pl.cdiv(hi, block_k))
+        # only blocks up to the diagonal contribute (explicit int32 math:
+        # x64 weak-typing + Mosaic lowering disagree on int promotion)
+        hi = (qi + 1) * jnp.int32(block_q)
+        nkb = jnp.minimum(jnp.int32(nkb),
+                          lax.div(hi + jnp.int32(block_k - 1),
+                                  jnp.int32(block_k)))
+
+    neg_big = jnp.float32(-1e30)  # avoid -inf arithmetic in Mosaic
 
     def body(j, carry):
-        o, l, m = carry
+        o, l, m = carry  # o:[bq,d]  l,m:[bq,1]  (keep 2-D for the VPU)
         k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
@@ -61,32 +66,31 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
         mask = kpos < seq_k  # K padding
         if causal:
             mask = mask & (qpos >= kpos)
-        s = jnp.where(mask, s, -jnp.inf)
-        new_m = jnp.maximum(m, jnp.max(s, axis=1))
-        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
-        p = jnp.where(mask, jnp.exp(s - safe_m[:, None]), 0.0)
-        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
-        new_l = l * corr + jnp.sum(p, axis=1)
-        new_o = o * corr[:, None] + jnp.dot(p, v,
-                                            preferred_element_type=jnp.float32)
+        s = jnp.where(mask, s, neg_big)
+        new_m = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - new_m), 0.0)
+        corr = jnp.exp(m - new_m)
+        new_l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        new_o = o * corr + jnp.dot(p, v, preferred_element_type=jnp.float32)
         return new_o, new_l, new_m
 
     o0 = jnp.zeros((bq, d), jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    m0 = jnp.full((bq, 1), neg_big, jnp.float32)
     o, l, m = lax.fori_loop(0, nkb, body, (o0, l0, m0))
     l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+    o_ref[0] = (o / l).astype(o_ref.dtype)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    """q,k,v: [BH, T, D] (T padded to block multiples)."""
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, true_tk):
+    """q,k,v: [BH, T, D] (T padded to block multiples); true_tk = unpadded
+    key length (padded keys are masked out)."""
     bh, tq, d = q.shape
     tk = k.shape[1]
     grid = (bh, tq // block_q)
     return pl.pallas_call(
         functools.partial(_attn_fwd_kernel, block_q=block_q,
-                          block_k=block_k, seq_k=tk, causal=causal,
+                          block_k=block_k, seq_k=true_tk, causal=causal,
                           scale=scale),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         grid=grid,
@@ -100,34 +104,40 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     )(q, k, v)
 
 
-def _reference_attention(q, k, v, causal, scale):
+def _reference_attention(q, k, v, causal, scale, true_tk):
     """Blockwise-exact attention in plain JAX — supplies the VJP and the
     numerical oracle. [BH, T, D] layout, f32 accumulation."""
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     tq, tk = q.shape[1], k.shape[1]
+    kpos = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+    mask = kpos < true_tk
     if causal:
-        mask = lax.broadcasted_iota(jnp.int32, (tq, tk), 0) >= \
-            lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
-        s = jnp.where(mask[None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
+        mask = mask & (lax.broadcasted_iota(jnp.int32, (tq, tk), 0) >= kpos)
+    s = jnp.where(mask[None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)  # -inf masked entries -> 0
     return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret,
+                true_tk):
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                      true_tk)
 
 
-def _flash_core_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+def _flash_core_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                    true_tk):
+    out = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                     true_tk)
     return out, (q, k, v)
 
 
-def _flash_core_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_core_bwd(causal, scale, block_q, block_k, interpret, true_tk,
+                    res, g):
     q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _reference_attention(a, b, c, causal,
-                                                          scale), q, k, v)
+    _, vjp = jax.vjp(lambda a, b, c: _reference_attention(
+        a, b, c, causal, scale, true_tk), q, k, v)
     return vjp(g)
 
 
@@ -158,7 +168,8 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=128,
         return x
 
     qb, kb, vb = to_bh(q, tq), to_bh(k, tk), to_bh(v, tk)
-    out = _flash_core(qb, kb, vb, causal, scale, block_q, block_k, interpret)
+    out = _flash_core(qb, kb, vb, causal, scale, block_q, block_k, interpret,
+                      tk)
     out = out[:, :tq]
     return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
 

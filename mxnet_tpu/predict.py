@@ -1,0 +1,126 @@
+"""Deployment predictor: the C predict API, TPU-native.
+
+Parity: ``include/mxnet/c_predict_api.h`` + ``src/c_api/c_predict_api.cc``
+— create a predictor from (symbol JSON, parameter bytes), forward only, no
+autodiff machinery. The reference strips its engine down to the naive one
+under MXNET_PREDICT_ONLY; here the analogue is a single pre-compiled XLA
+inference computation with no vjp residuals.
+
+Also covers the amalgamation use case (one self-contained predict path):
+``Predictor`` depends only on the core symbol/ndarray modules.
+"""
+from __future__ import annotations
+
+import io as _io
+
+import numpy as np
+import jax
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .parallel.graph import make_graph_fn
+
+__all__ = ["Predictor"]
+
+
+class Predictor:
+    """Forward-only executor over a frozen graph.
+
+    Parameters
+    ----------
+    symbol_json : str — symbol JSON text or a path to it
+    param_data : bytes | str | dict — .params file bytes, path, or an
+        already-loaded {'arg:name'/'aux:name' -> NDArray} dict
+    input_shapes : dict name -> shape
+    dev_type/dev_id : accepted for API parity (XLA owns placement)
+    """
+
+    def __init__(self, symbol_json, param_data, input_shapes,
+                 dev_type="cpu", dev_id=0):
+        if "{" not in symbol_json:  # path, not JSON text
+            with open(symbol_json) as f:
+                symbol_json = f.read()
+        self._symbol = sym_mod.load_json(symbol_json)
+
+        if isinstance(param_data, dict):
+            save_dict = param_data
+        else:
+            if isinstance(param_data, (bytes, bytearray)):
+                save_dict = nd.load_buffer(bytes(param_data))
+            else:
+                save_dict = nd.load(param_data)
+        arg_params, aux_params = {}, {}
+        for k, v in save_dict.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:  # raw name (predict API accepts both layouts)
+                arg_params[k] = v
+
+        self._input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        arg_names = self._symbol.list_arguments()
+        aux_names = self._symbol.list_auxiliary_states()
+        arg_shapes, out_shapes, aux_shapes = \
+            self._symbol.infer_shape(**self._input_shapes)
+        if arg_shapes is None:
+            raise MXNetError("Predictor: cannot infer shapes")
+        self._out_shapes = out_shapes
+        self._arg_names = arg_names
+        self._params = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in self._input_shapes:
+                continue
+            if name in arg_params:
+                self._params[name] = arg_params[name]._val
+            elif name.endswith("label"):
+                # loss-layer labels are dead inputs at inference (the
+                # reference predictor likewise binds only data inputs)
+                self._params[name] = np.zeros(shape, np.float32)
+            else:
+                raise MXNetError("Predictor: missing parameter %s" % name)
+        self._aux = []
+        for name, shape in zip(aux_names, aux_shapes):
+            if name not in aux_params:
+                raise MXNetError("Predictor: missing aux state %s" % name)
+            self._aux.append(aux_params[name]._val)
+
+        graph_fn = make_graph_fn(self._symbol)
+        params = self._params
+        aux = self._aux
+
+        def run(inputs):
+            vals = [params[n] if n in params else inputs[n]
+                    for n in arg_names]
+            outs, _ = graph_fn(vals, list(aux), False,
+                               jax.random.PRNGKey(0))
+            return outs
+
+        self._run = jax.jit(run)
+        self._outputs = None
+
+    def forward(self, **inputs):
+        """Set inputs and run (reference MXPredForward + MXPredSetInput)."""
+        arrs = {}
+        for k, shape in self._input_shapes.items():
+            if k not in inputs:
+                raise MXNetError("Predictor.forward: missing input %s" % k)
+            v = inputs[k]
+            v = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+            if tuple(v.shape) != shape:
+                raise MXNetError("input %s: shape %s != bound %s"
+                                 % (k, v.shape, shape))
+            arrs[k] = v.astype(np.float32)
+        self._outputs = self._run(arrs)
+        return self
+
+    def get_output(self, index):
+        """Fetch output as numpy (reference MXPredGetOutput)."""
+        if self._outputs is None:
+            raise MXNetError("call forward first")
+        return np.asarray(self._outputs[index])
+
+    @property
+    def num_outputs(self):
+        return len(self._out_shapes)

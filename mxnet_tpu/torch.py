@@ -1,0 +1,101 @@
+"""Torch interop: embed torch modules/criterions as operators.
+
+Parity: ``plugin/torch`` (torch_module-inl.h, torch_criterion-inl.h — Lua
+Torch modules run as MXNet ops) and ``python/mxnet/torch.py`` (torch
+function dispatch on NDArrays). The modern analogue embeds **PyTorch**
+``nn.Module``s: forward/backward run on host through torch autograd,
+bridged into the traced graph with ``jax.pure_callback`` (same design as
+the reference's synchronous NativeOp bridge, operator.py custom ops).
+CPU-torch only — this is an interop escape hatch, not the fast path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+from .operator import PythonOp
+
+__all__ = ["to_torch", "from_torch", "TorchModuleOp", "th_function"]
+
+
+def _torch():
+    try:
+        import torch
+        return torch
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("torch is not available: %s" % e)
+
+
+def to_torch(nd_arr):
+    """NDArray -> torch.Tensor (host copy)."""
+    torch = _torch()
+    return torch.from_numpy(np.ascontiguousarray(nd_arr.asnumpy()))
+
+
+def from_torch(tensor, ctx=None):
+    """torch.Tensor -> NDArray."""
+    return array(tensor.detach().cpu().numpy())
+
+
+def th_function(fn, *nds):
+    """Apply a torch function elementwise-compatibly on NDArrays
+    (reference mxnet.th.* dispatch)."""
+    outs = fn(*[to_torch(x) for x in nds])
+    if isinstance(outs, (list, tuple)):
+        return [from_torch(o) for o in outs]
+    return from_torch(outs)
+
+
+class TorchModuleOp(PythonOp):
+    """Wrap a ``torch.nn.Module`` as a symbolic operator.
+
+    The module's parameters are torch-owned (updated by torch optimizers if
+    desired); the op exposes only data inputs, like the reference's
+    TorchModule with frozen params. Gradients w.r.t. inputs flow back into
+    the surrounding XLA graph.
+    """
+
+    def __init__(self, module, num_inputs=1, need_top_grad=True):
+        super().__init__(need_top_grad=need_top_grad)
+        self.module = module
+        self.num_inputs = num_inputs
+        self._saved = None
+
+    def list_arguments(self):
+        return ["data"] if self.num_inputs == 1 \
+            else ["data%d" % i for i in range(self.num_inputs)]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        torch = _torch()
+        with torch.no_grad():
+            dummies = [torch.zeros(*s) for s in in_shape]
+            out = self.module(*dummies)
+        return in_shape, [list(out.shape)]
+
+    def forward(self, in_data, out_data):
+        torch = _torch()
+        xs = [torch.from_numpy(np.ascontiguousarray(a)).requires_grad_(True)
+              for a in in_data]
+        out = self.module(*xs)
+        self._saved = (xs, out)
+        out_data[0][:] = out.detach().numpy()
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        torch = _torch()
+        xs, out = self._saved if self._saved else (None, None)
+        if xs is None:
+            # recompute (backward without forward in this process)
+            xs = [torch.from_numpy(np.ascontiguousarray(a))
+                  .requires_grad_(True) for a in in_data]
+            out = self.module(*xs)
+        g = torch.from_numpy(np.ascontiguousarray(out_grad[0])) \
+            if out_grad else torch.ones_like(out)
+        grads = torch.autograd.grad(out, xs, grad_outputs=g,
+                                    allow_unused=True)
+        for dst, gt in zip(in_grad, grads):
+            dst[:] = 0 if gt is None else gt.numpy()
+        self._saved = None

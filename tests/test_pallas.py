@@ -1,0 +1,84 @@
+"""Pallas kernel tests (interpreter mode on CPU; same code runs compiled
+on TPU — the backend-consistency oracle)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mxnet_tpu.ops import pallas_kernels as pk
+
+
+def _dense(q, k, v, causal, scale=None):
+    B, T, H, D = q.shape
+    scale = scale or 1.0 / np.sqrt(D)
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        Tk = k.shape[1]
+        mask = np.tril(np.ones((T, Tk), bool), k=Tk - T)
+        s = np.where(mask[None, None], s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("t", [64, 100])
+def test_flash_attention_forward(causal, t):
+    rng = np.random.RandomState(0)
+    q = rng.randn(2, t, 2, 16).astype(np.float32)
+    k = rng.randn(2, t, 2, 16).astype(np.float32)
+    v = rng.randn(2, t, 2, 16).astype(np.float32)
+    out = pk.flash_attention(jnp.array(q), jnp.array(k), jnp.array(v),
+                             causal=causal, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(out), _dense(q, k, v, causal),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_grad():
+    rng = np.random.RandomState(1)
+    q = rng.randn(1, 32, 1, 8).astype(np.float32)
+    k = rng.randn(1, 32, 1, 8).astype(np.float32)
+    v = rng.randn(1, 32, 1, 8).astype(np.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(pk.flash_attention(q, k, v, causal=True,
+                                          block_q=16, block_k=16) ** 2)
+
+    def loss_dense(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(8)
+        mask = np.tril(np.ones((32, 32), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p, v) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(jnp.array(q), jnp.array(k),
+                                                 jnp.array(v))
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(jnp.array(q), jnp.array(k),
+                                                 jnp.array(v))
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_flash_attention_under_jit():
+    rng = np.random.RandomState(2)
+    q = rng.randn(1, 64, 2, 8).astype(np.float32)
+    f = jax.jit(lambda a: pk.flash_attention(a, a, a, causal=True,
+                                             block_q=32, block_k=32))
+    out = f(jnp.array(q))
+    np.testing.assert_allclose(np.asarray(out), _dense(q, q, q, True),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("act", ["linear", "relu", "tanh"])
+def test_fused_linear(act):
+    rng = np.random.RandomState(3)
+    x = rng.randn(50, 40).astype(np.float32)
+    w = rng.randn(40, 30).astype(np.float32)
+    b = rng.randn(30).astype(np.float32)
+    out = pk.fused_linear(jnp.array(x), jnp.array(w), jnp.array(b), act,
+                          block_m=32, block_n=128)
+    ref = x @ w + b
+    ref = {"linear": lambda r: r, "relu": lambda r: np.maximum(r, 0),
+           "tanh": np.tanh}[act](ref)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=1e-4)

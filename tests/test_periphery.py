@@ -1,0 +1,96 @@
+"""Periphery tests: visualization, predictor, rtc (Pallas user kernels),
+torch interop."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import visualization, rtc, predict
+
+
+def _net():
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=16)
+    act = mx.symbol.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.symbol.FullyConnected(data=act, name="fc2", num_hidden=4)
+    return mx.symbol.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def test_network_dot():
+    dot = visualization.network_dot(_net(), shape={"data": (2, 8),
+                                                   "softmax_label": (2,)})
+    assert "digraph" in dot
+    assert "fc1" in dot and "SoftmaxOutput" in dot
+    assert "2x16" in dot  # edge shape annotation
+
+
+def test_print_summary(capsys):
+    total = visualization.print_summary(
+        _net(), shape={"data": (2, 8), "softmax_label": (2,)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "Total params" in out
+    # fc1: 8*16+16, fc2: 16*4+4
+    assert total == 8 * 16 + 16 + 16 * 4 + 4
+
+
+def test_predictor(tmp_path):
+    """Round-trip: train-side checkpoint -> deploy-side Predictor."""
+    sym = _net()
+    shapes = {"data": (3, 8), "softmax_label": (3,)}
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    rng = np.random.RandomState(0)
+    arg_params = {}
+    for name, arr in exe.arg_dict.items():
+        if name not in shapes:
+            v = rng.uniform(-0.3, 0.3, arr.shape).astype(np.float32)
+            arr[:] = v
+            arg_params[name] = mx.nd.array(v)
+    x = rng.randn(3, 8).astype(np.float32)
+    exe.forward(is_train=False, data=x)
+    want = exe.outputs[0].asnumpy()
+
+    prefix = str(tmp_path / "model")
+    mx.model.save_checkpoint(prefix, 1, sym, arg_params, {})
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    with open(prefix + "-0001.params", "rb") as f:
+        param_bytes = f.read()
+    pred = predict.Predictor(sym_json, param_bytes, {"data": (3, 8)})
+    pred.forward(data=x)
+    np.testing.assert_allclose(pred.get_output(0), want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_pallas_op_push():
+    def scale_kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    op = rtc.PallasOp("scale2", scale_kernel,
+                      out_shapes=lambda shapes: [shapes[0]])
+    x = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    (y,) = op.push([x])
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy() * 2)
+
+
+def test_torch_module_op():
+    torch = pytest.importorskip("torch")
+    from mxnet_tpu.torch import TorchModuleOp, to_torch, from_torch
+
+    lin = torch.nn.Linear(6, 3)
+    op = TorchModuleOp(lin)
+    sym = op.get_symbol(mx.symbol.Variable("data"), name="tmod")
+    exe = sym.simple_bind(mx.cpu(), data=(2, 6))
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 6).astype(np.float32)
+    exe.forward(is_train=True, data=x)
+    with torch.no_grad():
+        want = lin(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(), want, rtol=1e-5,
+                               atol=1e-6)
+    # gradient flows back into the graph
+    exe.backward([mx.nd.array(np.ones((2, 3), np.float32))])
+    g = exe.grad_dict["data"].asnumpy()
+    want_g = np.ones((2, 3), np.float32) @ lin.weight.detach().numpy()
+    np.testing.assert_allclose(g, want_g, rtol=1e-5, atol=1e-6)
+    # tensor conversion helpers
+    t = to_torch(mx.nd.array(x))
+    np.testing.assert_array_equal(from_torch(t).asnumpy(), x)
