@@ -1,0 +1,115 @@
+"""Transformer language model (decoder-only) — the long-context flagship.
+
+No reference counterpart (the reference's sequence model is the unrolled
+LSTM, example/rnn/lstm.py); this is the model family that exercises the
+TPU framework's long-context machinery: flash attention (Pallas), ring
+sequence parallelism (parallel/ring.py) and the dp/tp sharding rules.
+Built entirely from registered Symbol ops so it trains through
+FeedForward or ParallelTrainer like every other zoo model.
+"""
+from __future__ import annotations
+
+from .. import symbol as sym
+
+__all__ = ["transformer_block", "get_transformer_lm", "tp_rules"]
+
+
+def transformer_block(data, num_heads, hidden, name, causal=True,
+                      impl="flash", dropout=0.0):
+    """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x)). data: [B,T,E]."""
+    ln1 = sym.LayerNorm(data=data,
+                        gamma=sym.Variable(name + "_ln1_gamma"),
+                        beta=sym.Variable(name + "_ln1_beta"),
+                        name=name + "_ln1")
+    attn = sym.MultiHeadAttention(
+        data=ln1,
+        qkv_weight=sym.Variable(name + "_qkv_weight"),
+        qkv_bias=sym.Variable(name + "_qkv_bias"),
+        out_weight=sym.Variable(name + "_proj_weight"),
+        out_bias=sym.Variable(name + "_proj_bias"),
+        num_heads=num_heads, causal=causal, impl=impl, dropout=dropout,
+        name=name + "_attn")
+    x = data + attn
+    ln2 = sym.LayerNorm(data=x,
+                        gamma=sym.Variable(name + "_ln2_gamma"),
+                        beta=sym.Variable(name + "_ln2_beta"),
+                        name=name + "_ln2")
+    # position-wise FFN as 1x FullyConnected pair over flattened time
+    f1 = sym.FullyConnected(data=ln2, num_hidden=hidden,
+                            name=name + "_ffn1", flatten=False)
+    act = sym.Activation(data=f1, act_type="relu", name=name + "_ffn_relu")
+    f2 = sym.FullyConnected(data=act, num_hidden=0,  # set by caller embed
+                            name=name + "_ffn2", flatten=False)
+    return x + f2
+
+
+def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
+                       ffn_hidden=None, seq_len=None, impl="flash",
+                       dropout=0.0):
+    """Decoder-only LM: Embedding -> N blocks -> tied-free FC -> softmax
+    over vocab per position (multi_output SoftmaxOutput, the reference's
+    per-position softmax mode, softmax_output-inl.h multi_output)."""
+    if ffn_hidden is None:
+        ffn_hidden = 4 * embed_dim
+    data = sym.Variable("data")  # [B, T] int tokens
+    net = sym.Embedding(data=data, input_dim=vocab_size,
+                        output_dim=embed_dim, name="embed")
+    # learned positional embedding via Embedding on position ids is a
+    # host-side concern; keep an additive learned position weight
+    pos = sym.Variable("pos_embed")  # [T, E] broadcast over batch
+    net = net + sym.Reshape(data=pos, target_shape=(0,), name="pos_rs") \
+        if False else net + pos
+    for i in range(num_layers):
+        net = _block(net, num_heads, ffn_hidden, embed_dim,
+                     "layer%d" % i, impl=impl, dropout=dropout)
+    ln_f = sym.LayerNorm(data=net, gamma=sym.Variable("lnf_gamma"),
+                         beta=sym.Variable("lnf_beta"), name="lnf")
+    logits = sym.FullyConnected(data=ln_f, num_hidden=vocab_size,
+                                name="lm_head", flatten=False)
+    # per-position softmax: label [B, T]
+    logits_t = sym.SwapAxis(data=logits, dim1=1, dim2=2, name="logits_t")
+    return sym.SoftmaxOutput(data=logits_t, name="softmax",
+                             multi_output=True)
+
+
+def _block(data, num_heads, hidden, embed_dim, name, impl, dropout):
+    ln1 = sym.LayerNorm(data=data,
+                        gamma=sym.Variable(name + "_ln1_gamma"),
+                        beta=sym.Variable(name + "_ln1_beta"),
+                        name=name + "_ln1")
+    attn = sym.MultiHeadAttention(
+        data=ln1,
+        qkv_weight=sym.Variable(name + "_qkv_weight"),
+        qkv_bias=sym.Variable(name + "_qkv_bias"),
+        out_weight=sym.Variable(name + "_proj_weight"),
+        out_bias=sym.Variable(name + "_proj_bias"),
+        num_heads=num_heads, causal=True, impl=impl, dropout=dropout,
+        name=name + "_attn")
+    x = data + attn
+    ln2 = sym.LayerNorm(data=x,
+                        gamma=sym.Variable(name + "_ln2_gamma"),
+                        beta=sym.Variable(name + "_ln2_beta"),
+                        name=name + "_ln2")
+    f1 = sym.FullyConnected(data=ln2, num_hidden=hidden,
+                            name=name + "_ffn1", flatten=False)
+    act = sym.Activation(data=f1, act_type="relu", name=name + "_ffn_relu")
+    f2 = sym.FullyConnected(data=act, num_hidden=embed_dim,
+                            name=name + "_ffn2", flatten=False)
+    return x + f2
+
+
+def tp_rules():
+    """Tensor-parallel sharding rules for transformer params (Megatron
+    layout: QKV/FFN1 column-parallel, proj/FFN2 row-parallel) — pass to
+    ShardingRules(param_rules=...)."""
+    from ..parallel.shard import P
+    return [
+        (r"_qkv_weight$", P("tp", None)),
+        (r"_qkv_bias$", P("tp")),
+        (r"_ffn1_weight$", P("tp", None)),
+        (r"_ffn1_bias$", P("tp")),
+        (r"_proj_weight$", P(None, "tp")),
+        (r"_ffn2_weight$", P(None, "tp")),
+        (r"embed_weight$", P("tp", None)),
+        (r"lm_head_weight$", P("tp", None)),
+    ]

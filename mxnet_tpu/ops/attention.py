@@ -1,0 +1,121 @@
+"""Attention and normalization operators (TPU-era extensions).
+
+The reference predates attention (its sequence story is explicit LSTM
+unrolling, example/rnn/lstm.py); these ops extend the same declarative
+operator pattern (``registry.OpSpec``) so transformers compose through
+the ordinary Symbol API. The compute path is the Pallas flash-attention
+kernel (ops/pallas_kernels.py) on TPU — interpreter elsewhere — with the
+blockwise recurrence supplying gradients.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import OpSpec, Param, register, shape_assign
+
+
+@register
+class LayerNorm(OpSpec):
+    """Layer normalization over the trailing axis: gamma/beta learnable.
+    (No reference counterpart — BatchNorm is its 2015 relative; kept in
+    the same Param/arguments/infer_shape mold as batch_norm-inl.h.)"""
+
+    name = "LayerNorm"
+    params = {"eps": Param("float", 1e-5)}
+
+    def arguments(self, p):
+        return ["data", "gamma", "beta"]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return list(in_shapes), [None], []
+        c = (d[-1],)
+        return [d, shape_assign(in_shapes[1], c, "LayerNorm gamma"),
+                shape_assign(in_shapes[2], c, "LayerNorm beta")], [d], []
+
+    def forward(self, p, ins, aux, is_train, rng):
+        x, gamma, beta = ins
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mu) * jax.lax.rsqrt(var + p["eps"])
+        return [y * gamma + beta], []
+
+
+@register
+class MultiHeadAttention(OpSpec):
+    """Multi-head self-attention with fused QKV projection.
+
+    data: [B, T, E]; weights: qkv_weight [3E, E], qkv_bias [3E],
+    out_weight [E, E], out_bias [E] (weights laid out ``num_hidden x
+    input`` like FullyConnected, fully_connected-inl.h:148-171).
+
+    ``impl``: flash (Pallas kernel), blockwise (lax.scan recurrence), or
+    dense. Long sequences shard over the ``sp`` mesh axis via
+    ``parallel.ring_attention`` at the trainer level; inside a single
+    program this op is the per-shard compute.
+    """
+
+    name = "MultiHeadAttention"
+    params = {"num_heads": Param("int"),
+              "causal": Param("bool", True),
+              "impl": Param("str", "flash"),
+              "dropout": Param("float", 0.0)}
+
+    def arguments(self, p):
+        return ["data", "qkv_weight", "qkv_bias", "out_weight", "out_bias"]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return list(in_shapes), [None], []
+        if len(d) != 3:
+            raise MXNetError("MultiHeadAttention: data must be [B, T, E]")
+        e = d[2]
+        if e % p["num_heads"] != 0:
+            raise MXNetError("MultiHeadAttention: %d heads do not divide "
+                             "embed dim %d" % (p["num_heads"], e))
+        ins = [d,
+               shape_assign(in_shapes[1], (3 * e, e), "qkv_weight"),
+               shape_assign(in_shapes[2], (3 * e,), "qkv_bias"),
+               shape_assign(in_shapes[3], (e, e), "out_weight"),
+               shape_assign(in_shapes[4], (e,), "out_bias")]
+        return ins, [d], []
+
+    def forward(self, p, ins, aux, is_train, rng):
+        x, wqkv, bqkv, wo, bo = ins
+        b, t, e = x.shape
+        h = p["num_heads"]
+        d = e // h
+        qkv = jnp.einsum("bte,fe->btf", x, wqkv) + bqkv
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(z):
+            return z.reshape(b, t, h, d)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        impl = p["impl"]
+        if impl == "flash":
+            from .pallas_kernels import flash_attention
+            o = flash_attention(q, k, v, causal=p["causal"])
+        elif impl == "blockwise":
+            from ..parallel.ring import blockwise_attention
+            o = blockwise_attention(q, k, v, causal=p["causal"])
+        elif impl == "dense":
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+            if p["causal"]:
+                mask = jnp.tril(jnp.ones((t, t), bool))
+                s = jnp.where(mask[None, None], s, -jnp.inf)
+            o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+        else:
+            raise MXNetError("MultiHeadAttention: unknown impl %r" % impl)
+        o = o.reshape(b, t, e)
+        out = jnp.einsum("bte,fe->btf", o, wo) + bo
+        if is_train and p["dropout"] > 0.0:
+            keep = 1.0 - p["dropout"]
+            mask = jax.random.bernoulli(rng, keep, out.shape)
+            out = jnp.where(mask, out / keep, 0.0)
+        return [out], []
