@@ -1,0 +1,499 @@
+#!/usr/bin/env python
+"""Single-file, dependency-light deployment predictor.
+
+Parity: the reference's ``amalgamation/`` (mxnet_predict-all.cc — the whole
+predict path concatenated into one translation unit with only a BLAS
+dependency, for mobile/embedded deployment; ``amalgamation/README.md:1-30``)
+plus ``include/mxnet/c_predict_api.h`` semantics (create from symbol JSON +
+param bytes, set input, forward, get output — no autodiff, no training).
+
+This is the TPU framework's analogue: ONE Python file whose only dependency
+is numpy. It parses the same symbol JSON and ``.params`` checkpoint format
+as the main framework (bit-compatible with the reference's
+``ndarray.cc:518-640`` list format) and interprets the graph forward-only
+in numpy — for hosts where jax/XLA isn't installed (edge boxes, CI smoke
+machines, hermetic servers). Outputs match ``mxnet_tpu.predict.Predictor``
+(the XLA path) to float tolerance; ``tests/test_periphery.py`` asserts it.
+
+Usage:
+    from mxnet_tpu_predict import Predictor
+    p = Predictor(open("m-symbol.json").read(), open("m-0001.params","rb").read(),
+                  {"data": (1, 3, 224, 224)})
+    p.forward(data=x)
+    out = p.get_output(0)
+
+CLI smoke test:
+    python mxnet_tpu_predict.py m-symbol.json m-0001.params --shape 1,3,224,224
+"""
+from __future__ import annotations
+
+import io
+import json
+import struct
+import sys
+
+import numpy as np
+
+__all__ = ["Predictor", "load_params", "load_symbol"]
+
+
+# ----------------------------------------------------------------------
+# .params checkpoint reader (reference ndarray.cc:518-640 binary format)
+
+_LIST_MAGIC = 0x112
+_DTYPES = {0: np.float32, 1: np.float64, 2: np.float16, 3: np.uint8,
+           4: np.int32}
+
+
+def _load_one(fi):
+    (ndim,) = struct.unpack("<I", fi.read(4))
+    if ndim == 0:
+        return np.zeros((1,), np.float32)
+    shape = struct.unpack("<%dI" % ndim, fi.read(4 * ndim))
+    struct.unpack("<ii", fi.read(8))  # saved context, ignored
+    (type_flag,) = struct.unpack("<i", fi.read(4))
+    dtype = np.dtype(_DTYPES[type_flag])
+    count = int(np.prod(shape))
+    return np.frombuffer(fi.read(count * dtype.itemsize),
+                         dtype=dtype).reshape(shape)
+
+
+def load_params(data):
+    """Read a .params file (path, bytes, or file object) → {name: ndarray}."""
+    if isinstance(data, (bytes, bytearray)):
+        fi = io.BytesIO(data)
+    elif isinstance(data, str):
+        fi = open(data, "rb")
+    else:
+        fi = data
+    magic, _ = struct.unpack("<QQ", fi.read(16))
+    if magic != _LIST_MAGIC:
+        raise ValueError("invalid .params magic 0x%x" % magic)
+    (count,) = struct.unpack("<Q", fi.read(8))
+    arrays = [_load_one(fi) for _ in range(count)]
+    (nkeys,) = struct.unpack("<Q", fi.read(8))
+    names = []
+    for _ in range(nkeys):
+        (ln,) = struct.unpack("<Q", fi.read(8))
+        names.append(fi.read(ln).decode("utf-8"))
+    if nkeys == 0:
+        names = [str(i) for i in range(count)]
+    return dict(zip(names, arrays))
+
+
+# ----------------------------------------------------------------------
+# hyperparameter string parsing (dmlc-style "param" dict values)
+
+def _shape(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return tuple(int(float(x)) for x in
+                 v.strip().strip("()").replace(" ", "").split(",") if x)
+
+
+def _b(v):
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("true", "1")
+
+
+def _i(v):
+    return int(float(v))
+
+
+# ----------------------------------------------------------------------
+# numpy forward kernels (inference mode)
+
+def _im2col(x, kh, kw, sh, sw, ph, pw, dh=1, dw=1):
+    """(N,C,H,W) → (N, C*kh*kw, OH*OW) patches, zero-padded."""
+    n, c, h, w = x.shape
+    eh, ew = dh * (kh - 1) + 1, dw * (kw - 1) + 1
+    oh = (h + 2 * ph - eh) // sh + 1
+    ow = (w + 2 * pw - ew) // sw + 1
+    xp = np.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    s0, s1, s2, s3 = xp.strides
+    view = np.lib.stride_tricks.as_strided(
+        xp, (n, c, kh, kw, oh, ow),
+        (s0, s1, s2 * dh, s3 * dw, s2 * sh, s3 * sw), writeable=False)
+    return view.reshape(n, c * kh * kw, oh * ow), oh, ow
+
+
+def _conv(x, w, b, stride, pad, dilate, groups):
+    nf = w.shape[0]
+    kh, kw = w.shape[2], w.shape[3]
+    n, c = x.shape[0], x.shape[1]
+    outs = []
+    for g in range(groups):
+        xg = x[:, g * (c // groups):(g + 1) * (c // groups)]
+        wg = w[g * (nf // groups):(g + 1) * (nf // groups)]
+        col, oh, ow = _im2col(xg, kh, kw, stride[0], stride[1],
+                              pad[0], pad[1], dilate[0], dilate[1])
+        out = wg.reshape(nf // groups, -1) @ col  # (N, nf/g, OH*OW)
+        outs.append(out.reshape(n, nf // groups, oh, ow))
+    out = np.concatenate(outs, axis=1) if groups > 1 else outs[0]
+    if b is not None:
+        out = out + b[None, :, None, None]
+    return out
+
+
+def _deconv(x, w, b, stride, pad, groups):
+    # transposed conv = dilate input by stride, convolve with flipped
+    # kernel, pad (k-1-p); weight layout (C_in, nf/g, kh, kw)
+    kh, kw = w.shape[2], w.shape[3]
+    n, c, h, wd = x.shape
+    sh, sw = stride
+    xd = np.zeros((n, c, (h - 1) * sh + 1, (wd - 1) * sw + 1), x.dtype)
+    xd[:, :, ::sh, ::sw] = x
+    wf = w[:, :, ::-1, ::-1]
+    cin_g = c // groups
+    outs = []
+    for g in range(groups):
+        xg = xd[:, g * cin_g:(g + 1) * cin_g]
+        # weight (cin_g, nf/g, kh, kw) → conv weight (nf/g, cin_g, kh, kw)
+        wg = wf[g * cin_g:(g + 1) * cin_g].transpose(1, 0, 2, 3)
+        outs.append(_conv(xg, wg, None, (1, 1),
+                          (kh - 1 - pad[0], kw - 1 - pad[1]), (1, 1), 1))
+    out = np.concatenate(outs, axis=1) if groups > 1 else outs[0]
+    if b is not None:
+        out = out + b[None, :, None, None]
+    return out
+
+
+def _pool_osize(h, k, s, p):
+    o = (h + 2 * p - k + s - 1) // s + 1
+    if (o - 1) * s >= h + p:
+        o -= 1
+    return o
+
+
+def _pool(x, kernel, stride, pad, ptype, global_pool):
+    if global_pool:
+        kh, kw = x.shape[2], x.shape[3]
+        sh = sw = 1
+        ph = pw = 0
+    else:
+        kh, kw = kernel
+        sh, sw = stride
+        ph, pw = pad
+    oh = _pool_osize(x.shape[2], kh, sh, ph)
+    ow = _pool_osize(x.shape[3], kw, sw, pw)
+    eh = max((oh - 1) * sh + kh - x.shape[2] - ph, ph)
+    ew = max((ow - 1) * sw + kw - x.shape[3] - pw, pw)
+    fill = -np.inf if ptype == "max" else 0.0
+    xp = np.pad(x.astype(np.float64), ((0, 0), (0, 0), (ph, eh), (pw, ew)),
+                constant_values=fill)
+    s0, s1, s2, s3 = xp.strides
+    view = np.lib.stride_tricks.as_strided(
+        xp, (x.shape[0], x.shape[1], oh, ow, kh, kw),
+        (s0, s1, s2 * sh, s3 * sw, s2, s3), writeable=False)
+    if ptype == "max":
+        out = view.max(axis=(4, 5))
+    else:
+        out = view.sum(axis=(4, 5))
+        if ptype == "avg":
+            out = out / (kh * kw)
+    return out.astype(x.dtype)
+
+
+def _softmax(x, axis):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _sigmoid(x):
+    return np.where(x >= 0, 1.0 / (1.0 + np.exp(-np.clip(x, -88, 88))),
+                    np.exp(np.clip(x, -88, 88)) /
+                    (1.0 + np.exp(np.clip(x, -88, 88)))).astype(x.dtype)
+
+
+def _batchnorm(x, gamma, beta, mmean, mvar, eps, fix_gamma):
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if fix_gamma:
+        gamma = np.ones_like(gamma)
+    inv = 1.0 / np.sqrt(mvar + eps)
+    return ((x - mmean.reshape(shape)) * inv.reshape(shape)
+            * gamma.reshape(shape) + beta.reshape(shape))
+
+
+def _upsample_nearest(ins, scale, mode):
+    th, tw = ins[0].shape[2] * scale, ins[0].shape[3] * scale
+    outs = []
+    for x in ins:
+        fh, fw = th // x.shape[2], tw // x.shape[3]
+        outs.append(np.repeat(np.repeat(x, fh, axis=2), fw, axis=3))
+    if len(outs) == 1:
+        return outs[0]
+    if mode == "sum":
+        return sum(outs[1:], outs[0])
+    return np.concatenate(outs, axis=1)
+
+
+def _crop(ins, p):
+    x = ins[0]
+    if _i(p.get("num_args", 1)) == 2:
+        th, tw = ins[1].shape[2], ins[1].shape[3]
+    else:
+        th, tw = _shape(p.get("h_w", "(0,0)"))
+    if _b(p.get("center_crop", "False")):
+        oy = (x.shape[2] - th) // 2
+        ox = (x.shape[3] - tw) // 2
+    else:
+        oy, ox = _shape(p.get("offset", "(0,0)"))
+    return x[:, :, oy:oy + th, ox:ox + tw]
+
+
+_UNARY = {"abs": np.abs, "sign": np.sign, "round": np.round, "ceil": np.ceil,
+          "floor": np.floor, "square": np.square, "sqrt": np.sqrt,
+          "rsqrt": lambda x: 1.0 / np.sqrt(x), "exp": np.exp, "log": np.log,
+          "cos": np.cos, "sin": np.sin}
+_BINARY = {"_Plus": np.add, "_Minus": np.subtract, "_Mul": np.multiply,
+           "_Div": np.divide, "_Power": np.power, "_Maximum": np.maximum,
+           "_Minimum": np.minimum}
+_SCALAR = {"_PlusScalar": lambda x, s: x + s,
+           "_MinusScalar": lambda x, s: x - s,
+           "_RMinusScalar": lambda x, s: s - x,
+           "_MulScalar": lambda x, s: x * s,
+           "_DivScalar": lambda x, s: x / s,
+           "_RDivScalar": lambda x, s: s / x,
+           "_PowerScalar": lambda x, s: np.power(x, s),
+           "_RPowerScalar": lambda x, s: np.power(s, x),
+           "_MaximumScalar": lambda x, s: np.maximum(x, s),
+           "_MinimumScalar": lambda x, s: np.minimum(x, s)}
+
+
+def _eval_node(op, p, ins):
+    """Inference-mode forward of one graph node → list of outputs."""
+    if op == "FullyConnected":
+        x = ins[0].reshape(ins[0].shape[0], -1)
+        out = x @ ins[1].T
+        if not _b(p.get("no_bias", "False")):
+            out = out + ins[2]
+        return [out]
+    if op == "Convolution":
+        nb = _b(p.get("no_bias", "False"))
+        return [_conv(ins[0], ins[1], None if nb else ins[2],
+                      _shape(p.get("stride", "(1,1)")),
+                      _shape(p.get("pad", "(0,0)")),
+                      _shape(p.get("dilate", "(1,1)")),
+                      _i(p.get("num_group", 1)))]
+    if op == "Deconvolution":
+        nb = _b(p.get("no_bias", "True"))
+        return [_deconv(ins[0], ins[1], None if nb else ins[2],
+                        _shape(p.get("stride", "(1,1)")),
+                        _shape(p.get("pad", "(0,0)")),
+                        _i(p.get("num_group", 1)))]
+    if op == "Activation":
+        t = p["act_type"]
+        x = ins[0]
+        if t == "relu":
+            return [np.maximum(x, 0)]
+        if t == "sigmoid":
+            return [_sigmoid(x)]
+        if t == "tanh":
+            return [np.tanh(x)]
+        if t == "softrelu":
+            return [np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)]
+        raise ValueError("Activation: " + t)
+    if op == "LeakyReLU":
+        t = p.get("act_type", "leaky")
+        x = ins[0]
+        if t == "leaky":
+            return [np.where(x > 0, x, float(p.get("slope", 0.25)) * x)]
+        if t == "elu":
+            return [np.where(x > 0, x,
+                             float(p.get("slope", 0.25)) * (np.exp(x) - 1))]
+        if t == "prelu":
+            g = ins[1].reshape((1, -1) + (1,) * (x.ndim - 2))
+            return [np.where(x > 0, x, g * x)]
+        if t == "rrelu":
+            s = (float(p.get("lower_bound", 0.125)) +
+                 float(p.get("upper_bound", 0.334))) / 2.0
+            return [np.where(x > 0, x, s * x)]
+        raise ValueError("LeakyReLU: " + t)
+    if op == "BatchNorm":
+        return [_batchnorm(ins[0], ins[1], ins[2], ins[3], ins[4],
+                           float(p.get("eps", 1e-3)),
+                           _b(p.get("fix_gamma", "True")))]
+    if op == "Pooling":
+        return [_pool(ins[0], _shape(p["kernel"]),
+                      _shape(p.get("stride", "(1,1)")),
+                      _shape(p.get("pad", "(0,0)")),
+                      p.get("pool_type", "max"),
+                      _b(p.get("global_pool", "False")))]
+    if op == "Dropout":
+        return [ins[0]]  # identity at inference
+    if op == "LRN":
+        x = ins[0]
+        n = _i(p["nsize"])
+        sq = np.square(x)
+        pad = np.pad(sq, ((0, 0), (n // 2, n - 1 - n // 2), (0, 0), (0, 0)))
+        ssum = np.zeros_like(x)
+        for k in range(n):
+            ssum += pad[:, k:k + x.shape[1]]
+        scale = float(p.get("knorm", 2.0)) + \
+            (float(p.get("alpha", 1e-4)) / n) * ssum
+        return [x * np.power(scale, -float(p.get("beta", 0.75)))]
+    if op == "Embedding":
+        return [ins[1][ins[0].astype(np.int32)]]
+    if op == "UpSampling":
+        if p.get("sample_type", "nearest") == "bilinear":
+            s = _i(p["scale"])
+            k = 2 * s - s % 2
+            pad = (s + 1) // 2 - 1 + (k - 1) // 2
+            x, w = ins
+            c = x.shape[1]
+            # depthwise transposed conv, weight (C,1,k,k)
+            outs = [_deconv(x[:, i:i + 1],
+                            w[i:i + 1].transpose(1, 0, 2, 3), None,
+                            (s, s), (pad, pad), 1) for i in range(c)]
+            return [np.concatenate(outs, axis=1)]
+        return [_upsample_nearest(ins, _i(p["scale"]),
+                                  p.get("multi_input_mode", "concat"))]
+    if op in ("SoftmaxOutput", "Softmax"):
+        axis = 1 if _b(p.get("multi_output", "False")) else -1
+        return [_softmax(ins[0], axis)]
+    if op == "SoftmaxActivation":
+        return [_softmax(ins[0], 1 if p.get("mode") == "channel" else -1)]
+    if op in ("LinearRegressionOutput", "MAERegressionOutput"):
+        return [ins[0]]
+    if op == "LogisticRegressionOutput":
+        return [_sigmoid(ins[0])]
+    if op == "IdentityAttachKLSparseReg":
+        return [ins[0]]
+    if op == "ElementWiseSum":
+        out = ins[0]
+        for x in ins[1:]:
+            out = out + x
+        return [out]
+    if op == "Reshape":
+        x = ins[0]
+        tgt = (x.shape[0],) + _shape(p["target_shape"])
+        if 0 in tgt[1:]:
+            known = int(np.prod([t for t in tgt[1:] if t != 0])) * tgt[0]
+            tgt = tuple(x.size // max(known, 1) if t == 0 else t for t in tgt)
+        return [x.reshape(tgt)]
+    if op == "Flatten":
+        return [ins[0].reshape(ins[0].shape[0], -1)]
+    if op == "Concat":
+        return [np.concatenate(ins, axis=_i(p.get("dim", 1)))]
+    if op == "SliceChannel":
+        outs = np.split(ins[0], _i(p["num_outputs"]),
+                        axis=_i(p.get("axis", 1)))
+        if _b(p.get("squeeze_axis", "False")):
+            outs = [np.squeeze(o, axis=_i(p.get("axis", 1))) for o in outs]
+        return list(outs)
+    if op == "SwapAxis":
+        return [np.swapaxes(ins[0], _i(p.get("dim1", 0)),
+                            _i(p.get("dim2", 0)))]
+    if op == "Cast":
+        return [ins[0].astype(np.dtype(p["dtype"]))]
+    if op == "BlockGrad":
+        return [ins[0]]
+    if op == "Crop":
+        return [_crop(ins, p)]
+    if op in _UNARY:
+        return [_UNARY[op](ins[0]).astype(ins[0].dtype)]
+    if op in _BINARY:
+        return [_BINARY[op](ins[0], ins[1])]
+    if op in _SCALAR:
+        return [_SCALAR[op](ins[0], float(p["scalar"])).astype(ins[0].dtype)]
+    raise ValueError("amalgamation predictor: unsupported op %s" % op)
+
+
+# ----------------------------------------------------------------------
+
+def load_symbol(symbol_json):
+    """Parse symbol JSON (reference schema: nodes/arg_nodes/heads)."""
+    if "{" not in symbol_json:
+        with open(symbol_json) as f:
+            symbol_json = f.read()
+    return json.loads(symbol_json)
+
+
+# aux-state argument names, per op, in input order after the data args
+_AUX = {"BatchNorm": ["moving_mean", "moving_var"],
+        "IdentityAttachKLSparseReg": ["moving_avg"]}
+
+
+class Predictor:
+    """Forward-only graph interpreter (MXPredCreate/Forward/GetOutput)."""
+
+    def __init__(self, symbol_json, param_data, input_shapes,
+                 dev_type="cpu", dev_id=0):
+        graph = load_symbol(symbol_json)
+        self._nodes = graph["nodes"]
+        self._heads = [tuple(h[:2]) for h in graph["heads"]]
+        self._input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+
+        if isinstance(param_data, dict):
+            raw = {k: np.asarray(v) for k, v in param_data.items()}
+        else:
+            raw = load_params(param_data)
+        self._params = {}
+        for k, v in raw.items():
+            name = k.split(":", 1)[1] if ":" in k else k
+            self._params[name] = v
+        self._outputs = None
+
+    def forward(self, **inputs):
+        vals = [None] * len(self._nodes)  # per-node list of outputs
+        for i, node in enumerate(self._nodes):
+            op = node["op"]
+            name = node["name"]
+            if op == "null":
+                if name in inputs:
+                    v = np.asarray(inputs[name], np.float32)
+                    want = self._input_shapes.get(name)
+                    if want and tuple(v.shape) != want:
+                        raise ValueError("input %s: shape %s != bound %s"
+                                         % (name, v.shape, want))
+                elif name in self._params:
+                    v = self._params[name]
+                elif name.endswith("label"):
+                    v = np.zeros((1,), np.float32)  # dead loss input
+                else:
+                    raise ValueError("missing parameter %s" % name)
+                vals[i] = [v]
+            else:
+                ins = [vals[src][idx] for src, idx, *_ in node["inputs"]]
+                # aux states (moving stats) aren't graph inputs — they're
+                # loaded from the checkpoint by "{node}_{aux}" name, the
+                # same contract as Symbol.list_auxiliary_states()
+                for aux_arg in _AUX.get(op, ()):
+                    aux_name = "%s_%s" % (name, aux_arg)
+                    if aux_name not in self._params:
+                        raise ValueError("missing aux state %s" % aux_name)
+                    ins.append(self._params[aux_name])
+                vals[i] = _eval_node(op, node.get("param", {}), ins)
+        self._outputs = [vals[nid][idx] for nid, idx in self._heads]
+        return self
+
+    def get_output(self, index):
+        if self._outputs is None:
+            raise RuntimeError("call forward first")
+        return self._outputs[index]
+
+    @property
+    def num_outputs(self):
+        return len(self._heads)
+
+
+def main(argv):
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("symbol")
+    ap.add_argument("params")
+    ap.add_argument("--shape", required=True,
+                    help="input shape, e.g. 1,3,224,224")
+    ap.add_argument("--input-name", default="data")
+    args = ap.parse_args(argv)
+    shape = tuple(int(x) for x in args.shape.split(","))
+    pred = Predictor(args.symbol, args.params, {args.input_name: shape})
+    x = np.random.RandomState(0).rand(*shape).astype(np.float32)
+    pred.forward(**{args.input_name: x})
+    out = pred.get_output(0)
+    print("output[0] shape=%s mean=%.6f" % (out.shape, float(out.mean())))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,89 @@
+"""Amalgamation predictor tests: the numpy-only single-file deployment path
+(amalgamation/mxnet_tpu_predict.py) must match the XLA executor on real
+models — the analogue of the reference's amalgamated predict path being the
+same code as libmxnet's (amalgamation/README.md)."""
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import (get_inception_bn_small, get_lenet, get_resnet_cifar)
+
+_AMAL = os.path.join(os.path.dirname(__file__), os.pardir,
+                     "amalgamation", "mxnet_tpu_predict.py")
+spec = importlib.util.spec_from_file_location("mxnet_tpu_predict", _AMAL)
+amal = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(amal)
+
+
+def _check_model(sym, shapes, tmp_path, atol=1e-4):
+    """Bind on XLA, checkpoint, reload through the amalgamation path."""
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    rng = np.random.RandomState(0)
+    arg_params, aux_params = {}, {}
+    for name, arr in exe.arg_dict.items():
+        if name not in shapes:
+            v = rng.uniform(-0.2, 0.2, arr.shape).astype(np.float32)
+            arr[:] = v
+            arg_params[name] = mx.nd.array(v)
+    for name, arr in exe.aux_dict.items():
+        v = rng.uniform(0.5, 1.0, arr.shape).astype(np.float32)
+        arr[:] = v
+        aux_params[name] = mx.nd.array(v)
+    data = rng.randn(*shapes["data"]).astype(np.float32)
+    exe.forward(is_train=False, data=data)
+    want = exe.outputs[0].asnumpy()
+
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 1, sym, arg_params, aux_params)
+    pred = amal.Predictor(prefix + "-symbol.json",
+                          prefix + "-0001.params",
+                          {"data": shapes["data"]})
+    pred.forward(data=data)
+    got = pred.get_output(0)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=atol)
+
+
+def test_amalgamation_lenet(tmp_path):
+    _check_model(get_lenet(num_classes=10),
+                 {"data": (2, 1, 28, 28), "softmax_label": (2,)}, tmp_path)
+
+
+def test_amalgamation_inception_bn(tmp_path):
+    """Covers Convolution, BatchNorm aux loading, Pooling ceil-mode,
+    Concat — the full Inception-BN op mix."""
+    _check_model(get_inception_bn_small(num_classes=10),
+                 {"data": (2, 3, 28, 28), "softmax_label": (2,)}, tmp_path)
+
+
+def test_amalgamation_resnet(tmp_path):
+    _check_model(get_resnet_cifar(num_classes=10, n=1),
+                 {"data": (2, 3, 32, 32), "softmax_label": (2,)}, tmp_path)
+
+
+def test_amalgamation_structural_ops(tmp_path):
+    """SliceChannel/SwapAxis/Crop/scalar ops/unary zoo path."""
+    d = mx.symbol.Variable("data")
+    a, b = mx.symbol.SliceChannel(data=d, num_outputs=2, name="sl")
+    x = mx.symbol.SwapAxis(data=a * 2.0 + 1.0, dim1=2, dim2=3, name="sw")
+    y = mx.symbol.sqrt(mx.symbol.abs(b) + 1e-3)
+    y = mx.symbol.SwapAxis(data=y, dim1=2, dim2=3)
+    out = mx.symbol.Flatten(data=x + y, name="fl")
+    sym = mx.symbol.LinearRegressionOutput(
+        data=mx.symbol.FullyConnected(data=out, num_hidden=3, name="fc"),
+        name="lro")
+    _check_model(sym, {"data": (2, 4, 5, 6), "lro_label": (2, 3)}, tmp_path)
+
+
+def test_amalgamation_is_standalone():
+    """The file must not import jax or mxnet_tpu (numpy-only contract)."""
+    import re
+    src = open(_AMAL).read()
+    imports = re.findall(r"^\s*(?:import|from)\s+([\w.]+)", src, re.M)
+    roots = {m.split(".")[0] for m in imports}
+    assert roots <= {"io", "json", "struct", "sys", "numpy",
+                     "argparse", "__future__"}, roots

@@ -90,3 +90,23 @@ def test_get_type():
     kvtype = "local_allreduce_cpu"
     kv = mx.kv.create(kvtype)
     assert kv.type == kvtype
+
+
+def test_kvstore_server_commands():
+    """KVStoreServer command surface (reference kvstore_server.py:14-55):
+    head 0 installs a pickled optimizer as updater; head 1 (sync mode) is
+    accepted; negative head stops."""
+    import pickle
+    from mxnet_tpu.kvstore_server import KVStoreServer
+
+    kv = mx.kv.create("local")
+    server = KVStoreServer(kv)
+    sgd = mx.optimizer.create("sgd", learning_rate=0.1)
+    server._controller(0, pickle.dumps(sgd))
+    assert kv._updater is not None
+    server._controller(1, b"")
+    server._controller(-1, b"")
+    assert not server._running
+
+    # worker-role import is a no-op (does not sys.exit)
+    import mxnet_tpu.kvstore_server  # noqa: F401
