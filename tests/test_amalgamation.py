@@ -85,5 +85,5 @@ def test_amalgamation_is_standalone():
     src = open(_AMAL).read()
     imports = re.findall(r"^\s*(?:import|from)\s+([\w.]+)", src, re.M)
     roots = {m.split(".")[0] for m in imports}
-    assert roots <= {"io", "json", "struct", "sys", "numpy",
-                     "argparse", "__future__"}, roots
+    assert roots <= {"io", "json", "struct", "sys", "numpy", "argparse",
+                     "__future__", "mxnet_tpu_predict"}, roots
