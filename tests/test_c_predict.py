@@ -1,0 +1,69 @@
+"""End-to-end test of the native C predict ABI: build the example C
+client against libmxnet_tpu_predict.so (CPython-embedding implementation
+of the reference's c_predict_api.h), feed it a checkpoint produced by the
+Python side, and compare outputs — the analogue of the reference's
+tests/python/predict/ smoke test, but crossing the real C boundary."""
+import os
+import shutil
+import struct
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+LIB = os.path.join(ROOT, "mxnet_tpu", "lib", "libmxnet_tpu_predict.so")
+EXE = os.path.join(ROOT, "cpp", "example", "predict_example")
+
+
+def _build():
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        return False
+    r = subprocess.run(["make", "-C", os.path.join(ROOT, "cpp"),
+                        "example/predict_example"],
+                       capture_output=True, text=True)
+    return r.returncode == 0 and os.path.exists(EXE)
+
+
+@pytest.mark.skipif(not (os.path.exists(LIB) or _build()),
+                    reason="native predict library not built")
+def test_c_predict_end_to_end(tmp_path):
+    if not os.path.exists(EXE) and not _build():
+        pytest.skip("cannot build example client")
+
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=8)
+    act = mx.symbol.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.symbol.FullyConnected(data=act, name="fc2", num_hidden=3)
+    sym = mx.symbol.SoftmaxOutput(data=fc2, name="softmax")
+
+    shapes = {"data": (2, 6), "softmax_label": (2,)}
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    rng = np.random.RandomState(42)
+    arg_params = {}
+    for name, arr in exe.arg_dict.items():
+        if name not in shapes:
+            v = rng.uniform(-0.5, 0.5, arr.shape).astype(np.float32)
+            arr[:] = v
+            arg_params[name] = mx.nd.array(v)
+    x = rng.randn(2, 6).astype(np.float32)
+    exe.forward(is_train=False, data=x)
+    want = exe.outputs[0].asnumpy()
+
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 1, sym, arg_params, {})
+
+    env = dict(os.environ)
+    # the amalgamation numpy path keeps the subprocess jax-free and fast
+    env["MXNET_TPU_PREDICT_NUMPY"] = "1"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [EXE, prefix + "-symbol.json", prefix + "-0001.params", "2", "6"],
+        input=x.astype("<f4").tobytes(), capture_output=True, env=env,
+        timeout=240)
+    assert r.returncode == 0, r.stderr.decode()
+    got = np.array([float(t) for t in r.stdout.split()],
+                   dtype=np.float32).reshape(2, 3)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
