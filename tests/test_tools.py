@@ -1,0 +1,227 @@
+"""Tests for the tools suite: make_list, parse_log, caffe converter
+(prototxt + binary caffemodel wire parsing), AccNN low-rank surgery —
+the reference's tools/ directory rebuilt (SURVEY.md §2.9)."""
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, ROOT)
+
+from tools import make_list, parse_log
+from tools.caffe_converter.prototxt import parse_prototxt, parse_caffemodel
+from tools.caffe_converter.convert_symbol import proto2symbol
+from tools.caffe_converter.convert_model import convert_model
+from tools.accnn.accnn import accelerate, decompose_conv, decompose_fc
+from tools.accnn.rank_selection import select_ranks
+
+
+# ----------------------------------------------------------------------
+def test_make_list(tmp_path):
+    for cls in ("cat", "dog"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(4):
+            (d / ("%d.jpg" % i)).write_bytes(b"x")
+    out = make_list.make_lists(str(tmp_path / "imgs"),
+                               str(tmp_path / "out"), train_ratio=0.75)
+    train = (tmp_path / "out_train.lst").read_text().strip().splitlines()
+    val = (tmp_path / "out_val.lst").read_text().strip().splitlines()
+    assert len(train) == 6 and len(val) == 2
+    cols = train[0].split("\t")
+    assert len(cols) == 3 and cols[1] in ("0", "1")
+
+
+def test_parse_log(tmp_path):
+    log = """INFO Epoch[0] Train-accuracy=0.51
+INFO Epoch[0] Time cost=12.3
+INFO Epoch[0] Validation-accuracy=0.61
+INFO Epoch[1] Train-accuracy=0.72 time=10.1
+INFO Epoch[1] Validation-accuracy=0.70
+"""
+    data = parse_log.parse(log.splitlines())
+    assert data[0] == {"train": 0.51, "time": 12.3, "val": 0.61}
+    assert data[1]["train"] == 0.72 and data[1]["time"] == 10.1
+    md = parse_log.to_markdown(data)
+    assert "| 0 |" in md and "0.700000" in md
+
+
+# ----------------------------------------------------------------------
+_PROTOTXT = """
+name: "TinyNet"
+input: "data"
+input_dim: 1
+input_dim: 3
+input_dim: 8
+input_dim: 8
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 stride: 1 } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+  inner_product_param { num_output: 5 } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip1" bottom: "label" }
+"""
+
+
+def test_parse_prototxt():
+    net = parse_prototxt(_PROTOTXT)
+    assert net["name"] == "TinyNet"
+    assert len(net["layer"]) == 5
+    conv = net["layer"][0]
+    assert conv["convolution_param"]["num_output"] == 4
+    assert conv["convolution_param"]["kernel_size"] == [3]
+
+
+def test_convert_symbol():
+    sym, input_name = proto2symbol(_PROTOTXT)
+    args = sym.list_arguments()
+    assert "conv1_weight" in args and "ip1_weight" in args
+    arg_shapes, out_shapes, _ = sym.infer_shape(data=(2, 3, 8, 8))
+    assert out_shapes[0] == (2, 5)
+
+
+# --- minimal caffemodel wire-format writer for round-trip testing ------
+def _varint(n):
+    out = b""
+    while True:
+        b7 = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b7 | 0x80])
+        else:
+            out += bytes([b7])
+            return out
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _ld(field, payload):
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _blob(shape, data):
+    shp = b"".join(_varint(d) for d in shape)
+    packed = struct.pack("<%df" % len(data), *data)
+    return _ld(7, _ld(1, shp)) + _ld(5, packed)
+
+
+def _layer(name, ltype, blobs):
+    payload = _ld(1, name.encode()) + _ld(2, ltype.encode())
+    for shape, data in blobs:
+        payload += _ld(7, _blob(shape, data))
+    return _ld(100, payload)
+
+
+def test_convert_model(tmp_path):
+    rng = np.random.RandomState(0)
+    conv_w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    conv_b = rng.randn(4).astype(np.float32)
+    ip_w = rng.randn(5, 4 * 4 * 4).astype(np.float32)
+    ip_b = rng.randn(5).astype(np.float32)
+    model = _ld(1, b"TinyNet") \
+        + _layer("conv1", "Convolution",
+                 [(conv_w.shape, conv_w.ravel()), ((4,), conv_b)]) \
+        + _layer("ip1", "InnerProduct",
+                 [(ip_w.shape, ip_w.ravel()), ((5,), ip_b)])
+    net = parse_caffemodel(model)
+    assert [l["name"] for l in net["layer"]] == ["conv1", "ip1"]
+
+    prefix = str(tmp_path / "converted")
+    sym, arg_params, aux_params = convert_model(_PROTOTXT, model, prefix)
+    np.testing.assert_allclose(arg_params["conv1_weight"].asnumpy(), conv_w)
+    np.testing.assert_allclose(arg_params["ip1_bias"].asnumpy(), ip_b)
+    assert os.path.exists(prefix + "-symbol.json")
+
+    # converted checkpoint must actually run
+    sym2, args2, aux2 = mx.model.load_checkpoint(prefix, 0)
+    exe = sym2.simple_bind(mx.cpu(), grad_req="null", data=(2, 3, 8, 8),
+                           loss_label=(2,))
+    for k, v in args2.items():
+        exe.arg_dict[k][:] = v.asnumpy()
+    exe.forward(is_train=False, data=np.ones((2, 3, 8, 8), np.float32))
+    assert exe.outputs[0].shape == (2, 5)
+
+
+# ----------------------------------------------------------------------
+def test_decompose_conv_reconstruction():
+    rng = np.random.RandomState(1)
+    w = rng.randn(6, 3, 3, 3).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+    # full rank: reconstruction must be near-exact
+    K = min(3 * 3, 6 * 3)
+    v_w, v_b, h_w, h_b = decompose_conv(w, b, K)
+    # V then H applied to an impulse reproduces the original kernel
+    C, kh, kw = 3, 3, 3
+    recon = np.einsum("kcij,nkjl->ncil", v_w, h_w)
+    np.testing.assert_allclose(recon, w, atol=1e-4)
+
+
+def test_decompose_fc_reconstruction():
+    rng = np.random.RandomState(2)
+    w = rng.randn(8, 10).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    W1, b1, W2, b2 = decompose_fc(w, b, 8)
+    np.testing.assert_allclose(W2 @ W1, w, atol=1e-4)
+    np.testing.assert_allclose(b2, b)
+
+
+def test_accnn_graph_surgery():
+    """Full-rank decomposition must preserve network outputs."""
+    data = mx.symbol.Variable("data")
+    conv = mx.symbol.Convolution(data=data, name="conv1", kernel=(3, 3),
+                                 num_filter=4, pad=(1, 1))
+    act = mx.symbol.Activation(data=conv, name="relu1", act_type="relu")
+    fc = mx.symbol.FullyConnected(data=mx.symbol.Flatten(data=act),
+                                  name="fc1", num_hidden=6)
+    sym = mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+
+    shapes = {"data": (2, 3, 6, 6), "softmax_label": (2,)}
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    rng = np.random.RandomState(3)
+    arg_params = {}
+    for name, arr in exe.arg_dict.items():
+        if name not in shapes:
+            v = rng.uniform(-0.4, 0.4, arr.shape).astype(np.float32)
+            arr[:] = v
+            arg_params[name] = mx.nd.array(v)
+    x = rng.randn(*shapes["data"]).astype(np.float32)
+    exe.forward(is_train=False, data=x)
+    want = exe.outputs[0].asnumpy()
+
+    # full rank → exact; conv K = min(C*kh, N*kw) = min(9, 12) = 9
+    ranks = {"conv1": 9, "fc1": 6}
+    new_sym, new_args, _ = accelerate(sym, arg_params, {}, ranks)
+    assert "conv1_v_weight" in new_sym.list_arguments()
+    exe2 = new_sym.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    for name, arr in new_args.items():
+        exe2.arg_dict[name][:] = arr.asnumpy()
+    exe2.forward(is_train=False, data=x)
+    np.testing.assert_allclose(exe2.outputs[0].asnumpy(), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rank_selection():
+    data = mx.symbol.Variable("data")
+    conv = mx.symbol.Convolution(data=data, name="conv1", kernel=(3, 3),
+                                 num_filter=8)
+    sym = mx.symbol.SoftmaxOutput(
+        data=mx.symbol.Flatten(data=conv), name="softmax")
+    rng = np.random.RandomState(4)
+    # near-rank-1 weight: energy criterion should pick a tiny K
+    u = rng.randn(3 * 3, 1)
+    v = rng.randn(1, 8 * 3)
+    w = (u @ v).reshape(3, 3, 8, 3).transpose(2, 0, 1, 3) \
+        .astype(np.float32)  # (N,C,kh,kw) = (8,3,3,3), rank-1 as (C*kh, N*kw)
+    arg_params = {"conv1_weight": mx.nd.array(np.ascontiguousarray(w))}
+    ranks = select_ranks(sym, arg_params, ratio=0.95)
+    assert ranks["conv1"] <= 2

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""AccNN: accelerate a trained network by low-rank decomposition.
+
+Parity: the reference's ``tools/accnn`` (acc_conv.py VH conv
+decomposition, acc_fc.py FC factorization, rank_selection.py) — replace a
+k×k convolution with a (k×1) "V" conv of K filters followed by a (1×k)
+"H" conv (Jaderberg et al.), and an FC layer with two rank-K FCs; ranks
+chosen by singular-value energy or a global speedup ratio.
+
+TPU note: this is a *capability* port — on TPU the MXU often makes the
+original fused k×k conv faster than two thin convs, so AccNN here is the
+model-size/bandwidth tool (smaller params → less HBM traffic), not the
+latency tool it was on 2015 GPUs. The graph surgery operates on symbol
+JSON and rebuilds Symbols through the public registry.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                os.pardir, os.pardir))
+
+import mxnet_tpu as mx
+from mxnet_tpu.symbol import _create, Variable
+
+try:
+    from .rank_selection import select_ranks
+except ImportError:
+    from rank_selection import select_ranks
+
+
+def _parse_shape(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return tuple(int(float(x)) for x in
+                 str(v).strip("()").replace(" ", "").split(",") if x)
+
+
+def decompose_conv(weight, bias, K):
+    """k×k conv (N,C,kh,kw) → V (K,C,kh,1), H (N,K,1,kw) via SVD.
+
+    Follows Jaderberg-style separable reconstruction (reference
+    acc_conv.py conv_vh_decomposition): stack W as (C*kh, N*kw), SVD,
+    split sqrt singular values between the factors.
+    """
+    N, C, kh, kw = weight.shape
+    Wm = weight.transpose(1, 2, 0, 3).reshape(C * kh, N * kw)
+    U, D, Qt = np.linalg.svd(Wm, full_matrices=False)
+    sq = np.sqrt(D[:K])
+    V = (U[:, :K] * sq)          # (C*kh, K)
+    H = (Qt[:K, :].T * sq)       # (N*kw, K)
+    v_w = V.T.reshape(K, C, kh, 1)
+    h_w = H.reshape(N, kw, 1, K).transpose(0, 3, 2, 1)  # (N,K,1,kw)
+    v_b = np.zeros((K,), np.float32)
+    h_b = bias if bias is not None else np.zeros((N,), np.float32)
+    return v_w.astype(np.float32), v_b, h_w.astype(np.float32), h_b
+
+
+def decompose_fc(weight, bias, K):
+    """FC (out,in) → W1 (K,in), W2 (out,K) via truncated SVD (acc_fc.py)."""
+    U, D, Qt = np.linalg.svd(weight, full_matrices=False)
+    sq = np.sqrt(D[:K])
+    W2 = (U[:, :K] * sq).astype(np.float32)          # (out, K)
+    W1 = (sq[:, None] * Qt[:K, :]).astype(np.float32)  # (K, in)
+    b1 = np.zeros((K,), np.float32)
+    b2 = bias if bias is not None else np.zeros((weight.shape[0],),
+                                                np.float32)
+    return W1, b1, W2, b2
+
+
+def accelerate(symbol, arg_params, aux_params, ranks):
+    """Rebuild the graph with decomposed layers.
+
+    ``ranks``: {layer_name: K}. Returns (new_symbol, new_args, new_aux).
+    """
+    graph = json.loads(symbol.tojson())
+    nodes = graph["nodes"]
+    new_args = {k: v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+                for k, v in arg_params.items()}
+    out_syms = [None] * len(nodes)  # node id -> list of Symbols
+    var_cache = {}
+
+    def get_var(name):
+        if name not in var_cache:
+            var_cache[name] = Variable(name)
+        return var_cache[name]
+
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            out_syms[i] = [get_var(name)]
+            continue
+        ins = [out_syms[src][idx] for src, idx, *_ in node["inputs"]]
+        p = dict(node.get("param", {}))
+        if op == "Convolution" and name in ranks \
+                and _parse_shape(p["kernel"]) > (1, 1):
+            K = ranks[name]
+            w = new_args.pop(name + "_weight")
+            b = new_args.pop(name + "_bias", None)
+            v_w, v_b, h_w, h_b = decompose_conv(w, b, K)
+            kh, kw = _parse_shape(p["kernel"])
+            sh, sw = _parse_shape(p.get("stride", "(1,1)"))
+            ph, pw = _parse_shape(p.get("pad", "(0,0)"))
+            sv = _create("Convolution", [ins[0]], {
+                "name": name + "_v", "kernel": (kh, 1), "stride": (sh, 1),
+                "pad": (ph, 0), "num_filter": K})
+            sh_sym = _create("Convolution", [sv], {
+                "name": name + "_h", "kernel": (1, kw), "stride": (1, sw),
+                "pad": (0, pw), "num_filter": w.shape[0]})
+            new_args[name + "_v_weight"] = v_w
+            new_args[name + "_v_bias"] = v_b
+            new_args[name + "_h_weight"] = h_w
+            new_args[name + "_h_bias"] = h_b
+            out_syms[i] = [sh_sym]
+            continue
+        if op == "FullyConnected" and name in ranks:
+            K = ranks[name]
+            w = new_args.pop(name + "_weight")
+            b = new_args.pop(name + "_bias", None)
+            W1, b1, W2, b2 = decompose_fc(w, b, K)
+            s1 = _create("FullyConnected", [ins[0]],
+                         {"name": name + "_red", "num_hidden": K})
+            s2 = _create("FullyConnected", [s1],
+                         {"name": name + "_rec",
+                          "num_hidden": w.shape[0]})
+            new_args[name + "_red_weight"] = W1
+            new_args[name + "_red_bias"] = b1
+            new_args[name + "_rec_weight"] = W2
+            new_args[name + "_rec_bias"] = b2
+            out_syms[i] = [s2]
+            continue
+        # pass-through: re-create the node as-is
+        kwargs = dict(p)
+        kwargs["name"] = name
+        out_syms[i] = list(_create(op, ins, kwargs))
+
+    heads = [out_syms[nid][idx] for nid, idx in
+             (tuple(h[:2]) for h in graph["heads"])]
+    new_sym = heads[0] if len(heads) == 1 else mx.symbol.Group(heads)
+    args_nd = {k: mx.nd.array(v) for k, v in new_args.items()}
+    return new_sym, args_nd, dict(aux_params)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="input checkpoint prefix")
+    p.add_argument("epoch", type=int)
+    p.add_argument("out_prefix")
+    p.add_argument("--ratio", type=float, default=0.9,
+                   help="singular-value energy to keep per layer")
+    p.add_argument("--layers", nargs="*", default=None,
+                   help="only decompose these layers")
+    args = p.parse_args()
+    sym, arg_params, aux_params = mx.model.load_checkpoint(args.prefix,
+                                                           args.epoch)
+    ranks = select_ranks(sym, arg_params, args.ratio, args.layers)
+    new_sym, new_args, new_aux = accelerate(sym, arg_params, aux_params,
+                                            ranks)
+    mx.model.save_checkpoint(args.out_prefix, 0, new_sym, new_args, new_aux)
+    print("ranks:", ranks)
+    print("saved %s-symbol.json, %s-0000.params"
+          % (args.out_prefix, args.out_prefix))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Rank selection for AccNN low-rank decomposition.
+
+Parity: the reference's ``tools/accnn/rank_selection.py``, which picks
+per-layer ranks by singular-value spectra subject to a global speedup
+budget (dynamic programming over eigenvalue energies). Here the criterion
+is per-layer singular-value energy: keep the smallest K whose squared
+singular values sum to ``ratio`` of the total — same spectra, simpler
+selection, rank capped to keep the factorized layer no larger than the
+original.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+__all__ = ["select_ranks"]
+
+
+def _energy_rank(svals, ratio):
+    e = np.asarray(svals, np.float64) ** 2
+    total = e.sum()
+    if total <= 0:
+        return 1
+    c = np.cumsum(e) / total
+    return int(np.searchsorted(c, ratio) + 1)
+
+
+def select_ranks(symbol, arg_params, ratio=0.9, only_layers=None):
+    """→ {layer_name: K} for Convolution (k>1) and FullyConnected layers."""
+    graph = json.loads(symbol.tojson())
+    ranks = {}
+    for node in graph["nodes"]:
+        op, name = node["op"], node["name"]
+        if only_layers and name not in only_layers:
+            continue
+        wname = name + "_weight"
+        if wname not in arg_params:
+            continue
+        w = arg_params[wname]
+        w = w.asnumpy() if hasattr(w, "asnumpy") else np.asarray(w)
+        if op == "Convolution":
+            kernel = node.get("param", {}).get("kernel", "(1,1)")
+            ks = tuple(int(float(x)) for x in
+                       str(kernel).strip("()").split(",") if x.strip())
+            if max(ks) <= 1:
+                continue
+            N, C, kh, kw = w.shape
+            Wm = w.transpose(1, 2, 0, 3).reshape(C * kh, N * kw)
+            svals = np.linalg.svd(Wm, compute_uv=False)
+            K = _energy_rank(svals, ratio)
+            # factorized cost ~ K*(C*kh + N*kw); don't exceed original N*C*kh*kw
+            K = min(K, max(1, (N * C * kh * kw) // (C * kh + N * kw)))
+            ranks[name] = max(K, 1)
+        elif op == "FullyConnected":
+            svals = np.linalg.svd(w, compute_uv=False)
+            K = _energy_rank(svals, ratio)
+            out_d, in_d = w.shape
+            K = min(K, max(1, (out_d * in_d) // (out_d + in_d)))
+            ranks[name] = max(K, 1)
+    return ranks

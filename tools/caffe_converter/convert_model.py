@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Convert Caffe (prototxt, caffemodel) to an mxnet_tpu checkpoint.
+
+Parity: the reference's ``tools/caffe_converter/convert_model.py``
+(weight mapping: conv weight (N,C,H,W) and IP weight (num_output, dim)
+carry over directly; caffe pair BatchNorm[mean,var,scale_factor] +
+Scale[gamma,beta] folds into one BatchNorm's aux/arg states). Produces
+``prefix-symbol.json`` + ``prefix-0000.params`` loadable by
+``FeedForward.load`` / the predictors.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                os.pardir, os.pardir))
+
+import mxnet_tpu as mx
+
+try:
+    from .prototxt import parse_caffemodel
+    from .convert_symbol import proto2symbol
+except ImportError:  # executed as a script
+    from prototxt import parse_caffemodel
+    from convert_symbol import proto2symbol
+
+
+def convert_model(prototxt, caffemodel, prefix=None):
+    """→ (symbol, arg_params, aux_params). Writes checkpoint if prefix."""
+    sym, _ = proto2symbol(prototxt)
+    net = parse_caffemodel(caffemodel)
+
+    arg_names = set(sym.list_arguments())
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params, aux_params = {}, {}
+    # caffe BatchNorm layer's following Scale layer carries gamma/beta;
+    # remember each BatchNorm's name to attach them
+    last_bn = None
+    for lay in net["layer"]:
+        name = str(lay["name"]).replace("/", "_")
+        ltype = lay["type"]
+        blobs = [np.asarray(d, np.float32).reshape(s)
+                 for s, d in lay["blobs"]]
+        if not blobs:
+            continue
+        if ltype in ("Convolution", "Deconvolution", 4) :
+            arg_params[name + "_weight"] = mx.nd.array(blobs[0])
+            if len(blobs) > 1 and name + "_bias" in arg_names:
+                arg_params[name + "_bias"] = mx.nd.array(blobs[1].ravel())
+        elif ltype in ("InnerProduct", 14):
+            arg_params[name + "_weight"] = mx.nd.array(
+                blobs[0].reshape(blobs[0].shape[-2:])
+                if blobs[0].ndim > 2 else blobs[0])
+            if len(blobs) > 1 and name + "_bias" in arg_names:
+                arg_params[name + "_bias"] = mx.nd.array(blobs[1].ravel())
+        elif ltype == "BatchNorm":
+            scale = float(blobs[2].ravel()[0]) if len(blobs) > 2 else 1.0
+            scale = 1.0 / scale if scale != 0 else 1.0
+            aux_params[name + "_moving_mean"] = \
+                mx.nd.array(blobs[0].ravel() * scale)
+            aux_params[name + "_moving_var"] = \
+                mx.nd.array(blobs[1].ravel() * scale)
+            arg_params.setdefault(name + "_gamma", mx.nd.ones(
+                blobs[0].ravel().shape))
+            arg_params.setdefault(name + "_beta", mx.nd.zeros(
+                blobs[0].ravel().shape))
+            last_bn = name
+        elif ltype == "Scale" and last_bn is not None:
+            arg_params[last_bn + "_gamma"] = mx.nd.array(blobs[0].ravel())
+            if len(blobs) > 1:
+                arg_params[last_bn + "_beta"] = mx.nd.array(blobs[1].ravel())
+    # keep only names the symbol actually binds
+    arg_params = {k: v for k, v in arg_params.items() if k in arg_names}
+    aux_params = {k: v for k, v in aux_params.items() if k in aux_names}
+    if prefix:
+        mx.model.save_checkpoint(prefix, 0, sym, arg_params, aux_params)
+    return sym, arg_params, aux_params
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prototxt")
+    p.add_argument("caffemodel")
+    p.add_argument("prefix", help="output checkpoint prefix")
+    args = p.parse_args()
+    convert_model(args.prototxt, args.caffemodel, args.prefix)
+    print("saved %s-symbol.json, %s-0000.params" % (args.prefix, args.prefix))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,209 @@
+"""Minimal Caffe text/binary protobuf readers (no caffe/protobuf deps).
+
+Parity: the reference's ``tools/caffe_converter/caffe_parse`` (generated
+``caffe_pb2`` used with ``google.protobuf.text_format``). Here both the
+text-format .prototxt and the binary .caffemodel wire format are parsed
+directly: a NetParameter becomes nested dicts with repeated fields as
+lists. Only the fields the converter reads are interpreted; everything
+else is carried through or skipped structurally.
+
+Field numbers follow the public BVLC ``caffe.proto``:
+NetParameter{name=1, input=3, input_dim=4, layers(V1)=2, layer=100,
+input_shape=8}; LayerParameter{name=1, type=2, bottom=3, top=4, blobs=7};
+V1LayerParameter{bottom=2, top=3, name=4, type=5, blobs=6};
+BlobProto{num=1, channels=2, height=3, width=4, data=5, shape=7};
+BlobShape{dim=1}.
+"""
+from __future__ import annotations
+
+import re
+import struct
+
+__all__ = ["parse_prototxt", "parse_caffemodel"]
+
+
+# ----------------------------------------------------------------------
+# text format
+
+_TOKEN = re.compile(r"""
+    (?P<brace>[{}])
+  | (?P<name>[A-Za-z_][\w]*)\s*:?\s*
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<value>[^\s{}"]+)
+""", re.X)
+
+
+def _tokenize(text):
+    text = re.sub(r"#[^\n]*", "", text)
+    for m in _TOKEN.finditer(text):
+        kind = m.lastgroup
+        val = m.group(kind)
+        yield kind, val
+
+
+def _coerce(v):
+    if v.startswith('"'):
+        return v[1:-1]
+    if v in ("true", "True"):
+        return True
+    if v in ("false", "False"):
+        return False
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        return v
+
+
+# fields that repeat in the layers we interpret
+_REPEATED = {"layer", "layers", "bottom", "top", "input", "input_dim",
+             "dim", "blobs", "data", "input_shape", "pad", "kernel_size",
+             "stride", "loss_weight", "param"}
+
+
+def _insert(d, key, value):
+    if key in _REPEATED:
+        d.setdefault(key, []).append(value)
+    else:
+        d[key] = value
+
+
+def parse_prototxt(text):
+    """Parse text-format NetParameter → nested dict."""
+    if "\n" not in text and text.strip().endswith(".prototxt"):
+        with open(text) as f:
+            text = f.read()
+    root = {}
+    stack = [root]
+    pending = None
+    for kind, val in _tokenize(text):
+        if kind == "name":
+            pending = val
+        elif kind == "brace":
+            if val == "{":
+                msg = {}
+                _insert(stack[-1], pending, msg)
+                stack.append(msg)
+                pending = None
+            else:
+                stack.pop()
+        else:  # string or scalar value
+            _insert(stack[-1], pending, _coerce(val))
+    return root
+
+
+# ----------------------------------------------------------------------
+# binary wire format
+
+def _read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _scan(buf, start=0, end=None):
+    """Yield (field_number, wire_type, value) over one message's bytes.
+    value: varint int, 8/4-byte bytes, or length-delimited bytes."""
+    pos = start
+    end = len(buf) if end is None else end
+    while pos < end:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, pos = _read_varint(buf, pos)
+        elif wire == 1:
+            v = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            v = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wire)
+        yield field, wire, v
+
+
+def _floats(chunks_packed, chunks_f32):
+    out = []
+    for c in chunks_packed:
+        out.extend(struct.unpack("<%df" % (len(c) // 4), c))
+    out.extend(struct.unpack("<f", c)[0] for c in chunks_f32)
+    return out
+
+
+def _parse_blob(buf):
+    """BlobProto → (shape tuple, list[float])."""
+    dims_old = {}
+    shape = None
+    packed, singles = [], []
+    for field, wire, v in _scan(buf):
+        if field in (1, 2, 3, 4) and wire == 0:
+            dims_old[field] = v
+        elif field == 5:
+            (packed if wire == 2 else singles).append(v)
+        elif field == 7 and wire == 2:  # BlobShape
+            dim = []
+            for f2, w2, v2 in _scan(v):
+                if f2 == 1:
+                    if w2 == 2:  # packed varints
+                        pos = 0
+                        while pos < len(v2):
+                            d, pos = _read_varint(v2, pos)
+                            dim.append(d)
+                    else:
+                        dim.append(v2)
+            shape = tuple(dim)
+    data = _floats(packed, singles)
+    if shape is None and dims_old:
+        shape = tuple(dims_old.get(i, 1) for i in (1, 2, 3, 4))
+    return shape or (len(data),), data
+
+
+def _parse_layer(buf, v1):
+    """LayerParameter / V1LayerParameter → {name, type, bottom, top, blobs}."""
+    f_name, f_type, f_bottom, f_top, f_blobs = \
+        (4, 5, 2, 3, 6) if v1 else (1, 2, 3, 4, 7)
+    out = {"name": "", "type": "", "bottom": [], "top": [], "blobs": []}
+    for field, wire, v in _scan(buf):
+        if field == f_name:
+            out["name"] = v.decode()
+        elif field == f_type:
+            out["type"] = v if v1 else v.decode()
+        elif field == f_bottom:
+            out["bottom"].append(v.decode())
+        elif field == f_top:
+            out["top"].append(v.decode())
+        elif field == f_blobs:
+            out["blobs"].append(_parse_blob(v))
+    return out
+
+
+def parse_caffemodel(path_or_bytes):
+    """Binary NetParameter → {"name": str, "layer": [layer dicts]} with
+    each layer's ``blobs`` as [(shape, [floats]), ...]."""
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        buf = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            buf = f.read()
+    net = {"name": "", "layer": []}
+    for field, wire, v in _scan(buf):
+        if field == 1 and wire == 2:
+            net["name"] = v.decode()
+        elif field == 100 and wire == 2:
+            net["layer"].append(_parse_layer(v, v1=False))
+        elif field == 2 and wire == 2:
+            net["layer"].append(_parse_layer(v, v1=True))
+    return net

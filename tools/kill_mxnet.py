@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Kill leftover distributed training processes on this machine.
+
+Parity: the reference's ``tools/kill-mxnet.py`` (cleanup after a crashed
+``tools/launch.py`` job left scheduler/server/worker processes behind).
+Here the launcher spawns peer workers carrying ``MXNET_TPU_RANK`` in their
+environment; this scans /proc for them (optionally filtered by a command
+substring) and SIGTERMs, then SIGKILLs stragglers.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import time
+
+
+def find_jobs(pattern=None):
+    """→ [(pid, cmdline)] of processes with MXNET_TPU_RANK in env."""
+    jobs = []
+    me = os.getpid()
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == me:
+            continue
+        try:
+            with open("/proc/%s/environ" % pid, "rb") as f:
+                env = f.read().decode("utf-8", "replace")
+            if "MXNET_TPU_RANK=" not in env:
+                continue
+            with open("/proc/%s/cmdline" % pid, "rb") as f:
+                cmd = f.read().decode("utf-8", "replace").replace("\0", " ")
+            if pattern and pattern not in cmd:
+                continue
+            jobs.append((int(pid), cmd.strip()))
+        except (OSError, PermissionError):
+            continue
+    return jobs
+
+
+def kill_jobs(pattern=None, grace=3.0, dry_run=False):
+    jobs = find_jobs(pattern)
+    for pid, cmd in jobs:
+        print("kill %d  %s" % (pid, cmd[:100]))
+        if not dry_run:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+    if dry_run or not jobs:
+        return jobs
+    deadline = time.time() + grace
+    while time.time() < deadline:
+        if not any(os.path.exists("/proc/%d" % pid) for pid, _ in jobs):
+            break
+        time.sleep(0.1)
+    for pid, _ in jobs:
+        if os.path.exists("/proc/%d" % pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+    return jobs
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("pattern", nargs="?", default=None,
+                   help="only kill processes whose cmdline contains this")
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args()
+    jobs = kill_jobs(args.pattern, dry_run=args.dry_run)
+    print("%d process(es)%s" % (len(jobs),
+                                " (dry run)" if args.dry_run else ""))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Generate .lst image-list files for im2rec.
+
+Parity: the reference's ``tools/make_list.py`` (walk an image directory,
+assign integer labels per subdirectory, write TAB-separated
+``index\tlabel\tpath`` lines, optional shuffle/train-test split/chunking).
+The .lst format feeds ``tools/im2rec.py`` and the C++ RecordIO packer.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_image(root, recursive, exts):
+    """Yield (relpath, label). Recursive mode labels by subdirectory (one
+    class per folder, folders sorted for determinism); flat mode labels 0."""
+    image_list = []
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root)):
+            dirs.sort()
+            for fname in sorted(files):
+                if os.path.splitext(fname)[1].lower() not in exts:
+                    continue
+                if path not in cat:
+                    cat[path] = len(cat)
+                image_list.append(
+                    (os.path.relpath(os.path.join(path, fname), root),
+                     cat[path]))
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            if os.path.isfile(fpath) and \
+                    os.path.splitext(fname)[1].lower() in exts:
+                image_list.append((fname, 0))
+    return image_list
+
+
+def write_list(path_out, image_list, start=0):
+    with open(path_out, "w") as fout:
+        for i, (path, label) in enumerate(image_list):
+            fout.write("%d\t%d\t%s\n" % (start + i, label, path))
+
+
+def make_lists(root, prefix, recursive=True, exts=_EXTS, shuffle=True,
+               train_ratio=1.0, chunks=1, seed=42):
+    image_list = list_image(root, recursive, set(exts))
+    if shuffle:
+        random.Random(seed).shuffle(image_list)
+    n = len(image_list)
+    chunk_size = (n + chunks - 1) // max(chunks, 1)
+    written = []
+    for c in range(chunks):
+        chunk = image_list[c * chunk_size:(c + 1) * chunk_size]
+        suffix = "_%d" % c if chunks > 1 else ""
+        ntrain = int(len(chunk) * train_ratio)
+        if train_ratio < 1.0:
+            write_list(prefix + suffix + "_train.lst", chunk[:ntrain])
+            write_list(prefix + suffix + "_val.lst", chunk[ntrain:])
+            written += [prefix + suffix + "_train.lst",
+                        prefix + suffix + "_val.lst"]
+        else:
+            write_list(prefix + suffix + ".lst", chunk)
+            written.append(prefix + suffix + ".lst")
+    return written
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("root", help="image directory")
+    p.add_argument("prefix", help="output .lst path prefix")
+    p.add_argument("--recursive", action="store_true", default=True)
+    p.add_argument("--no-recursive", dest="recursive", action="store_false")
+    p.add_argument("--exts", nargs="+", default=list(_EXTS))
+    p.add_argument("--no-shuffle", dest="shuffle", action="store_false",
+                   default=True)
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--chunks", type=int, default=1)
+    args = p.parse_args()
+    for f in make_lists(args.root, args.prefix, args.recursive,
+                        tuple(e.lower() for e in args.exts), args.shuffle,
+                        args.train_ratio, args.chunks):
+        print(f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Parse training logs into a per-epoch table.
+
+Parity: the reference's ``tools/parse_log.py`` (regex over
+``Epoch[N] Train-*=V`` / ``Epoch[N] Validation-*=V`` /
+``Epoch[N] Time cost=V`` lines → markdown table). Handles both the
+FeedForward log format and ParallelTrainer's ``Train-acc=V time=V`` lines.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+_PATTERNS = [
+    ("train", re.compile(r".*Epoch\[(\d+)\] Train-[\w-]+=([.\d]+)")),
+    ("val", re.compile(r".*Epoch\[(\d+)\] Validation-[\w-]+=([.\d]+)")),
+    ("time", re.compile(r".*Epoch\[(\d+)\] Time cost=([.\d]+)")),
+    ("time", re.compile(r".*Epoch\[(\d+)\] Train-[\w-]+=[.\d]+ "
+                        r"time=([.\d]+)")),
+]
+
+
+def parse(lines):
+    """→ {epoch: {"train": v, "val": v, "time": v}} (last value wins)."""
+    data = {}
+    for line in lines:
+        for kind, rx in _PATTERNS:
+            m = rx.match(line)
+            if m:
+                epoch = int(m.group(1))
+                data.setdefault(epoch, {})[kind] = float(m.group(2))
+    return data
+
+
+def to_markdown(data):
+    out = ["| epoch | train | valid | time |", "| --- | --- | --- | --- |"]
+    for epoch in sorted(data):
+        row = data[epoch]
+        out.append("| %d | %s | %s | %s |" % (
+            epoch,
+            "%.6f" % row["train"] if "train" in row else "-",
+            "%.6f" % row["val"] if "val" in row else "-",
+            "%.1f" % row["time"] if "time" in row else "-"))
+    return "\n".join(out)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logfile")
+    p.add_argument("--format", choices=["markdown", "none"],
+                   default="markdown")
+    args = p.parse_args()
+    with open(args.logfile) as f:
+        data = parse(f)
+    if args.format == "markdown":
+        print(to_markdown(data))
+    else:
+        for epoch in sorted(data):
+            print(epoch, data[epoch])
+
+
+if __name__ == "__main__":
+    main()
