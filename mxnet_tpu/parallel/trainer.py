@@ -63,8 +63,18 @@ class ParallelTrainer:
     """
 
     def __init__(self, symbol, input_shapes, optimizer="sgd", mesh=None,
-                 rules=None, initializer=None, seed=None, optimizer_params=None):
+                 rules=None, initializer=None, seed=None, optimizer_params=None,
+                 compute_dtype=None):
         self.symbol = symbol
+        # Mixed precision: forward/backward in compute_dtype (bfloat16 —
+        # native MXU input width, halves HBM traffic for activations),
+        # while params/optimizer state stay float32 master copies. The
+        # cast's vjp accumulates gradients back to f32. The reference has
+        # no AMP (2015, fp32-only mshadow); on TPU bf16 is the idiomatic
+        # default for the compute path.
+        if compute_dtype is not None:
+            compute_dtype = jnp.dtype(compute_dtype)
+        self.compute_dtype = compute_dtype
         self.mesh = mesh if mesh is not None else local_mesh()
         self.rules = rules if rules is not None else ShardingRules(self.mesh)
         self.input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
@@ -159,13 +169,31 @@ class ParallelTrainer:
         return self
 
     # ------------------------------------------------------------------
-    def _step_impl(self, params, opt_state, aux, batch, lr, t, rng):
+    def _cast_compute(self, v):
+        if self.compute_dtype is not None and \
+                jnp.issubdtype(v.dtype, jnp.floating):
+            return v.astype(self.compute_dtype)
+        return v
+
+    def _step_impl(self, params, opt_state, aux, batch, lr, t, rng_base):
+        # fold the step counter into the key INSIDE the compiled program —
+        # doing it eagerly in step() costs a host dispatch per step
+        rng = jax.random.fold_in(rng_base, t)
+        cast = self._cast_compute
+
         def fwd(p):
-            vals = [p[n] if n in p else batch[n] for n in self.arg_names]
+            # cast INSIDE the differentiated fn: the cast's vjp upcasts
+            # gradients back to the f32 master params
+            vals = [cast(p[n]) if n in p else cast(batch[n])
+                    for n in self.arg_names]
             outs, new_aux = self._graph_fn(vals, list(aux), True, rng)
             return tuple(outs), tuple(new_aux)
 
         outs, vjp_fn, new_aux = jax.vjp(fwd, params, has_aux=True)
+        if self.compute_dtype is not None:
+            # moving stats stay f32 across steps (stable jit signature)
+            new_aux = tuple(a.astype(o.dtype)
+                            for a, o in zip(new_aux, aux))
         head_grads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
         (grads,) = vjp_fn(head_grads)
         new_params, new_state = {}, {}
@@ -204,12 +232,19 @@ class ParallelTrainer:
         multiproc = jax.process_count() > 1
         try:
             for k in self.input_shapes:
-                v = _as_jnp(batch[k])
+                v = batch[k]
+                if isinstance(v, NDArray):
+                    v = v._val
                 if multiproc:
                     out[k] = jax.make_array_from_process_local_data(
                         self._data_sh[k], np.asarray(v))
-                else:
+                elif isinstance(v, jax.Array):
+                    # committed arrays must be resharded explicitly
                     out[k] = jax.device_put(v, self._data_sh[k])
+                else:
+                    # hand numpy straight to jit — in_shardings places it
+                    # during dispatch, cheaper than an eager device_put
+                    out[k] = v
         except KeyError as e:
             raise MXNetError("%s: missing input %s" % (what, e))
         return out
@@ -228,11 +263,12 @@ class ParallelTrainer:
             lr = self.optimizer.lr_scheduler(self._t)
         else:
             lr = self.optimizer.lr
-        rng = jax.random.fold_in(self._rng, self._t)
+        # numpy scalars (not jnp) keep this dispatch-only — no eager
+        # device ops on the host critical path
         with self.mesh:
             self.params, self.opt_state, self.aux, outs = self._jit_step(
                 self.params, self.opt_state, self.aux, batch,
-                jnp.float32(lr), jnp.int32(self._t), rng)
+                np.float32(lr), np.int32(self._t), self._rng)
         return outs
 
     def forward(self, batch):
@@ -242,9 +278,8 @@ class ParallelTrainer:
         if self._jit_eval is None:
             self._jit_eval = self._build_eval()
         batch = self._shard_batch(batch, "forward")
-        rng = jax.random.fold_in(self._rng, 0)
         with self.mesh:
-            return self._jit_eval(self.params, self.aux, batch, rng)
+            return self._jit_eval(self.params, self.aux, batch, self._rng)
 
     # ------------------------------------------------------------------
     def fit(self, train_data, eval_data=None, eval_metric="acc",

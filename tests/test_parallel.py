@@ -312,3 +312,38 @@ def test_collectives_exact_values():
     out = jax.shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
                         check_vma=False)(jnp.ones(()))
     assert float(out) == n * (n + 1) / 2
+
+
+def test_trainer_bf16_mixed_precision_converges():
+    """compute_dtype=bfloat16: forward/backward run in bf16 while master
+    params/opt state stay f32 (grad flows back through the cast vjp);
+    convergence must match the f32 oracle to coarse tolerance."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(7)
+    n = 512
+    x = rng.randn(n, 16).astype(np.float32)
+    w_true = rng.randn(16, 3).astype(np.float32)
+    y = np.argmax(x @ w_true, axis=1).astype(np.float32)
+
+    data = mx.symbol.Variable("data")
+    fc = mx.symbol.FullyConnected(data=data, name="fc", num_hidden=3)
+    sym = mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+
+    train_iter = mx.io.NDArrayIter(x, y, batch_size=64, shuffle=False)
+    trainer = par.ParallelTrainer(
+        sym, {"data": (64, 16), "softmax_label": (64,)},
+        optimizer="sgd", mesh=par.data_parallel_mesh(),
+        optimizer_params={"learning_rate": 0.5},
+        compute_dtype="bfloat16")
+    trainer.init_params()
+    trainer.fit(train_iter, num_epoch=10)
+    assert trainer.params["fc_weight"].dtype == jnp.float32  # master stays f32
+    train_iter.reset()
+    correct = total = 0
+    for b in train_iter:
+        out = trainer.forward({"data": b.data[0],
+                               "softmax_label": b.label[0]})
+        pred = np.argmax(np.asarray(out[0]), axis=1)
+        correct += (pred == b.label[0].asnumpy()).sum()
+        total += len(pred)
+    assert correct / total > 0.85, correct / total
