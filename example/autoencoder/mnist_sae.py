@@ -1,0 +1,111 @@
+"""Stacked (denoising) autoencoder with greedy layerwise pretraining.
+
+Parity: reference ``example/autoencoder/`` (autoencoder.py + mnist_sae.py
++ solver.py) — the same recipe: per-layer encoder/decoder pairs trained
+greedily on the previous layer's codes with LinearRegressionOutput, then
+the full stack fine-tuned end-to-end. The reference's hand-rolled Solver
+is replaced by FeedForward, which is all the solver did (SGD + metric +
+logging).
+
+Runs on synthetic MNIST-shaped blobs (no egress in this image); the
+oracle is reconstruction MSE dropping well below the data variance.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def autoencoder_symbol(dims, sparse_pen=0.0):
+    """Full stack: in -> dims[0] -> ... -> dims[-1] -> ... -> dims[0] -> in."""
+    data = mx.symbol.Variable("data")
+    x = data
+    for i, d in enumerate(dims[1:]):
+        x = mx.symbol.FullyConnected(data=x, name="enc_%d" % i, num_hidden=d)
+        x = mx.symbol.Activation(data=x, act_type="relu",
+                                 name="enc_act_%d" % i)
+        if sparse_pen > 0:
+            x = mx.symbol.IdentityAttachKLSparseReg(
+                data=x, penalty=sparse_pen, name="sparse_%d" % i)
+    for i, d in reversed(list(enumerate(dims[:-1]))):
+        x = mx.symbol.FullyConnected(data=x, name="dec_%d" % i, num_hidden=d)
+        if i != 0:
+            x = mx.symbol.Activation(data=x, act_type="relu",
+                                     name="dec_act_%d" % i)
+    return mx.symbol.LinearRegressionOutput(data=x, name="softmax")
+
+
+def layer_symbol(n_in, n_hidden, idx):
+    data = mx.symbol.Variable("data")
+    x = mx.symbol.FullyConnected(data=data, name="enc_%d" % idx,
+                                 num_hidden=n_hidden)
+    x = mx.symbol.Activation(data=x, act_type="relu", name="enc_act_%d" % idx)
+    x = mx.symbol.FullyConnected(data=x, name="dec_%d" % idx, num_hidden=n_in)
+    return mx.symbol.LinearRegressionOutput(data=x, name="softmax")
+
+
+def train(sym, x, num_epochs, lr, batch_size=100):
+    it = mx.io.NDArrayIter(x, x.copy(), batch_size=batch_size, shuffle=True,
+                           label_name="softmax_label")
+    model = mx.model.FeedForward(ctx=mx.cpu(), symbol=sym,
+                                 num_epoch=num_epochs, learning_rate=lr,
+                                 momentum=0.9, wd=0.0)
+    model.fit(X=it, eval_metric="mse")
+    return model
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--dims', type=str, default='784,256,64')
+    parser.add_argument('--pretrain-epochs', type=int, default=3)
+    parser.add_argument('--finetune-epochs', type=int, default=5)
+    parser.add_argument('--lr', type=float, default=0.02)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    dims = [int(d) for d in args.dims.split(',')]
+
+    # pixel-scale data like normalized MNIST ([0,1]-ish, low-rank structure)
+    rng = np.random.RandomState(0)
+    base = rng.rand(20, dims[0]).astype(np.float32) / 20.0
+    coef = rng.rand(6000, 20).astype(np.float32)
+    x = coef @ base + 0.02 * rng.rand(6000, dims[0]).astype(np.float32)
+
+    # greedy layerwise pretraining
+    codes = x
+    pretrained = {}
+    for i in range(len(dims) - 1):
+        logging.info("pretraining layer %d: %d -> %d", i, dims[i],
+                     dims[i + 1])
+        m = train(layer_symbol(dims[i], dims[i + 1], i), codes,
+                  args.pretrain_epochs, args.lr)
+        pretrained.update({k: v for k, v in m.arg_params.items()
+                           if k.startswith("enc_%d" % i)
+                           or k.startswith("dec_%d" % i)})
+        # push codes through the trained encoder for the next layer
+        w = m.arg_params["enc_%d_weight" % i].asnumpy()
+        b = m.arg_params["enc_%d_bias" % i].asnumpy()
+        codes = np.maximum(codes @ w.T + b, 0.0)
+
+    # end-to-end fine-tune from the pretrained stack
+    logging.info("fine-tuning %s", dims)
+    sym = autoencoder_symbol(dims)
+    it = mx.io.NDArrayIter(x, x.copy(), batch_size=100, shuffle=True,
+                           label_name="softmax_label")
+    model = mx.model.FeedForward(ctx=mx.cpu(), symbol=sym,
+                                 num_epoch=args.finetune_epochs,
+                                 learning_rate=args.lr, momentum=0.9, wd=0.0,
+                                 arg_params=pretrained,
+                                 allow_extra_params=True)
+    model.fit(X=it, eval_metric="mse")
+
+    recon = model.predict(mx.io.NDArrayIter(x, batch_size=100))
+    mse = float(np.mean((recon - x) ** 2))
+    var = float(x.var())
+    logging.info("reconstruction mse %.4f vs data variance %.4f", mse, var)
+    assert mse < 0.8 * var, "autoencoder failed to learn"
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,78 @@
+"""Train Inception-BN-28-small (or small ResNet) on CIFAR-10.
+
+Parity: reference ``example/image-classification/train_cifar10.py`` — the
+headline single-machine benchmark config (batch 128, lr 0.05, factor
+schedule; README.md:199-206). Reads packed RecordIO via
+``mx.ImageRecordIter`` when ``--data-dir`` holds ``train.rec``/``test.rec``;
+otherwise synthesizes CIFAR-shaped data so the script runs end-to-end in
+this no-egress image.
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_inception_bn_small, get_resnet_cifar
+import train_model
+
+
+def get_iterator(args, kv):
+    data_shape = (3, 28, 28)
+    train_rec = os.path.join(args.data_dir, "train.rec")
+    test_rec = os.path.join(args.data_dir, "test.rec")
+    if os.path.exists(train_rec) and os.path.exists(test_rec):
+        train = mx.ImageRecordIter(
+            path_imgrec=train_rec, mean_img=os.path.join(args.data_dir,
+                                                         "mean.bin"),
+            data_shape=data_shape, batch_size=args.batch_size,
+            rand_crop=True, rand_mirror=True,
+            num_parts=kv.num_workers, part_index=kv.rank)
+        val = mx.ImageRecordIter(
+            path_imgrec=test_rec, mean_img=os.path.join(args.data_dir,
+                                                        "mean.bin"),
+            data_shape=data_shape, batch_size=args.batch_size,
+            rand_crop=False, rand_mirror=False,
+            num_parts=kv.num_workers, part_index=kv.rank)
+        return (train, val)
+    rng = np.random.RandomState(11)
+    n = args.num_examples
+    labels = rng.randint(0, 10, n).astype(np.float32)
+    x = rng.rand(n, *data_shape).astype(np.float32)
+    # plant a per-class signal so accuracy is a meaningful smoke oracle
+    for c in range(10):
+        x[labels == c, 0, c, c] += 2.0
+    split = int(0.9 * n)
+    train = mx.io.NDArrayIter(x[:split], labels[:split],
+                              batch_size=args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(x[split:], labels[split:],
+                            batch_size=args.batch_size)
+    return (train, val)
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description='train an image classifier on cifar10')
+    parser.add_argument('--network', type=str, default='inception-bn-28-small',
+                        choices=['inception-bn-28-small', 'resnet-28-small'])
+    parser.add_argument('--data-dir', type=str, default='cifar10/')
+    parser.add_argument('--devices', type=str, default='cpu',
+                        help="'cpu' or comma list of tpu ids, e.g. '0,1'")
+    parser.add_argument('--num-examples', type=int, default=60000)
+    parser.add_argument('--batch-size', type=int, default=128)
+    parser.add_argument('--lr', type=float, default=.05)
+    parser.add_argument('--lr-factor', type=float, default=1)
+    parser.add_argument('--lr-factor-epoch', type=float, default=1)
+    parser.add_argument('--model-prefix', type=str)
+    parser.add_argument('--num-epochs', type=int, default=20)
+    parser.add_argument('--kv-store', type=str, default='local')
+    return parser.parse_args()
+
+
+if __name__ == '__main__':
+    args = parse_args()
+    if args.network == 'inception-bn-28-small':
+        net = get_inception_bn_small(num_classes=10)
+    else:
+        net = get_resnet_cifar(num_classes=10)
+    train_model.fit(args, net, get_iterator)

@@ -1,0 +1,82 @@
+"""Train an MLP or LeNet on MNIST.
+
+Parity: reference ``example/image-classification/train_mnist.py`` — same
+CLI (``--network mlp|lenet``, ``--batch-size``, ``--lr``, ``--kv-store``),
+same default hyperparameters. Uses ``mx.io.MNISTIter`` when the idx-ubyte
+files are present under ``--data-dir``; otherwise falls back to a
+deterministic synthetic set (this image has no network egress, so nothing
+is downloaded).
+"""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_mlp, get_lenet
+import train_model
+
+
+def get_iterator(data_shape):
+    def get_iterator_impl(args, kv):
+        flat = len(data_shape) == 1
+        files = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+                 "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+        have_mnist = all(os.path.exists(os.path.join(args.data_dir, f))
+                         for f in files)
+        if have_mnist:
+            train = mx.io.MNISTIter(
+                image=os.path.join(args.data_dir, files[0]),
+                label=os.path.join(args.data_dir, files[1]),
+                input_shape=data_shape, batch_size=args.batch_size,
+                shuffle=True, flat=flat,
+                num_parts=kv.num_workers, part_index=kv.rank)
+            val = mx.io.MNISTIter(
+                image=os.path.join(args.data_dir, files[2]),
+                label=os.path.join(args.data_dir, files[3]),
+                input_shape=data_shape, batch_size=args.batch_size,
+                flat=flat)
+            return (train, val)
+        # synthetic fallback: class-dependent gaussian blobs, learnable
+        rng = np.random.RandomState(7)
+        n = args.num_examples
+        labels = rng.randint(0, 10, n).astype(np.float32)
+        centers = rng.randn(10, int(np.prod(data_shape))).astype(np.float32)
+        x = centers[labels.astype(int)] + \
+            0.3 * rng.randn(n, int(np.prod(data_shape))).astype(np.float32)
+        x = x.reshape((n,) + tuple(data_shape))
+        split = int(0.9 * n)
+        train = mx.io.NDArrayIter(x[:split], labels[:split],
+                                  batch_size=args.batch_size, shuffle=True)
+        val = mx.io.NDArrayIter(x[split:], labels[split:],
+                                batch_size=args.batch_size)
+        return (train, val)
+    return get_iterator_impl
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description='train an image classifier '
+                                                 'on mnist')
+    parser.add_argument('--network', type=str, default='mlp',
+                        choices=['mlp', 'lenet'])
+    parser.add_argument('--data-dir', type=str, default='mnist/')
+    parser.add_argument('--devices', type=str, default='cpu',
+                        help="'cpu' or comma list of tpu ids, e.g. '0,1'")
+    parser.add_argument('--num-examples', type=int, default=60000)
+    parser.add_argument('--batch-size', type=int, default=128)
+    parser.add_argument('--lr', type=float, default=.1)
+    parser.add_argument('--model-prefix', type=str)
+    parser.add_argument('--num-epochs', type=int, default=10)
+    parser.add_argument('--kv-store', type=str, default='local')
+    return parser.parse_args()
+
+
+if __name__ == '__main__':
+    args = parse_args()
+    if args.network == 'mlp':
+        data_shape = (784,)
+        net = get_mlp()
+    else:
+        data_shape = (1, 28, 28)
+        net = get_lenet()
+    train_model.fit(args, net, get_iterator(data_shape))

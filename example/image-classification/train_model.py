@@ -1,0 +1,70 @@
+"""Shared training harness for the image-classification examples.
+
+Parity: reference ``example/image-classification/train_model.py`` — the
+same fit() contract (kvstore creation, rank-tagged logging, checkpoint
+save/resume, FactorScheduler, Speedometer) over mxnet_tpu. On TPU the
+device list maps to ``mx.tpu(i)``; data-parallel gradient sync rides the
+mesh psum behind the KVStore facade instead of ps-lite.
+"""
+import logging
+import os
+
+import mxnet_tpu as mx
+
+
+def fit(args, network, data_loader):
+    kv = mx.kvstore.create(args.kv_store)
+
+    # INFO, not the reference's DEBUG: jax itself logs on DEBUG and would
+    # drown the training log
+    head = '%(asctime)-15s Node[' + str(kv.rank) + '] %(message)s'
+    logging.basicConfig(level=logging.INFO, format=head)
+    logging.info('start with arguments %s', args)
+
+    model_prefix = args.model_prefix
+    if model_prefix is not None:
+        model_prefix += "-%d" % (kv.rank,)
+    model_args = {}
+    if getattr(args, 'load_epoch', None) is not None:
+        assert model_prefix is not None
+        tmp = mx.model.FeedForward.load(model_prefix, args.load_epoch)
+        model_args = {'arg_params': tmp.arg_params,
+                      'aux_params': tmp.aux_params,
+                      'begin_epoch': args.load_epoch}
+    checkpoint = None if model_prefix is None else \
+        mx.callback.do_checkpoint(model_prefix)
+
+    (train, val) = data_loader(args, kv)
+
+    if args.devices == 'cpu':
+        devs = mx.cpu()
+    else:
+        devs = [mx.tpu(int(i)) for i in args.devices.split(',')]
+
+    epoch_size = args.num_examples // args.batch_size
+    if args.kv_store == 'dist_sync':
+        epoch_size //= kv.num_workers
+        model_args['epoch_size'] = epoch_size
+
+    if getattr(args, 'lr_factor', 1) < 1:
+        model_args['lr_scheduler'] = mx.lr_scheduler.FactorScheduler(
+            step=max(int(epoch_size * args.lr_factor_epoch), 1),
+            factor=args.lr_factor)
+
+    model = mx.model.FeedForward(
+        ctx=devs,
+        symbol=network,
+        num_epoch=args.num_epochs,
+        learning_rate=args.lr,
+        momentum=0.9,
+        wd=0.00001,
+        initializer=mx.initializer.Xavier(factor_type="in", magnitude=2.34),
+        **model_args)
+
+    model.fit(
+        X=train,
+        eval_data=val,
+        kvstore=kv,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
+        epoch_end_callback=checkpoint)
+    return model

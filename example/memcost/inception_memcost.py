@@ -1,0 +1,88 @@
+"""Report the training-step memory cost of Inception-BN under different
+memory policies.
+
+Parity: reference ``example/memcost/`` — there, ``make with_inplace /
+with_sharing / forward_only`` rebuilds with allocator flags and
+``GraphExecutor::Print`` reports plan MB (graph_executor.cc:852-853).
+Here the planner is XLA buffer assignment, so the knobs are:
+
+* ``forward_only``   — inference graph only (no grads kept)
+* ``full``           — fused forward+backward (XLA plans/reuses buffers;
+                       inplace + sharing are automatic)
+* ``remat``          — plus ``jax.checkpoint`` over the whole graph
+                       (the reference's MXNET_BACKWARD_DO_MIRROR)
+
+and the report comes from the compiled executable's memory analysis.
+"""
+import argparse
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_inception_bn
+from mxnet_tpu.parallel import make_graph_fn
+
+
+def mem_mb(compiled):
+    m = compiled.memory_analysis()
+    if m is None:
+        return None
+    return dict(
+        temp_mb=m.temp_size_in_bytes / 2**20,
+        output_mb=m.output_size_in_bytes / 2**20,
+        argument_mb=m.argument_size_in_bytes / 2**20,
+    )
+
+
+def report(tag, fn, *args):
+    compiled = jax.jit(fn).lower(*args).compile()
+    m = mem_mb(compiled)
+    if m is None:
+        print("%-14s memory analysis unavailable on this backend" % tag)
+        return
+    print("%-14s temp %8.1f MB   args %8.1f MB   outputs %8.1f MB"
+          % (tag, m["temp_mb"], m["argument_mb"], m["output_mb"]))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--batch-size', type=int, default=32)
+    args = parser.parse_args()
+
+    sym = get_inception_bn(num_classes=1000)
+    shapes = {"data": (args.batch_size, 3, 224, 224),
+              "softmax_label": (args.batch_size,)}
+    arg_names = sym.list_arguments()
+    arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+    rng = np.random.RandomState(0)
+    params = [jnp.asarray(rng.uniform(-0.01, 0.01, s).astype(np.float32))
+              for s in arg_shapes]
+    aux = [jnp.zeros(s, jnp.float32) for s in aux_shapes]
+    graph_fn = make_graph_fn(sym)
+    label_idx = arg_names.index("softmax_label")
+
+    def fwd(params, aux):
+        outs, _ = graph_fn(params, aux, False, jax.random.PRNGKey(0))
+        return outs[0]
+
+    def loss(params, aux):
+        outs, _ = graph_fn(params, aux, True, jax.random.PRNGKey(0))
+        p = outs[0]
+        lab = params[label_idx].astype(jnp.int32)
+        return -jnp.mean(jnp.log(p[jnp.arange(p.shape[0]), lab] + 1e-8))
+
+    def full(params, aux):
+        return jax.grad(loss)(params, aux)
+
+    def remat(params, aux):
+        return jax.grad(jax.checkpoint(loss))(params, aux)
+
+    report("forward_only", fwd, params, aux)
+    report("full", full, params, aux)
+    report("remat", remat, params, aux)
+
+
+if __name__ == '__main__':
+    main()

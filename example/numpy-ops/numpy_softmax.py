@@ -1,0 +1,81 @@
+"""Custom softmax loss written as a NumpyOp, trained inside an MLP.
+
+Parity: reference ``example/numpy-ops/numpy_softmax.py`` — the custom-op
+bridge demo (``mx.operator.NumpyOp`` with user forward/backward/
+infer_shape in pure numpy, reference python/mxnet/operator.py). The op
+runs on the host; XLA calls out to it per step, so this is the "escape
+hatch" path, not the fast path — exactly the reference's NativeOp
+semantics.
+
+Runs on synthetic MNIST-like blobs (no egress in this image).
+"""
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class NumpySoftmax(mx.operator.NumpyOp):
+    def __init__(self):
+        super(NumpySoftmax, self).__init__(False)
+
+    def list_arguments(self):
+        return ['data', 'label']
+
+    def list_outputs(self):
+        return ['output']
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        output_shape = in_shape[0]
+        return [data_shape, label_shape], [output_shape]
+
+    def forward(self, in_data, out_data):
+        x = in_data[0]
+        y = out_data[0]
+        y[:] = np.exp(x - x.max(axis=1).reshape((x.shape[0], 1)))
+        y /= y.sum(axis=1).reshape((x.shape[0], 1))
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        l = in_data[1]
+        l = l.reshape((l.size,)).astype(int)
+        y = out_data[0]
+        dx = in_grad[0]
+        dx[:] = y
+        dx[np.arange(l.shape[0]), l] -= 1.0
+
+
+def build_mlp():
+    data = mx.symbol.Variable('data')
+    fc1 = mx.symbol.FullyConnected(data=data, name='fc1', num_hidden=128)
+    act1 = mx.symbol.Activation(data=fc1, name='relu1', act_type="relu")
+    fc2 = mx.symbol.FullyConnected(data=act1, name='fc2', num_hidden=64)
+    act2 = mx.symbol.Activation(data=fc2, name='relu2', act_type="relu")
+    fc3 = mx.symbol.FullyConnected(data=act2, name='fc3', num_hidden=10)
+    mysoftmax = NumpySoftmax()
+    return mysoftmax(data=fc3, name='softmax')
+
+
+def synthetic_mnist(n=6400, dim=784, num_classes=10, seed=7):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, n).astype(np.float32)
+    centers = rng.randn(num_classes, dim).astype(np.float32)
+    x = centers[labels.astype(int)] + \
+        0.3 * rng.randn(n, dim).astype(np.float32)
+    split = int(0.9 * n)
+    return ((x[:split], labels[:split]), (x[split:], labels[split:]))
+
+
+if __name__ == '__main__':
+    logging.basicConfig(level=logging.INFO)
+    mlp = build_mlp()
+    (xt, yt), (xv, yv) = synthetic_mnist()
+    train = mx.io.NDArrayIter(xt, yt, batch_size=100, shuffle=True)
+    val = mx.io.NDArrayIter(xv, yv, batch_size=100)
+    model = mx.model.FeedForward(
+        ctx=mx.cpu(), symbol=mlp, num_epoch=5,
+        learning_rate=0.1, momentum=0.9, wd=0.00001)
+    model.fit(X=train, eval_data=val,
+              batch_end_callback=mx.callback.Speedometer(100, 50))

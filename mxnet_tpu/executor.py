@@ -27,6 +27,8 @@ traced into one XLA computation:
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -169,11 +171,18 @@ class Executor:
         return jax.jit(run)
 
     def _build_train(self):
+        # MXNET_BACKWARD_DO_MIRROR=1 -> gradient mirroring (reference
+        # static_graph.cc:400-436) as jax.checkpoint: recompute
+        # activations in the backward instead of keeping them
+        mirror = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") == "1"
+
         def run(arg_vals, aux_vals, rng):
             def f(av):
                 outs, new_aux, _ = self._eval_graph(list(av), aux_vals,
                                                     True, rng)
                 return tuple(outs), tuple(new_aux)
+            if mirror:
+                f = jax.checkpoint(f)
             outs, vjp_fn, new_aux = jax.vjp(f, tuple(arg_vals), has_aux=True)
             leaves, treedef = jax.tree_util.tree_flatten(vjp_fn)
             self._vjp_treedef = treedef  # host capture during trace
@@ -352,4 +361,41 @@ class Executor:
                                            nd.array(np.asarray(val)))
 
     def debug_str(self):
-        return self._symbol.debug_str()
+        """Execution-plan dump: the graph plus the compiled program's
+        buffer plan (reference ``GraphExecutor::Print``,
+        graph_executor.cc:821-854, which reports per-node storage and
+        'Total N MB'). Here the planner is XLA buffer assignment, so the
+        totals come from the jitted forward's memory analysis; the dump is
+        per-program (infer path) rather than per-node because XLA fuses
+        nodes into one executable."""
+        lines = [self._symbol.debug_str()]
+        try:
+            m = self._plan_memory
+        except AttributeError:
+            m = None
+        try:
+            if m is None:
+                arg_vals = [a._val for a in self.arg_arrays]
+                aux_vals = [a._val for a in self.aux_arrays]
+                if self._jit_infer is None:
+                    self._jit_infer = self._build_infer()
+                compiled = self._jit_infer.lower(
+                    arg_vals, aux_vals, jax.random.PRNGKey(0)).compile()
+                m = compiled.memory_analysis()
+                self._plan_memory = m  # compile once; plan is static
+            if m is not None:
+                mb = 2.0 ** 20
+                lines.append(
+                    "Compiled plan (XLA buffer assignment):\n"
+                    "  argument  %.2f MB\n  output    %.2f MB\n"
+                    "  temp      %.2f MB\n  generated code %.2f MB\n"
+                    "Total %.2f MB" % (
+                        m.argument_size_in_bytes / mb,
+                        m.output_size_in_bytes / mb,
+                        m.temp_size_in_bytes / mb,
+                        m.generated_code_size_in_bytes / mb,
+                        (m.argument_size_in_bytes + m.output_size_in_bytes
+                         + m.temp_size_in_bytes) / mb))
+        except Exception:  # memory analysis is backend-dependent
+            pass
+        return "\n".join(lines)

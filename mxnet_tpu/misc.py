@@ -1,0 +1,51 @@
+"""Legacy learning-rate scheduler module.
+
+Parity: ``/root/reference/python/mxnet/misc.py`` — the original
+``LearningRateScheduler``/``FactorScheduler`` pair that predates
+``lr_scheduler.py``. Kept for API compatibility; new code should use
+:mod:`mxnet_tpu.lr_scheduler`. Semantics match the reference: the factor
+scheduler returns ``base_lr * factor**(iteration // step)`` and logs when
+the rate changes.
+"""
+from __future__ import annotations
+
+import logging
+import math
+
+__all__ = ["LearningRateScheduler", "FactorScheduler"]
+
+
+class LearningRateScheduler:
+    """Base class: maps an iteration count to a learning rate."""
+
+    def __init__(self):
+        self.base_lr = 0.01
+
+    def __call__(self, iteration):
+        raise NotImplementedError("must override this")
+
+
+class FactorScheduler(LearningRateScheduler):
+    """Reduce the learning rate by `factor` every `step` iterations."""
+
+    def __init__(self, step, factor=0.1):
+        super().__init__()
+        if step < 1:
+            raise ValueError("Schedule step must be greater or equal than 1 round")
+        if factor >= 1.0:
+            raise ValueError("Factor must be less than 1 to make lr reduce")
+        self.step = step
+        self.factor = factor
+        self.old_lr = self.base_lr
+        self.init = False
+
+    def __call__(self, iteration):
+        if not self.init:
+            self.init = True
+            self.old_lr = self.base_lr
+        lr = self.base_lr * math.pow(self.factor, int(iteration / self.step))
+        if lr != self.old_lr:
+            self.old_lr = lr
+            logging.info("At Iteration [%d]: Swith to new learning rate %.5f",
+                         iteration, lr)
+        return lr

@@ -20,6 +20,7 @@ multi-device loop, bit-for-bit modulo reduction order.
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 import numpy as np
@@ -64,7 +65,7 @@ class ParallelTrainer:
 
     def __init__(self, symbol, input_shapes, optimizer="sgd", mesh=None,
                  rules=None, initializer=None, seed=None, optimizer_params=None,
-                 compute_dtype=None):
+                 compute_dtype=None, remat=None):
         self.symbol = symbol
         # Mixed precision: forward/backward in compute_dtype (bfloat16 —
         # native MXU input width, halves HBM traffic for activations),
@@ -75,6 +76,13 @@ class ParallelTrainer:
         if compute_dtype is not None:
             compute_dtype = jnp.dtype(compute_dtype)
         self.compute_dtype = compute_dtype
+        # Gradient mirroring -> rematerialization: the reference trades
+        # activation memory for recompute behind MXNET_BACKWARD_DO_MIRROR
+        # (static_graph.cc:400-436); the TPU analogue is jax.checkpoint
+        # over the forward, so XLA recomputes activations in the backward.
+        if remat is None:
+            remat = os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") == "1"
+        self.remat = bool(remat)
         self.mesh = mesh if mesh is not None else local_mesh()
         self.rules = rules if rules is not None else ShardingRules(self.mesh)
         self.input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
@@ -132,6 +140,14 @@ class ParallelTrainer:
         where the sharding spans non-addressable devices (every process
         holds the full host value — the replicated-init convention)."""
         if jax.process_count() == 1:
+            # device-side copy first when val is already a jax array:
+            # device_put may alias the caller's buffer when the sharding
+            # already matches, and the fused step DONATES params — donating
+            # an aliased buffer would delete the user's array out from
+            # under them. (A host round-trip would also work but costs a
+            # d2h+h2d per parameter.)
+            if isinstance(val, jax.Array):
+                val = jnp.copy(val)
             return jax.device_put(val, sharding)
         val = np.asarray(val)
         return jax.make_array_from_callback(val.shape, sharding,
@@ -189,6 +205,8 @@ class ParallelTrainer:
             outs, new_aux = self._graph_fn(vals, list(aux), True, rng)
             return tuple(outs), tuple(new_aux)
 
+        if self.remat:
+            fwd = jax.checkpoint(fwd)
         outs, vjp_fn, new_aux = jax.vjp(fwd, params, has_aux=True)
         if self.compute_dtype is not None:
             # moving stats stay f32 across steps (stable jit signature)

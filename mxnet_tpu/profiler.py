@@ -1,0 +1,58 @@
+"""Tracing / profiling.
+
+The reference has no dedicated profiler — its observability is the
+Monitor callback, `Speedometer`, plan dumps and `MXNET_ENGINE_INFO` op
+logs (SURVEY §5). On TPU the right tool is the XLA profiler: this module
+wraps ``jax.profiler`` with a stable mxnet-style surface so traces can be
+captured around any training region and opened in TensorBoard/Perfetto.
+
+Usage::
+
+    mx.profiler.start("/tmp/traces")     # or profiler_set_config + start
+    ... training steps ...
+    mx.profiler.stop()
+
+    with mx.profiler.scope("epoch-3"):   # named sub-regions in the trace
+        train_epoch()
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+_state = {"dir": None, "running": False}
+
+
+def profiler_set_config(output_dir: str):
+    """Configure the trace output directory before :func:`start`."""
+    _state["dir"] = output_dir
+
+
+def start(output_dir: str | None = None):
+    """Begin capturing a device+host trace."""
+    if output_dir is not None:
+        _state["dir"] = output_dir
+    if _state["dir"] is None:
+        raise ValueError("profiler: no output dir configured")
+    jax.profiler.start_trace(_state["dir"])
+    _state["running"] = True
+
+
+def stop():
+    """End the capture and flush the trace to the output dir."""
+    if _state["running"]:
+        jax.profiler.stop_trace()
+        _state["running"] = False
+
+
+@contextlib.contextmanager
+def scope(name: str):
+    """Annotate a named region; nests inside an active trace."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def device_memory_profile() -> bytes:
+    """Snapshot of current device memory (pprof format)."""
+    return jax.profiler.device_memory_profile()

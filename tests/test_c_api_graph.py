@@ -1,0 +1,320 @@
+"""Exercise the full native C graph ABI (cpp/c_api_graph.cc) through
+ctypes — NDArray, function registry, Symbol, Executor, and KVStore all
+crossing the real C boundary, the analogue of the reference's bindings
+sitting on include/mxnet/c_api.h. Loading the library in-process reuses
+the already-initialized CPython, so the embed path degenerates to
+PyGILState_Ensure: the same code path an external C host would run."""
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+LIB = os.path.join(ROOT, "mxnet_tpu", "lib", "libmxnet_tpu_capi.so")
+
+mx_uint = ctypes.c_uint
+Handle = ctypes.c_void_p
+
+
+def _build():
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        return False
+    r = subprocess.run(["make", "-C", os.path.join(ROOT, "cpp"),
+                        "../mxnet_tpu/lib/libmxnet_tpu_capi.so"],
+                       capture_output=True, text=True)
+    return r.returncode == 0 and os.path.exists(LIB)
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not os.path.exists(LIB) and not _build():
+        pytest.skip("native capi library not built")
+    L = ctypes.CDLL(LIB)
+    L.MXTApiGetLastError.restype = ctypes.c_char_p
+    return L
+
+
+def check(lib, ret):
+    assert ret == 0, lib.MXTApiGetLastError().decode()
+
+
+def _make_nd(lib, arr):
+    shape = (mx_uint * arr.ndim)(*arr.shape)
+    h = Handle()
+    check(lib, lib.MXTNDArrayCreate(shape, arr.ndim, 1, 0, 0,
+                                    ctypes.byref(h)))
+    data = np.ascontiguousarray(arr, dtype=np.float32)
+    check(lib, lib.MXTNDArraySyncCopyFromCPU(
+        h, data.ctypes.data_as(ctypes.c_void_p), data.size))
+    return h
+
+
+def _read_nd(lib, h):
+    ndim = mx_uint()
+    pdata = ctypes.POINTER(mx_uint)()
+    check(lib, lib.MXTNDArrayGetShape(h, ctypes.byref(ndim),
+                                      ctypes.byref(pdata)))
+    shape = tuple(pdata[i] for i in range(ndim.value))
+    out = np.empty(shape, np.float32)
+    check(lib, lib.MXTNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), out.size))
+    return out
+
+
+def test_ndarray_roundtrip(lib):
+    rng = np.random.RandomState(0)
+    a = rng.randn(3, 4).astype(np.float32)
+    h = _make_nd(lib, a)
+    dtype = ctypes.c_int()
+    check(lib, lib.MXTNDArrayGetDType(h, ctypes.byref(dtype)))
+    assert dtype.value == 0
+    dev_type, dev_id = ctypes.c_int(), ctypes.c_int()
+    check(lib, lib.MXTNDArrayGetContext(h, ctypes.byref(dev_type),
+                                        ctypes.byref(dev_id)))
+    assert dev_id.value == 0
+    np.testing.assert_allclose(_read_nd(lib, h), a, rtol=1e-6)
+    check(lib, lib.MXTNDArrayFree(h))
+
+
+def test_func_invoke_plus(lib):
+    rng = np.random.RandomState(1)
+    a, b = rng.randn(2, 3).astype(np.float32), rng.randn(2, 3).astype(np.float32)
+    ha, hb, ho = _make_nd(lib, a), _make_nd(lib, b), _make_nd(lib, np.zeros((2, 3)))
+    fn = Handle()
+    check(lib, lib.MXTGetFunction(b"_plus", ctypes.byref(fn)))
+    nu, ns, nm, mask = mx_uint(), mx_uint(), mx_uint(), ctypes.c_int()
+    check(lib, lib.MXTFuncDescribe(fn, ctypes.byref(nu), ctypes.byref(ns),
+                                   ctypes.byref(nm), ctypes.byref(mask)))
+    assert (nu.value, ns.value, nm.value) == (2, 0, 1)
+    used = (Handle * 2)(ha, hb)
+    check(lib, lib.MXTFuncInvoke(fn, used, None, (Handle * 1)(ho)))
+    np.testing.assert_allclose(_read_nd(lib, ho), a + b, rtol=1e-6)
+    # registry listing includes the classics
+    n, arr = mx_uint(), ctypes.POINTER(Handle)()
+    check(lib, lib.MXTListFunctions(ctypes.byref(n), ctypes.byref(arr)))
+    names = {ctypes.cast(arr[i], ctypes.c_char_p).value.decode()
+             for i in range(n.value)}
+    assert {"_plus", "_set_value", "dot", "clip"} <= names
+
+
+def test_ndarray_save_load(lib, tmp_path):
+    fname = str(tmp_path / "weights.params").encode()
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    h = _make_nd(lib, a)
+    keys = (ctypes.c_char_p * 1)(b"w")
+    check(lib, lib.MXTNDArraySave(fname, 1, (Handle * 1)(h), keys))
+    # loads back through the C side
+    out_size, out_arr = mx_uint(), ctypes.POINTER(Handle)()
+    name_size, out_names = mx_uint(), ctypes.POINTER(ctypes.c_char_p)()
+    check(lib, lib.MXTNDArrayLoad(fname, ctypes.byref(out_size),
+                                  ctypes.byref(out_arr),
+                                  ctypes.byref(name_size),
+                                  ctypes.byref(out_names)))
+    assert out_size.value == 1 and name_size.value == 1
+    assert out_names[0] == b"w"
+    np.testing.assert_array_equal(_read_nd(lib, out_arr[0]), a)
+    # and through the Python side (same format)
+    loaded = mx.nd.load(fname.decode())
+    np.testing.assert_array_equal(loaded["w"].asnumpy(), a)
+    # raw bytes roundtrip
+    size, buf = ctypes.c_size_t(), ctypes.c_char_p()
+    check(lib, lib.MXTNDArraySaveRawBytes(h, ctypes.byref(size),
+                                          ctypes.byref(buf)))
+    raw = ctypes.string_at(buf, size.value)
+    h2 = Handle()
+    check(lib, lib.MXTNDArrayLoadFromRawBytes(raw, len(raw),
+                                              ctypes.byref(h2)))
+    np.testing.assert_array_equal(_read_nd(lib, h2), a)
+
+
+def _atomic(lib, op, params, name, kw_inputs):
+    """Two-phase create+compose protocol like reference bindings."""
+    h = Handle()
+    keys = (ctypes.c_char_p * len(params))(*[k.encode() for k in params])
+    vals = (ctypes.c_char_p * len(params))(
+        *[str(v).encode() for v in params.values()])
+    check(lib, lib.MXTSymbolCreateAtomicSymbol(
+        ctypes.c_char_p(op.encode()), len(params), keys, vals,
+        ctypes.byref(h)))
+    in_keys = (ctypes.c_char_p * len(kw_inputs))(
+        *[k.encode() for k in kw_inputs])
+    in_args = (Handle * len(kw_inputs))(*kw_inputs.values())
+    check(lib, lib.MXTSymbolCompose(h, name.encode(), len(kw_inputs),
+                                    in_keys, in_args))
+    return h
+
+
+def test_symbol_executor_end_to_end(lib):
+    data = Handle()
+    check(lib, lib.MXTSymbolCreateVariable(b"data", ctypes.byref(data)))
+    fc1 = _atomic(lib, "FullyConnected", {"num_hidden": 8}, "fc1",
+                  {"data": data})
+    act = _atomic(lib, "Activation", {"act_type": "relu"}, "relu1",
+                  {"data": fc1})
+    fc2 = _atomic(lib, "FullyConnected", {"num_hidden": 3}, "fc2",
+                  {"data": act})
+    out = _atomic(lib, "SoftmaxOutput", {}, "softmax", {"data": fc2})
+
+    # list arguments through C
+    n, arr = mx_uint(), ctypes.POINTER(ctypes.c_char_p)()
+    check(lib, lib.MXTSymbolListArguments(out, ctypes.byref(n),
+                                          ctypes.byref(arr)))
+    arg_names = [arr[i].decode() for i in range(n.value)]
+    assert arg_names == ["data", "fc1_weight", "fc1_bias", "fc2_weight",
+                         "fc2_bias", "softmax_label"]
+
+    # infer shape (CSR packing, like reference bindings)
+    batch = 4
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (mx_uint * 2)(0, 2)
+    sdata = (mx_uint * 2)(batch, 6)
+    iss, isn = mx_uint(), ctypes.POINTER(mx_uint)()
+    isd = ctypes.POINTER(ctypes.POINTER(mx_uint))()
+    oss, osn = mx_uint(), ctypes.POINTER(mx_uint)()
+    osd = ctypes.POINTER(ctypes.POINTER(mx_uint))()
+    ass_, asn = mx_uint(), ctypes.POINTER(mx_uint)()
+    asd = ctypes.POINTER(ctypes.POINTER(mx_uint))()
+    complete = ctypes.c_int()
+    check(lib, lib.MXTSymbolInferShape(
+        out, 1, keys, indptr, sdata,
+        ctypes.byref(iss), ctypes.byref(isn), ctypes.byref(isd),
+        ctypes.byref(oss), ctypes.byref(osn), ctypes.byref(osd),
+        ctypes.byref(ass_), ctypes.byref(asn), ctypes.byref(asd),
+        ctypes.byref(complete)))
+    assert complete.value == 1
+    arg_shapes = [tuple(isd[i][j] for j in range(isn[i]))
+                  for i in range(iss.value)]
+    assert arg_shapes[0] == (batch, 6)
+    assert arg_shapes[1] == (8, 6)
+    out_shapes = [tuple(osd[i][j] for j in range(osn[i]))
+                  for i in range(oss.value)]
+    assert out_shapes == [(batch, 3)]
+
+    # JSON roundtrip through C
+    js = ctypes.c_char_p()
+    check(lib, lib.MXTSymbolSaveToJSON(out, ctypes.byref(js)))
+    h2 = Handle()
+    check(lib, lib.MXTSymbolCreateFromJSON(js, ctypes.byref(h2)))
+
+    # bind + forward + backward through C
+    rng = np.random.RandomState(0)
+    arg_arrays = []
+    grad_arrays = []
+    for shp in arg_shapes:
+        arg_arrays.append(_make_nd(lib, rng.randn(*shp) * 0.1))
+        grad_arrays.append(_make_nd(lib, np.zeros(shp)))
+    # labels
+    label_np = rng.randint(0, 3, (batch,)).astype(np.float32)
+    check(lib, lib.MXTNDArraySyncCopyFromCPU(
+        arg_arrays[-1], label_np.ctypes.data_as(ctypes.c_void_p),
+        label_np.size))
+    args_c = (Handle * len(arg_arrays))(*arg_arrays)
+    grads_c = (Handle * len(grad_arrays))(*grad_arrays)
+    reqs = (mx_uint * len(arg_arrays))(*([1] * len(arg_arrays)))
+    exe = Handle()
+    check(lib, lib.MXTExecutorBind(out, 1, 0, len(arg_arrays), args_c,
+                                   grads_c, reqs, 0, None,
+                                   ctypes.byref(exe)))
+    check(lib, lib.MXTExecutorForward(exe, 1))
+    osize, oarr = mx_uint(), ctypes.POINTER(Handle)()
+    check(lib, lib.MXTExecutorOutputs(exe, ctypes.byref(osize),
+                                      ctypes.byref(oarr)))
+    assert osize.value == 1
+    probs = _read_nd(lib, oarr[0])
+    assert probs.shape == (batch, 3)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+    check(lib, lib.MXTExecutorBackward(exe, 0, None))
+    gw = _read_nd(lib, grad_arrays[1])
+    assert np.abs(gw).sum() > 0  # gradient flowed
+
+
+def test_kvstore_with_c_updater(lib):
+    kv = Handle()
+    check(lib, lib.MXTKVStoreCreate(b"local", ctypes.byref(kv)))
+    t = ctypes.c_char_p()
+    check(lib, lib.MXTKVStoreGetType(kv, ctypes.byref(t)))
+    assert t.value == b"local"
+    rank, size = ctypes.c_int(), ctypes.c_int()
+    check(lib, lib.MXTKVStoreGetRank(kv, ctypes.byref(rank)))
+    check(lib, lib.MXTKVStoreGetGroupSize(kv, ctypes.byref(size)))
+    assert rank.value == 0 and size.value >= 1
+
+    shape = (4,)
+    init = np.zeros(shape, np.float32)
+    hv = _make_nd(lib, init)
+    keys = (ctypes.c_int * 1)(3)
+    check(lib, lib.MXTKVStoreInit(kv, 1, keys, (Handle * 1)(hv)))
+
+    seen = []
+    UPDATER = ctypes.CFUNCTYPE(None, ctypes.c_int, Handle, Handle,
+                               ctypes.c_void_p)
+
+    @UPDATER
+    def updater(key, recv, local, closure):
+        # local += 2 * recv, computed through the same C ABI re-entrantly
+        r = _read_nd(lib, recv)
+        l = _read_nd(lib, local)
+        new = l + 2.0 * r
+        lib.MXTNDArraySyncCopyFromCPU(
+            local, np.ascontiguousarray(new).ctypes.data_as(ctypes.c_void_p),
+            new.size)
+        seen.append(key)
+
+    check(lib, lib.MXTKVStoreSetUpdater(kv, updater, None))
+    grad = np.ones(shape, np.float32)
+    hg = _make_nd(lib, grad)
+    check(lib, lib.MXTKVStorePush(kv, 1, keys, (Handle * 1)(hg), 0))
+    hout = _make_nd(lib, np.zeros(shape))
+    check(lib, lib.MXTKVStorePull(kv, 1, keys, (Handle * 1)(hout), 0))
+    np.testing.assert_allclose(_read_nd(lib, hout), 2.0 * grad)
+    assert seen == [3]
+
+    w = ctypes.c_int()
+    check(lib, lib.MXTKVStoreIsWorkerNode(ctypes.byref(w)))
+    assert w.value == 1
+    check(lib, lib.MXTKVStoreBarrier(kv))
+
+
+def test_atomic_symbol_listing(lib):
+    n, arr = mx_uint(), ctypes.POINTER(Handle)()
+    check(lib, lib.MXTSymbolListAtomicSymbolCreators(ctypes.byref(n),
+                                                     ctypes.byref(arr)))
+    names = {ctypes.cast(arr[i], ctypes.c_char_p).value.decode()
+             for i in range(n.value)}
+    assert {"Convolution", "FullyConnected", "BatchNorm",
+            "SoftmaxOutput"} <= names
+    # creator info carries param metadata
+    name = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    na, an = mx_uint(), ctypes.POINTER(ctypes.c_char_p)()
+    at, ad = ctypes.POINTER(ctypes.c_char_p)(), ctypes.POINTER(ctypes.c_char_p)()
+    check(lib, lib.MXTSymbolGetAtomicSymbolInfo(
+        ctypes.c_char_p(b"FullyConnected"), ctypes.byref(name),
+        ctypes.byref(desc), ctypes.byref(na), ctypes.byref(an),
+        ctypes.byref(at), ctypes.byref(ad)))
+    params = [an[i].decode() for i in range(na.value)]
+    assert "num_hidden" in params
+
+
+def test_capi_example_subprocess(lib):
+    """Run the standalone C client — the true embed path where C owns
+    main() and CPython is initialized by the library."""
+    exe = os.path.join(ROOT, "cpp", "example", "capi_example")
+    if not os.path.exists(exe):
+        r = subprocess.run(["make", "-C", os.path.join(ROOT, "cpp"),
+                            "example/capi_example"],
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            pytest.skip("cannot build capi_example: " + r.stderr[-500:])
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=ROOT + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([exe], capture_output=True, text=True, env=env,
+                       timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+    assert "capi_example OK" in r.stdout

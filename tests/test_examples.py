@@ -1,0 +1,54 @@
+"""Smoke tests for the example/ tree (the analogue of the reference's
+tests/python/train/ convergence suite, but driving the actual example
+scripts users run)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+EX = os.path.join(ROOT, "example")
+
+
+def _run(subdir, script, *args, timeout=420):
+    # strip any site dir that pins the platform (e.g. the axon tunnel's
+    # sitecustomize): the smoke tests must run on plain CPU
+    extra = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "site" not in os.path.basename(p)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join([ROOT] + extra))
+    return subprocess.run(
+        [sys.executable, script] + list(args),
+        cwd=os.path.join(EX, subdir), env=env, capture_output=True,
+        text=True, timeout=timeout)
+
+
+def test_train_mnist_mlp_synthetic():
+    r = _run("image-classification", "train_mnist.py",
+             "--num-examples", "2560", "--num-epochs", "2")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Validation-accuracy" in r.stderr + r.stdout
+
+
+def test_numpy_softmax_custom_op():
+    r = _run("numpy-ops", "numpy_softmax.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = r.stderr + r.stdout
+    assert "Validation-accuracy" in out
+
+
+def test_lstm_ptb_synthetic():
+    r = _run("rnn", "lstm_ptb.py", "--seq-len", "8", "--num-hidden", "64",
+             "--num-embed", "32", "--batch-size", "16", "--num-epochs", "1",
+             "--max-batches", "10")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "perplexity" in r.stderr + r.stdout
+
+
+@pytest.mark.slow
+def test_autoencoder():
+    r = _run("autoencoder", "mnist_sae.py", "--pretrain-epochs", "1",
+             "--finetune-epochs", "2")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "reconstruction mse" in r.stderr + r.stdout

@@ -347,3 +347,32 @@ def test_trainer_bf16_mixed_precision_converges():
         correct += (pred == b.label[0].asnumpy()).sum()
         total += len(pred)
     assert correct / total > 0.85, correct / total
+
+
+def test_remat_step_matches_plain():
+    """Gradient mirroring (MXNET_BACKWARD_DO_MIRROR ≙ jax.checkpoint)
+    must not change the numerics — only the memory/compute tradeoff."""
+    sym = _mlp_symbol()
+    rng = np.random.RandomState(0)
+    data = rng.randn(8, 64).astype(np.float32)
+    label = rng.randint(0, 10, (8,)).astype(np.float32)
+    shapes = {"data": data.shape, "softmax_label": label.shape}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    arg_params = {n: mx.nd.array(
+        np.random.RandomState(5).uniform(-0.07, 0.07, s).astype("f"))
+        for n, s in zip(sym.list_arguments(), arg_shapes)
+        if n not in shapes}
+    results = []
+    for remat in (False, True):
+        trainer = par.ParallelTrainer(
+            sym, shapes, optimizer="sgd", mesh=par.data_parallel_mesh(1),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            remat=remat)
+        trainer.init_params({k: v.copy() for k, v in arg_params.items()})
+        for _ in range(2):
+            trainer.step({"data": data, "softmax_label": label})
+        got, _ = trainer.get_params()
+        results.append({k: v.asnumpy() for k, v in got.items()})
+    for n in results[0]:
+        np.testing.assert_allclose(results[0][n], results[1][n],
+                                   rtol=1e-5, atol=1e-6, err_msg=n)

@@ -94,3 +94,39 @@ def test_torch_module_op():
     # tensor conversion helpers
     t = to_torch(mx.nd.array(x))
     np.testing.assert_array_equal(from_torch(t).asnumpy(), x)
+
+
+def test_misc_factor_scheduler():
+    """Legacy misc.FactorScheduler parity (reference python/mxnet/misc.py)."""
+    sched = mx.misc.FactorScheduler(step=10, factor=0.1)
+    sched.base_lr = 1.0
+    assert sched(0) == 1.0
+    assert abs(sched(10) - 0.1) < 1e-12
+    assert abs(sched(25) - 0.01) < 1e-12
+    import pytest
+    with pytest.raises(ValueError):
+        mx.misc.FactorScheduler(step=0)
+    with pytest.raises(ValueError):
+        mx.misc.FactorScheduler(step=1, factor=1.5)
+
+
+def test_profiler_trace(tmp_path):
+    """mx.profiler wraps jax.profiler: trace capture + named scopes."""
+    import jax.numpy as jnp
+    mx.profiler.start(str(tmp_path))
+    with mx.profiler.scope("region"):
+        (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+    mx.profiler.stop()
+    traces = list(tmp_path.rglob("*"))
+    assert traces, "no trace files written"
+
+
+def test_executor_debug_str_memory_plan():
+    """debug_str reports the XLA buffer plan (GraphExecutor::Print
+    parity: graph dump + 'Total N MB')."""
+    data = mx.symbol.Variable("data")
+    fc = mx.symbol.FullyConnected(data=data, name="fc", num_hidden=4)
+    out = mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+    exe = out.simple_bind(mx.cpu(), data=(2, 8))
+    s = exe.debug_str()
+    assert "Total" in s and "MB" in s
