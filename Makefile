@@ -1,0 +1,26 @@
+# Top-level build driver (the reference's Makefile + make/config.mk role).
+# The Python/XLA compute path needs no build; `make` produces the native
+# runtime libraries (RecordIO/image pipeline, C predict ABI, full C graph
+# ABI) into mxnet_tpu/lib/.
+
+all: native
+
+native:
+	$(MAKE) -C cpp all
+
+examples: native
+	$(MAKE) -C cpp example/predict_example example/capi_example
+
+test: native
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+lint:
+	python -m compileall -q mxnet_tpu tools example
+
+clean:
+	$(MAKE) -C cpp clean
+
+.PHONY: all native examples test bench lint clean

@@ -402,7 +402,13 @@ int MXTNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
   size_t esize = dtype == 1 ? 8 : (dtype == 2 || dtype == 16) ? 2
                  : dtype == 3 ? 1 : 4;
   size_t want = size * esize;
-  if (want > static_cast<size_t>(len)) want = static_cast<size_t>(len);
+  if (want != static_cast<size_t>(len)) {
+    Py_DECREF(r);
+    SetError("MXTNDArraySyncCopyToCPU: size mismatch (array has " +
+             std::to_string(len / esize) + " elements, caller asked for " +
+             std::to_string(size) + ")");
+    return -1;
+  }
   std::memcpy(data, buf, want);
   Py_DECREF(r);
   return 0;
@@ -782,7 +788,9 @@ static int InferShapeImpl(SymbolHandle sym, mx_uint num_args,
                           const mx_uint ***aux_shape_data, int *complete,
                           int partial) {
   ENTER();
-  PyObject *k = StrTuple(num_args, keys);
+  /* keys == NULL => positional inference (reference c_api.cc supports
+   * it); the shim maps shapes to list_arguments() order */
+  PyObject *k = keys ? StrTuple(num_args, keys) : PyTuple_New(0);
   PyObject *shapes = PyTuple_New(num_args);
   for (mx_uint i = 0; i < num_args; ++i) {
     mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
@@ -851,7 +859,7 @@ int MXTSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
                        const int **out_type_data, mx_uint *aux_type_size,
                        const int **aux_type_data, int *complete) {
   ENTER();
-  PyObject *k = StrTuple(num_args, keys);
+  PyObject *k = keys ? StrTuple(num_args, keys) : PyTuple_New(0);
   PyObject *t = IntTuple(num_args, arg_type_data);
   PyObject *r =
       Call("symbol_infer_type", Py_BuildValue("(KNN)", Id(sym), k, t));

@@ -372,8 +372,14 @@ def _pack_shapes(shapes) -> tuple:
 
 def symbol_infer_shape(hid, keys, shapes, partial: int = 0) -> tuple:
     sym = _get(hid)
+    keys = list(keys)
+    shapes = list(shapes)
+    if not keys and shapes:
+        # positional mode (reference MXSymbolInferShape with keys=NULL):
+        # shapes align with list_arguments() order
+        keys = sym.list_arguments()[:len(shapes)]
     kwargs = {k: tuple(int(x) for x in s)
-              for k, s in zip(list(keys), list(shapes)) if len(s)}
+              for k, s in zip(keys, shapes) if len(s)}
     try:
         arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**kwargs)
     except Exception:
@@ -388,8 +394,12 @@ def symbol_infer_shape(hid, keys, shapes, partial: int = 0) -> tuple:
 
 def symbol_infer_type(hid, keys, type_codes) -> tuple:
     sym = _get(hid)
+    keys = list(keys)
+    type_codes = list(type_codes)
+    if not keys and type_codes:
+        keys = sym.list_arguments()[:len(type_codes)]
     kwargs = {k: _CODE_TO_DTYPE[int(c)]
-              for k, c in zip(list(keys), list(type_codes)) if int(c) >= 0}
+              for k, c in zip(keys, type_codes) if int(c) >= 0}
     arg_types, out_types, aux_types = sym.infer_type(**kwargs)
     if arg_types is None:
         return 0, (), (), ()

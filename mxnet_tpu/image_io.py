@@ -37,7 +37,8 @@ class ImageRecordIter(DataIter):
                  rand_crop=False, rand_mirror=False, shuffle=False, seed=0,
                  num_parts=1, part_index=0, preprocess_threads=4,
                  prefetch_buffer=4, round_batch=True, data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", mean_img=None,
+                 max_rotate_angle=0, random_h=0, random_s=0, random_l=0):
         super().__init__()
         if len(data_shape) != 3:
             raise MXNetError("data_shape must be (channels, height, width)")
@@ -50,7 +51,13 @@ class ImageRecordIter(DataIter):
         self._data = None
         self._label = None
 
-        self._lib = get_lib()
+        # mean-image subtraction (reference iter_normalize.h: load the
+        # cached mean file, computing + saving it on first use) and the
+        # rotate/HSL augmenters (image_augmenter.h) live in the Python
+        # engine; requesting them routes past the native decoder.
+        extended = (mean_img is not None or max_rotate_angle or random_h
+                    or random_s or random_l)
+        self._lib = None if extended else get_lib()
         if self._lib is not None:
             self.handle = ctypes.c_void_p()
             c, h, w = data_shape
@@ -75,7 +82,11 @@ class ImageRecordIter(DataIter):
             self._py = _PyEngine(path_imgrec, self._data_shape, batch_size,
                                  label_width, (mean_r, mean_g, mean_b), scale,
                                  resize, rand_crop, rand_mirror, shuffle,
-                                 seed, num_parts, part_index, round_batch)
+                                 seed, num_parts, part_index, round_batch,
+                                 mean_img=mean_img,
+                                 max_rotate_angle=max_rotate_angle,
+                                 random_h=random_h, random_s=random_s,
+                                 random_l=random_l)
 
     @property
     def provide_data(self):
@@ -140,7 +151,8 @@ class _PyEngine:
 
     def __init__(self, path, data_shape, batch_size, label_width, means,
                  scale, resize, rand_crop, rand_mirror, shuffle, seed,
-                 num_parts, part_index, round_batch):
+                 num_parts, part_index, round_batch, mean_img=None,
+                 max_rotate_angle=0, random_h=0, random_s=0, random_l=0):
         import cv2  # noqa: F401  (validates availability early)
         self.path = path
         self.data_shape = data_shape
@@ -154,6 +166,12 @@ class _PyEngine:
         self.shuffle = shuffle
         self.seed = seed
         self.round_batch = round_batch
+        self.max_rotate_angle = max_rotate_angle
+        self.random_h = random_h
+        self.random_s = random_s
+        self.random_l = random_l
+        self.mean_arr = None
+        self._mean_img_path = mean_img
         # scan offsets once
         reader = rec.MXRecordIO(path, "r")
         offsets = []
@@ -167,6 +185,47 @@ class _PyEngine:
         if not self.offsets:
             raise MXNetError("empty shard")
         self.epoch = 0
+        self.reset()
+        if mean_img is not None:
+            self._setup_mean_img(mean_img)
+
+    def _setup_mean_img(self, path):
+        """Load the (c,h,w) mean image, computing and caching it on first
+        use like the reference (iter_normalize.h: compute over the dataset
+        with augmentation off, save, then subtract per sample)."""
+        import os
+        from . import ndarray as _nd
+        if os.path.exists(path):
+            loaded = _nd.load(path)
+            arr = (loaded.get("mean_img") if isinstance(loaded, dict)
+                   else loaded[0])
+            self.mean_arr = arr.asnumpy().astype(np.float32)
+            return
+        # compute over RAW pixels: augmentation off AND scalar
+        # normalization off, else the cached mean would bake in
+        # mean_r/g/b and scale (reference computes over raw images)
+        saved = (self.rand_crop, self.rand_mirror, self.max_rotate_angle,
+                 self.random_h, self.random_s, self.random_l, self.means,
+                 self.scale)
+        self.rand_crop = self.rand_mirror = False
+        self.max_rotate_angle = self.random_h = self.random_s = \
+            self.random_l = 0
+        self.means = np.zeros(3, np.float32)
+        self.scale = 1.0
+        total = np.zeros(self.data_shape, np.float64)
+        count = 0
+        for off in self.offsets:
+            img, _ = self._load(off)
+            total += img
+            count += 1
+        self.mean_arr = (total / max(count, 1)).astype(np.float32)
+        _nd.save(path, {"mean_img": _nd.array(self.mean_arr)})
+        (self.rand_crop, self.rand_mirror, self.max_rotate_angle,
+         self.random_h, self.random_s, self.random_l, self.means,
+         self.scale) = saved
+        # rewind the epoch counter so cold-cache (mean computed) and
+        # warm-cache (mean loaded) runs see identical shuffle/RNG streams
+        self.epoch -= 1
         self.reset()
 
     def reset(self):
@@ -202,9 +261,33 @@ class _PyEngine:
         img = img[y0:y0 + h, x0:x0 + w]
         if self.rand_mirror and self.rng.randint(2):
             img = img[:, ::-1]
+        if self.max_rotate_angle:
+            # works for 2-D grayscale and 3-D color alike
+            angle = self.rng.uniform(-self.max_rotate_angle,
+                                     self.max_rotate_angle)
+            m = cv2.getRotationMatrix2D((w / 2.0, h / 2.0), angle, 1.0)
+            img = cv2.warpAffine(np.ascontiguousarray(img), m, (w, h),
+                                 borderMode=cv2.BORDER_REFLECT)
+        if (self.random_h or self.random_s or self.random_l) and \
+                img.ndim == 3 and img.shape[2] == 3:
+            # reference image_augmenter.h HSL jitter: additive uniform
+            # noise per channel in HLS space
+            hls = cv2.cvtColor(np.ascontiguousarray(img), cv2.COLOR_RGB2HLS)
+            hls = hls.astype(np.float32)
+            hls[..., 0] += self.rng.uniform(-self.random_h, self.random_h)
+            hls[..., 1] += self.rng.uniform(-self.random_l, self.random_l)
+            hls[..., 2] += self.rng.uniform(-self.random_s, self.random_s)
+            hls[..., 0] %= 180.0
+            img = cv2.cvtColor(np.clip(hls, 0, 255).astype(np.uint8),
+                               cv2.COLOR_HLS2RGB)
         if img.ndim == 2:
             img = img[:, :, None]
-        out = (img.astype(np.float32) - self.means[:c]) * self.scale
+        out = img.astype(np.float32)
+        if self.mean_arr is not None:
+            out = out - self.mean_arr.transpose(1, 2, 0)
+            out = out * self.scale
+        else:
+            out = (out - self.means[:c]) * self.scale
         label = np.zeros(self.label_width, np.float32)
         lab = header.label
         if isinstance(lab, np.ndarray):

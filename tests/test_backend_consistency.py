@@ -1,0 +1,91 @@
+"""Backend-consistency harness: the same net, CPU interpreter vs the real
+TPU chip, outputs and gradients compared.
+
+Parity: the reference's GPU test suite (tests/python/gpu/
+test_operator_gpu.py) runs every symbol on CPU and GPU and compares;
+here the pair is XLA-CPU vs XLA-TPU (through the axon platform). Each
+backend runs in its own subprocess because the image's sitecustomize
+pins the platform at interpreter startup. Skips when no TPU is
+reachable, so the suite stays green on CPU-only CI.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+DRIVER = r"""
+import sys, json
+import numpy as np
+import mxnet_tpu as mx
+
+out_path = sys.argv[1]
+
+data = mx.symbol.Variable("data")
+net = mx.symbol.Convolution(data=data, name="conv", kernel=(3, 3),
+                            num_filter=8, pad=(1, 1))
+net = mx.symbol.BatchNorm(data=net, name="bn")
+net = mx.symbol.Activation(data=net, name="relu", act_type="relu")
+net = mx.symbol.Pooling(data=net, name="pool", pool_type="max",
+                        kernel=(2, 2), stride=(2, 2))
+net = mx.symbol.Flatten(data=net)
+net = mx.symbol.FullyConnected(data=net, name="fc", num_hidden=5)
+net = mx.symbol.SoftmaxOutput(data=net, name="softmax")
+
+shapes = {"data": (4, 3, 8, 8)}
+exe = net.simple_bind(mx.cpu(), grad_req="write", **shapes)
+rng = np.random.RandomState(42)
+for name, arr in exe.arg_dict.items():
+    if name == "softmax_label":
+        arr[:] = rng.randint(0, 5, arr.shape).astype(np.float32)
+    else:
+        arr[:] = rng.uniform(-0.5, 0.5, arr.shape).astype(np.float32)
+exe.forward(is_train=True)
+exe.backward()
+result = {"out": exe.outputs[0].asnumpy().tolist()}
+for name, g in exe.grad_dict.items():
+    if g is not None and name != "softmax_label":
+        result["grad_" + name] = g.asnumpy().tolist()
+with open(out_path, "w") as f:
+    json.dump(result, f)
+"""
+
+
+def _run_backend(tmp_path, tag, env_extra):
+    script = tmp_path / ("driver_%s.py" % tag)
+    script.write_text(DRIVER)
+    out = tmp_path / ("out_%s.json" % tag)
+    env = dict(os.environ, **env_extra)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, str(script), str(out)],
+                       capture_output=True, text=True, timeout=600,
+                       cwd=ROOT, env=env)
+    if r.returncode != 0:
+        return None, r.stderr
+    with open(out) as f:
+        return json.load(f), None
+
+
+@pytest.mark.slow
+def test_cpu_vs_tpu_consistency(tmp_path):
+    cpu_env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+    cpu_res, err = _run_backend(tmp_path, "cpu", cpu_env)
+    assert cpu_res is not None, err
+
+    # default env: the axon TPU platform if the tunnel is up
+    tpu_res, err = _run_backend(tmp_path, "tpu", {})
+    if tpu_res is None:
+        pytest.skip("TPU backend unavailable: %s" % (err or "")[-200:])
+
+    for key in cpu_res:
+        a = np.asarray(cpu_res[key], np.float64)
+        b = np.asarray(tpu_res[key], np.float64)
+        # TPU f32 convs/matmuls accumulate through bf16 passes; scale
+        # tolerance to the tensor's magnitude
+        tol = 5e-2 * max(np.abs(a).max(), 1e-3)
+        assert np.abs(a - b).max() < tol, (
+            key, np.abs(a - b).max(), tol)

@@ -318,3 +318,36 @@ def test_capi_example_subprocess(lib):
                        timeout=300)
     assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
     assert "capi_example OK" in r.stdout
+
+
+def test_infer_shape_positional_and_copy_size_check(lib):
+    """keys=NULL positional inference (reference c_api.cc supports it) and
+    the SyncCopyToCPU exact-size contract."""
+    data = Handle()
+    check(lib, lib.MXTSymbolCreateVariable(b"data", ctypes.byref(data)))
+    fc = _atomic(lib, "FullyConnected", {"num_hidden": 4}, "fc",
+                 {"data": data})
+    indptr = (mx_uint * 2)(0, 2)
+    sdata = (mx_uint * 2)(3, 7)
+    iss, isn = mx_uint(), ctypes.POINTER(mx_uint)()
+    isd = ctypes.POINTER(ctypes.POINTER(mx_uint))()
+    oss, osn = mx_uint(), ctypes.POINTER(mx_uint)()
+    osd = ctypes.POINTER(ctypes.POINTER(mx_uint))()
+    ass_, asn = mx_uint(), ctypes.POINTER(mx_uint)()
+    asd = ctypes.POINTER(ctypes.POINTER(mx_uint))()
+    complete = ctypes.c_int()
+    check(lib, lib.MXTSymbolInferShape(
+        fc, 1, None, indptr, sdata,
+        ctypes.byref(iss), ctypes.byref(isn), ctypes.byref(isd),
+        ctypes.byref(oss), ctypes.byref(osn), ctypes.byref(osd),
+        ctypes.byref(ass_), ctypes.byref(asn), ctypes.byref(asd),
+        ctypes.byref(complete)))
+    assert complete.value == 1
+    assert tuple(osd[0][j] for j in range(osn[0])) == (3, 4)
+
+    h = _make_nd(lib, np.zeros((2, 3), np.float32))
+    buf = np.empty(100, np.float32)
+    ret = lib.MXTNDArraySyncCopyToCPU(
+        h, buf.ctypes.data_as(ctypes.c_void_p), 100)
+    assert ret == -1
+    assert b"size mismatch" in lib.MXTApiGetLastError()

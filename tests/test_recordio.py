@@ -208,3 +208,39 @@ def test_image_record_iter_augment(tmp_path, engine):
     data = b.data[0].asnumpy()
     assert data.shape == (8, 3, 24, 24)
     assert data.min() >= -1.01 and data.max() <= 1.01
+
+
+def test_image_record_iter_mean_img_and_aug(tmp_path):
+    """mean_img (computed + cached like iter_normalize.h) and the
+    rotate/HSL augmenters (image_augmenter.h)."""
+    path = _make_rec(tmp_path)
+    mean_path = str(tmp_path / "mean.bin")
+    it = ImageRecordIter(path, (3, 24, 24), batch_size=8,
+                         mean_img=mean_path, shuffle=False)
+    assert os.path.exists(mean_path), "mean image not cached"
+    batch = next(iter(it))
+    data = batch.data[0].asnumpy()
+    # mean-subtracted: dataset-wide mean is ~0 once round-over padding
+    # (batch.pad duplicate samples) is dropped
+    all_vals = []
+    it.reset()
+    for b in it:
+        arr = b.data[0].asnumpy()
+        if b.pad:
+            arr = arr[:-b.pad]
+        all_vals.append(arr)
+    assert abs(np.concatenate(all_vals).mean()) < 1.0
+    # cached file reloads identically
+    it2 = ImageRecordIter(path, (3, 24, 24), batch_size=8,
+                          mean_img=mean_path, shuffle=False)
+    b2 = next(iter(it2)).data[0].asnumpy()
+    np.testing.assert_allclose(b2, data, atol=1e-5)
+    # rotate + HSL jitter produce valid batches that differ from plain
+    it3 = ImageRecordIter(path, (3, 24, 24), batch_size=8, shuffle=False,
+                          max_rotate_angle=15, random_h=10, random_s=10,
+                          random_l=10, seed=3)
+    b3 = next(iter(it3)).data[0].asnumpy()
+    assert b3.shape == (8, 3, 24, 24) and np.isfinite(b3).all()
+    it4 = ImageRecordIter(path, (3, 24, 24), batch_size=8, shuffle=False)
+    b4 = next(iter(it4)).data[0].asnumpy()
+    assert np.abs(b3 - b4).max() > 1e-3
