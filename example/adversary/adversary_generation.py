@@ -1,0 +1,77 @@
+"""Fast-gradient-sign adversarial examples (Goodfellow et al. 2014).
+
+Parity: reference ``example/adversary/adversary_generation.ipynb`` —
+train a small classifier, then bind an executor with ``grad_req`` on the
+*data* input, backprop the loss to the pixels, and perturb along
+``sign(grad)``. The accuracy collapse on perturbed inputs is the oracle.
+
+Uses synthetic MNIST-like blobs (no egress in this image).
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_net():
+    data = mx.symbol.Variable('data')
+    fc1 = mx.symbol.FullyConnected(data=data, name='fc1', num_hidden=64)
+    act1 = mx.symbol.Activation(data=fc1, name='relu1', act_type='relu')
+    fc2 = mx.symbol.FullyConnected(data=act1, name='fc2', num_hidden=10)
+    return mx.symbol.SoftmaxOutput(data=fc2, name='softmax')
+
+
+def synthetic(n, dim=64, classes=10, seed=0):
+    # class centers are FIXED across calls (train and test must share
+    # the distribution); only the sampling varies with `seed`
+    centers = np.random.RandomState(1234).randn(classes, dim) \
+        .astype(np.float32)
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, classes, n).astype(np.float32)
+    x = centers[labels.astype(int)] + \
+        0.25 * rng.randn(n, dim).astype(np.float32)
+    return x, labels
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--epsilon', type=float, default=1.5)
+    parser.add_argument('--num-epochs', type=int, default=5)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = build_net()
+    x, y = synthetic(6000)
+    model = mx.model.FeedForward(ctx=mx.cpu(), symbol=net,
+                                 num_epoch=args.num_epochs,
+                                 learning_rate=0.2, momentum=0.9)
+    model.fit(X=mx.io.NDArrayIter(x, y, batch_size=100, shuffle=True))
+
+    # bind with a gradient buffer on `data` — grad_req only for the input
+    batch = 100
+    xt, yt = synthetic(batch, seed=7)
+    exe = net.simple_bind(mx.cpu(), grad_req={"data": "write"},
+                          data=(batch, 64))
+    exe.copy_params_from(model.arg_params)
+    exe.arg_dict["data"][:] = xt
+    exe.arg_dict["softmax_label"][:] = yt
+    exe.forward(is_train=True)
+    clean_acc = float((exe.outputs[0].asnumpy().argmax(1) == yt).mean())
+    exe.backward()
+    grad_sign = np.sign(exe.grad_dict["data"].asnumpy())
+
+    # FGSM perturbation
+    exe.arg_dict["data"][:] = xt + args.epsilon * grad_sign
+    exe.forward(is_train=False)
+    adv_acc = float((exe.outputs[0].asnumpy().argmax(1) == yt).mean())
+    logging.info("clean accuracy %.3f -> adversarial accuracy %.3f "
+                 "(epsilon=%.2f)", clean_acc, adv_acc, args.epsilon)
+    assert clean_acc > 0.9 and adv_acc < clean_acc - 0.2, \
+        (clean_acc, adv_acc)
+    return clean_acc, adv_acc
+
+
+if __name__ == '__main__':
+    main()

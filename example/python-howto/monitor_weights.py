@@ -1,0 +1,40 @@
+"""Monitor layer outputs/weights during training.
+
+Parity: reference ``example/python-howto/monitor_weights.py`` — install
+a Monitor computing ``norm(d)/sqrt(d.size)`` over every output every N
+batches. Synthetic data (no egress).
+"""
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+data = mx.symbol.Variable('data')
+fc1 = mx.symbol.FullyConnected(data=data, name='fc1', num_hidden=128)
+act1 = mx.symbol.Activation(data=fc1, name='relu1', act_type="relu")
+fc2 = mx.symbol.FullyConnected(data=act1, name='fc2', num_hidden=64)
+act2 = mx.symbol.Activation(data=fc2, name='relu2', act_type="relu")
+fc3 = mx.symbol.FullyConnected(data=act2, name='fc3', num_hidden=10)
+mlp = mx.symbol.SoftmaxOutput(data=fc3, name='softmax')
+
+rng = np.random.RandomState(0)
+labels = rng.randint(0, 10, 2000).astype(np.float32)
+centers = rng.randn(10, 784).astype(np.float32)
+x = centers[labels.astype(int)] + 0.3 * rng.randn(2000, 784).astype("f")
+train = mx.io.NDArrayIter(x, labels, batch_size=100, shuffle=True)
+
+logging.basicConfig(level=logging.INFO)
+
+model = mx.model.FeedForward(
+    ctx=mx.cpu(), symbol=mlp, num_epoch=2,
+    learning_rate=0.1, momentum=0.9, wd=0.00001)
+
+
+def norm_stat(d):
+    return mx.nd.norm(d) / np.sqrt(d.size)
+
+
+mon = mx.monitor.Monitor(10, norm_stat)
+model.fit(X=train, monitor=mon,
+          batch_end_callback=mx.callback.Speedometer(100, 10))

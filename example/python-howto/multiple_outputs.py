@@ -1,0 +1,28 @@
+"""Create a multiple-output configuration.
+
+Parity: reference ``example/python-howto/multiple_outputs.py`` — group
+an internal layer with the loss head so one forward returns both.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+net = mx.symbol.Variable('data')
+fc1 = mx.symbol.FullyConnected(data=net, name='fc1', num_hidden=128)
+net = mx.symbol.Activation(data=fc1, name='relu1', act_type="relu")
+net = mx.symbol.FullyConnected(data=net, name='fc2', num_hidden=64)
+out = mx.symbol.SoftmaxOutput(data=net, name='softmax')
+group = mx.symbol.Group([fc1, out])
+print(group.list_outputs())
+
+executor = group.simple_bind(mx.cpu(), data=(2, 32))
+rng = np.random.RandomState(0)
+for name, arr in executor.arg_dict.items():
+    if name not in ("data", "softmax_label"):
+        arr[:] = rng.uniform(-0.1, 0.1, arr.shape).astype(np.float32)
+executor.arg_dict["data"][:] = rng.randn(2, 32).astype(np.float32)
+executor.forward()
+print("fc1 output:", executor.outputs[0].shape)      # (2, 128)
+print("softmax output:", executor.outputs[1].shape)  # (2, 64)
+assert executor.outputs[0].shape == (2, 128)
+assert executor.outputs[1].shape == (2, 64)

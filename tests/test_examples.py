@@ -52,3 +52,22 @@ def test_autoencoder():
              "--finetune-epochs", "2")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "reconstruction mse" in r.stderr + r.stdout
+
+
+def test_adversary_fgsm():
+    r = _run("adversary", "adversary_generation.py", "--num-epochs", "3")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "adversarial accuracy" in r.stderr + r.stdout
+
+
+def test_lstm_bucketing():
+    r = _run("rnn", "lstm_ptb_bucketing.py", "--num-epochs", "1",
+             "--n-sent", "400")
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_python_howto():
+    for script in ("multiple_outputs.py", "data_iter.py",
+                   "monitor_weights.py"):
+        r = _run("python-howto", script)
+        assert r.returncode == 0, (script, r.stderr[-2000:])

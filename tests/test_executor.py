@@ -80,3 +80,93 @@ def test_reshape():
     # base exec forward
     exe.forward(is_train=False)
     assert np.all(exe.outputs[0].asnumpy() == 4)
+
+
+def test_bucketing_executor_groups_share_params():
+    """sym_gen bucketing (reference executor_manager.py:343-360): one
+    executor group per bucket key with a DIFFERENT input shape per key,
+    all sharing parameters, batches routed by batch.bucket_key."""
+    import logging
+    from mxnet_tpu.executor_manager import DataParallelExecutorManager
+
+    vocab, embed, classes, batch_size = 12, 6, 8, 4
+
+    def sym_gen(seq_len):
+        """Variable-length bag-of-embeddings classifier: params
+        (embed_weight, fc) are shape-invariant in seq_len, like the
+        unrolled-LSTM bucketing the reference builds this for."""
+        data = mx.symbol.Variable("data")
+        emb = mx.symbol.Embedding(data=data, name="embed",
+                                  input_dim=vocab, output_dim=embed)
+        slices = mx.symbol.SliceChannel(emb, num_outputs=seq_len, axis=1,
+                                        squeeze_axis=True, name="slice")
+        total = mx.symbol.ElementWiseSum(*[slices[i]
+                                           for i in range(seq_len)],
+                                         name="sum")
+        fc = mx.symbol.FullyConnected(data=total, name="fc",
+                                      num_hidden=classes)
+        return mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+
+    class _Batch:
+        def __init__(self, key):
+            rng = np.random.RandomState(key)
+            self.bucket_key = key
+            self.tokens = rng.randint(0, vocab, (batch_size, key))
+            self.data = [mx.nd.array(self.tokens.astype(np.float32))]
+            self.label = [mx.nd.array(
+                rng.randint(0, classes, (batch_size,)).astype(np.float32))]
+            self.pad = 0
+            self.provide_data = [("data", (batch_size, key))]
+            self.provide_label = [("softmax_label", (batch_size,))]
+
+    class _Iter:
+        batch_size = 4
+        default_bucket_key = 3
+        provide_data = [("data", (batch_size, 3))]
+        provide_label = [("softmax_label", (batch_size,))]
+
+    sym = sym_gen(3)
+    arg_names = sym.list_arguments()
+    param_names = [n for n in arg_names
+                   if n not in ("data", "softmax_label")]
+    mgr = DataParallelExecutorManager(
+        sym, [mx.cpu()], _Iter(), arg_names, param_names,
+        sym.list_auxiliary_states(), logger=logging, sym_gen=sym_gen)
+
+    rng = np.random.RandomState(0)
+    shapes = dict(zip(arg_names, sym.infer_shape(data=(4, 3))[0]))
+    arg_params = {n: mx.nd.array(rng.uniform(-0.5, 0.5,
+                                             shapes[n]).astype("f"))
+                  for n in param_names}
+    mgr.set_params(arg_params, {})
+
+    # route batches of three different sequence lengths; check each
+    # against a numpy reference with the SHARED params
+    W = arg_params["embed_weight"].asnumpy()
+    fcw = arg_params["fc_weight"].asnumpy()
+    fcb = arg_params["fc_bias"].asnumpy()
+    for key in (3, 5, 7):
+        b = _Batch(key)
+        mgr.load_data_batch(b)
+        mgr.forward(is_train=True)
+        mgr.backward()
+        got = mgr.curr_execgrp.train_execs[0].outputs[0].asnumpy()
+        bag = W[b.tokens].sum(axis=1)          # (batch, embed)
+        logits = bag @ fcw.T + fcb
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        want = e / e.sum(1, keepdims=True)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                   err_msg="bucket %d" % key)
+    assert len(mgr.execgrp_bucket) == 3
+
+    # param sharing: write through bucket 7's executor, bucket 3 sees it
+    exec7 = mgr.execgrp_bucket[7].train_execs[0]
+    exec3 = mgr.execgrp_bucket[3].train_execs[0]
+    exec7.arg_dict["fc_weight"][:] = 0.0
+    exec7.arg_dict["fc_bias"][:] = 0.0
+    np.testing.assert_allclose(exec3.arg_dict["fc_weight"].asnumpy(), 0.0)
+    b = _Batch(3)
+    mgr.load_data_batch(b)
+    mgr.forward(is_train=False)
+    p = mgr.curr_execgrp.train_execs[0].outputs[0].asnumpy()
+    np.testing.assert_allclose(p, 1.0 / classes, atol=1e-5)
