@@ -37,6 +37,9 @@ class Initializer:
             self._init_beta(name, arr)
         elif name.endswith("weight"):
             self._init_weight(name, arr)
+        elif name.endswith("embed"):
+            # learned embeddings (e.g. pos_embed) init like weights
+            self._init_weight(name, arr)
         elif name.endswith("moving_mean"):
             self._init_zero(name, arr)
         elif name.endswith("moving_var"):

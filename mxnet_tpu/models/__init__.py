@@ -27,6 +27,8 @@ from .inception import (get_inception_bn_small, get_inception_bn,
                         get_inception_v3, get_googlenet)
 from .lstm import lstm_unroll, LSTMState, LSTMParam
 from .fcn import get_fcn_symbol
+from . import transformer
+from .transformer import get_transformer_lm, transformer_block
 
 _REGISTRY = {
     "mlp": get_mlp,

@@ -14,8 +14,8 @@ from .. import symbol as sym
 __all__ = ["transformer_block", "get_transformer_lm", "tp_rules"]
 
 
-def transformer_block(data, num_heads, hidden, name, causal=True,
-                      impl="flash", dropout=0.0):
+def transformer_block(data, num_heads, hidden, embed_dim, name,
+                      causal=True, impl="flash", dropout=0.0):
     """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x)). data: [B,T,E]."""
     ln1 = sym.LayerNorm(data=data,
                         gamma=sym.Variable(name + "_ln1_gamma"),
@@ -34,11 +34,10 @@ def transformer_block(data, num_heads, hidden, name, causal=True,
                         gamma=sym.Variable(name + "_ln2_gamma"),
                         beta=sym.Variable(name + "_ln2_beta"),
                         name=name + "_ln2")
-    # position-wise FFN as 1x FullyConnected pair over flattened time
     f1 = sym.FullyConnected(data=ln2, num_hidden=hidden,
                             name=name + "_ffn1", flatten=False)
     act = sym.Activation(data=f1, act_type="relu", name=name + "_ffn_relu")
-    f2 = sym.FullyConnected(data=act, num_hidden=0,  # set by caller embed
+    f2 = sym.FullyConnected(data=act, num_hidden=embed_dim,
                             name=name + "_ffn2", flatten=False)
     return x + f2
 
@@ -54,14 +53,15 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
     data = sym.Variable("data")  # [B, T] int tokens
     net = sym.Embedding(data=data, input_dim=vocab_size,
                         output_dim=embed_dim, name="embed")
-    # learned positional embedding via Embedding on position ids is a
-    # host-side concern; keep an additive learned position weight
-    pos = sym.Variable("pos_embed")  # [T, E] broadcast over batch
-    net = net + sym.Reshape(data=pos, target_shape=(0,), name="pos_rs") \
-        if False else net + pos
+    # learned additive positional embedding, rows sharded with their
+    # positions under sequence parallelism
+    net = sym.PositionalEmbedding(data=net,
+                                  pos=sym.Variable("pos_embed"),
+                                  name="pos_add")
     for i in range(num_layers):
-        net = _block(net, num_heads, ffn_hidden, embed_dim,
-                     "layer%d" % i, impl=impl, dropout=dropout)
+        net = transformer_block(net, num_heads, ffn_hidden, embed_dim,
+                                "layer%d" % i, impl=impl,
+                                dropout=dropout)
     ln_f = sym.LayerNorm(data=net, gamma=sym.Variable("lnf_gamma"),
                          beta=sym.Variable("lnf_beta"), name="lnf")
     logits = sym.FullyConnected(data=ln_f, num_hidden=vocab_size,
@@ -70,32 +70,6 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
     logits_t = sym.SwapAxis(data=logits, dim1=1, dim2=2, name="logits_t")
     return sym.SoftmaxOutput(data=logits_t, name="softmax",
                              multi_output=True)
-
-
-def _block(data, num_heads, hidden, embed_dim, name, impl, dropout):
-    ln1 = sym.LayerNorm(data=data,
-                        gamma=sym.Variable(name + "_ln1_gamma"),
-                        beta=sym.Variable(name + "_ln1_beta"),
-                        name=name + "_ln1")
-    attn = sym.MultiHeadAttention(
-        data=ln1,
-        qkv_weight=sym.Variable(name + "_qkv_weight"),
-        qkv_bias=sym.Variable(name + "_qkv_bias"),
-        out_weight=sym.Variable(name + "_proj_weight"),
-        out_bias=sym.Variable(name + "_proj_bias"),
-        num_heads=num_heads, causal=True, impl=impl, dropout=dropout,
-        name=name + "_attn")
-    x = data + attn
-    ln2 = sym.LayerNorm(data=x,
-                        gamma=sym.Variable(name + "_ln2_gamma"),
-                        beta=sym.Variable(name + "_ln2_beta"),
-                        name=name + "_ln2")
-    f1 = sym.FullyConnected(data=ln2, num_hidden=hidden,
-                            name=name + "_ffn1", flatten=False)
-    act = sym.Activation(data=f1, act_type="relu", name=name + "_ffn_relu")
-    f2 = sym.FullyConnected(data=act, num_hidden=embed_dim,
-                            name=name + "_ffn2", flatten=False)
-    return x + f2
 
 
 def tp_rules():

@@ -10,3 +10,4 @@ from .registry import REGISTRY, OpSpec, Param, register, get
 from . import tensor  # noqa: F401  (registers structural/elementwise ops)
 from . import nn      # noqa: F401  (registers NN ops)
 from . import loss    # noqa: F401  (registers output/loss ops)
+from . import attention  # noqa: F401  (registers LayerNorm/MultiHeadAttention)

@@ -46,6 +46,35 @@ class LayerNorm(OpSpec):
 
 
 @register
+class PositionalEmbedding(OpSpec):
+    """out = data + pos[None, :, :] — learned additive positional
+    embedding. data: [B, T, E]; pos: [T, E] (a parameter). Under
+    sequence parallelism pos rows shard with their positions
+    (``P('sp', None)``). No reference counterpart (transformer-era op).
+    """
+
+    name = "PositionalEmbedding"
+    params = {}
+
+    def arguments(self, p):
+        return ["data", "pos"]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        ins = list(in_shapes)
+        if d is not None:
+            if len(d) != 3:
+                raise MXNetError("PositionalEmbedding: data must be "
+                                 "[B, T, E]")
+            ins[1] = shape_assign(in_shapes[1], (d[1], d[2]),
+                                  "PositionalEmbedding pos")
+        return ins, [d], []
+
+    def forward(self, p, ins, aux, is_train, rng):
+        return [ins[0] + ins[1][None, :, :]], []
+
+
+@register
 class MultiHeadAttention(OpSpec):
     """Multi-head self-attention with fused QKV projection.
 
@@ -63,7 +92,8 @@ class MultiHeadAttention(OpSpec):
     params = {"num_heads": Param("int"),
               "causal": Param("bool", True),
               "impl": Param("str", "flash"),
-              "dropout": Param("float", 0.0)}
+              "dropout": Param("float", 0.0),
+              "axis_name": Param("str", "sp")}
 
     def arguments(self, p):
         return ["data", "qkv_weight", "qkv_bias", "out_weight", "out_bias"]
@@ -110,6 +140,14 @@ class MultiHeadAttention(OpSpec):
                 mask = jnp.tril(jnp.ones((t, t), bool))
                 s = jnp.where(mask[None, None], s, -jnp.inf)
             o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+        elif impl == "ring":
+            # sequence/context parallelism: this shard holds [B, T/n, E];
+            # K/V blocks rotate the ring over mesh axis `axis_name`.
+            # Only valid inside shard_map (SequenceParallelTrainer) —
+            # positions are derived from lax.axis_index.
+            from ..parallel.ring import _ring_attention_local
+            o = _ring_attention_local(q, k, v, axis_name=p["axis_name"],
+                                      causal=p["causal"], scale=None)
         else:
             raise MXNetError("MultiHeadAttention: unknown impl %r" % impl)
         o = o.reshape(b, t, e)

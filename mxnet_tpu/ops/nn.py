@@ -42,12 +42,15 @@ def _conv_out(h, k, s, p, d):
 class FullyConnected(OpSpec):
     """out = data · weightᵀ + bias (``fully_connected-inl.h:53-81``).
 
-    Data with >2 dims is flattened to (N, -1) like the reference. The dot
-    is the canonical MXU op; bias-add fuses into it under XLA.
+    Data with >2 dims is flattened to (N, -1) like the reference; with
+    ``flatten=False`` the dot applies position-wise over the trailing
+    axis ([..., K] -> [..., num_hidden]), the layout transformer FFNs
+    need. The dot is the canonical MXU op; bias-add fuses into it.
     """
 
     name = "FullyConnected"
-    params = {"num_hidden": Param("int"), "no_bias": Param("bool", False)}
+    params = {"num_hidden": Param("int"), "no_bias": Param("bool", False),
+              "flatten": Param("bool", True)}
 
     def arguments(self, p):
         return ["data", "weight"] if p["no_bias"] else ["data", "weight", "bias"]
@@ -58,18 +61,26 @@ class FullyConnected(OpSpec):
         w = in_shapes[1] if len(in_shapes) > 1 else None
         ins = list(in_shapes)
         if d is not None:
-            k = int(np.prod(d[1:]))
+            k = d[-1] if not p["flatten"] else int(np.prod(d[1:]))
             ins[1] = shape_assign(w, (nh, k), "FullyConnected weight")
         elif w is not None and None not in w and 0 not in w:
             pass  # cannot reconstruct data shape from weight alone
         if not p["no_bias"]:
             ins[2] = shape_assign(ins[2], (nh,), "FullyConnected bias")
-        out = (d[0], nh) if d is not None else None
+        if d is None:
+            out = None
+        elif p["flatten"]:
+            out = (d[0], nh)
+        else:
+            out = tuple(d[:-1]) + (nh,)
         return ins, [out], []
 
     def forward(self, p, ins, aux, is_train, rng):
-        x = ins[0].reshape(ins[0].shape[0], -1)
-        out = jnp.dot(x, ins[1].T)
+        if p["flatten"]:
+            x = ins[0].reshape(ins[0].shape[0], -1)
+            out = jnp.dot(x, ins[1].T)
+        else:
+            out = jnp.einsum("...k,nk->...n", ins[0], ins[1])
         if not p["no_bias"]:
             out = out + ins[2]
         return [out], []

@@ -106,9 +106,9 @@ class ParallelTrainer:
         batch_size = next(iter(self.input_shapes.values()))[0]
         self.global_batch = batch_size
         if isinstance(optimizer, str):
-            optimizer = opt_mod.create(optimizer,
-                                       rescale_grad=1.0 / batch_size,
-                                       **(optimizer_params or {}))
+            opt_kwargs = dict(optimizer_params or {})
+            opt_kwargs.setdefault("rescale_grad", 1.0 / batch_size)
+            optimizer = opt_mod.create(optimizer, **opt_kwargs)
         self.optimizer = optimizer
         self._opt_init, self._opt_update = make_functional(optimizer)
 

@@ -18,6 +18,11 @@ def _run(subdir, script, *args, timeout=420):
              if p and "site" not in os.path.basename(p)]
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=os.pathsep.join([ROOT] + extra))
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
     return subprocess.run(
         [sys.executable, script] + list(args),
         cwd=os.path.join(EX, subdir), env=env, capture_output=True,
@@ -71,3 +76,14 @@ def test_python_howto():
                    "monitor_weights.py"):
         r = _run("python-howto", script)
         assert r.returncode == 0, (script, r.stderr[-2000:])
+
+
+def test_long_context_ring_lm():
+    r = _run("long-context", "train_lm.py", "--seq-len", "64",
+             "--steps", "8", "--embed", "32", "--heads", "2",
+             "--layers", "1")
+    # needs the 8-device mesh: _run sets cpu; add device count
+    if r.returncode != 0 and "devices" in (r.stderr or ""):
+        pytest.skip(r.stderr[-300:])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "learning across the ring" in r.stderr + r.stdout
