@@ -40,6 +40,10 @@ class Initializer:
         elif name.endswith("embed"):
             # learned embeddings (e.g. pos_embed) init like weights
             self._init_weight(name, arr)
+        elif "_expert_w" in name:
+            self._init_weight(name, arr)  # MoE expert kernels
+        elif "_expert_b" in name:
+            self._init_bias(name, arr)
         elif name.endswith("moving_mean"):
             self._init_zero(name, arr)
         elif name.endswith("moving_var"):

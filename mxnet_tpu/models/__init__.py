@@ -28,7 +28,8 @@ from .inception import (get_inception_bn_small, get_inception_bn,
 from .lstm import lstm_unroll, LSTMState, LSTMParam
 from .fcn import get_fcn_symbol
 from . import transformer
-from .transformer import get_transformer_lm, transformer_block
+from .transformer import (get_transformer_lm, transformer_block,
+                          moe_transformer_block)
 
 _REGISTRY = {
     "mlp": get_mlp,

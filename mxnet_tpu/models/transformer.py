@@ -11,12 +11,12 @@ from __future__ import annotations
 
 from .. import symbol as sym
 
-__all__ = ["transformer_block", "get_transformer_lm", "tp_rules"]
+__all__ = ["transformer_block", "moe_transformer_block",
+           "get_transformer_lm", "tp_rules", "ep_rules"]
 
 
-def transformer_block(data, num_heads, hidden, embed_dim, name,
-                      causal=True, impl="flash", dropout=0.0):
-    """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x)). data: [B,T,E]."""
+def _attn_sublayer(data, num_heads, name, causal, impl, dropout):
+    """x + MHA(LN(x)) then LN — the shared attention half of a block."""
     ln1 = sym.LayerNorm(data=data,
                         gamma=sym.Variable(name + "_ln1_gamma"),
                         beta=sym.Variable(name + "_ln1_beta"),
@@ -34,6 +34,13 @@ def transformer_block(data, num_heads, hidden, embed_dim, name,
                         gamma=sym.Variable(name + "_ln2_gamma"),
                         beta=sym.Variable(name + "_ln2_beta"),
                         name=name + "_ln2")
+    return x, ln2
+
+
+def transformer_block(data, num_heads, hidden, embed_dim, name,
+                      causal=True, impl="flash", dropout=0.0):
+    """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x)). data: [B,T,E]."""
+    x, ln2 = _attn_sublayer(data, num_heads, name, causal, impl, dropout)
     f1 = sym.FullyConnected(data=ln2, num_hidden=hidden,
                             name=name + "_ffn1", flatten=False)
     act = sym.Activation(data=f1, act_type="relu", name=name + "_ffn_relu")
@@ -42,9 +49,25 @@ def transformer_block(data, num_heads, hidden, embed_dim, name,
     return x + f2
 
 
+def moe_transformer_block(data, num_heads, hidden, embed_dim, num_experts,
+                          name, causal=True, impl="flash", dropout=0.0):
+    """Transformer block whose FFN is a mixture of experts (MoEFFN):
+    shard the expert dim over ``ep`` (ep_rules) for expert parallelism."""
+    x, ln2 = _attn_sublayer(data, num_heads, name, causal, impl, dropout)
+    moe = sym.MoEFFN(
+        data=ln2,
+        gate_weight=sym.Variable(name + "_gate_weight"),
+        expert_w1=sym.Variable(name + "_expert_w1"),
+        expert_b1=sym.Variable(name + "_expert_b1"),
+        expert_w2=sym.Variable(name + "_expert_w2"),
+        expert_b2=sym.Variable(name + "_expert_b2"),
+        num_experts=num_experts, hidden=hidden, name=name + "_moe")
+    return x + moe
+
+
 def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
                        ffn_hidden=None, seq_len=None, impl="flash",
-                       dropout=0.0):
+                       dropout=0.0, num_experts=0):
     """Decoder-only LM: Embedding -> N blocks -> tied-free FC -> softmax
     over vocab per position (multi_output SoftmaxOutput, the reference's
     per-position softmax mode, softmax_output-inl.h multi_output)."""
@@ -59,9 +82,15 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
                                   pos=sym.Variable("pos_embed"),
                                   name="pos_add")
     for i in range(num_layers):
-        net = transformer_block(net, num_heads, ffn_hidden, embed_dim,
-                                "layer%d" % i, impl=impl,
-                                dropout=dropout)
+        if num_experts:
+            net = moe_transformer_block(net, num_heads, ffn_hidden,
+                                        embed_dim, num_experts,
+                                        "layer%d" % i, impl=impl,
+                                        dropout=dropout)
+        else:
+            net = transformer_block(net, num_heads, ffn_hidden, embed_dim,
+                                    "layer%d" % i, impl=impl,
+                                    dropout=dropout)
     ln_f = sym.LayerNorm(data=net, gamma=sym.Variable("lnf_gamma"),
                          beta=sym.Variable("lnf_beta"), name="lnf")
     logits = sym.FullyConnected(data=ln_f, num_hidden=vocab_size,
@@ -86,4 +115,17 @@ def tp_rules():
         (r"_ffn2_weight$", P(None, "tp")),
         (r"embed_weight$", P("tp", None)),
         (r"lm_head_weight$", P("tp", None)),
+    ]
+
+
+def ep_rules():
+    """Expert-parallel sharding rules: the leading num_experts dim of
+    every MoEFFN parameter shards over ``ep``; XLA inserts the psum over
+    ``ep`` for the gate-weighted combine."""
+    from ..parallel.shard import P
+    return [
+        (r"_expert_w1$", P("ep", None, None)),
+        (r"_expert_b1$", P("ep", None)),
+        (r"_expert_w2$", P("ep", None, None)),
+        (r"_expert_b2$", P("ep", None)),
     ]

@@ -75,6 +75,56 @@ class PositionalEmbedding(OpSpec):
 
 
 @register
+class MoEFFN(OpSpec):
+    """Mixture-of-experts position-wise FFN with soft (dense) routing.
+
+    data: [B, T, E]. gate_weight: [X, E] (X = num_experts);
+    expert_w1: [X, H, E], expert_b1: [X, H]; expert_w2: [X, E, H],
+    expert_b2: [X, E]. out[b,t] = Σ_x gate[b,t,x] · FFN_x(data[b,t]).
+
+    Expert parallelism: shard the leading X dim of the expert params
+    over an ``ep`` mesh axis (``models.transformer.ep_rules()``) — each
+    device computes its experts for all tokens and XLA inserts the psum
+    over ``ep`` for the gate-weighted combine. Soft routing keeps the op
+    fully differentiable and static-shaped (no capacity overflow), the
+    XLA-friendly starting point; top-k hard routing is a gating refinement
+    on the same parameter layout. No reference counterpart (2015).
+    """
+
+    name = "MoEFFN"
+    params = {"num_experts": Param("int"), "hidden": Param("int")}
+
+    def arguments(self, p):
+        return ["data", "gate_weight", "expert_w1", "expert_b1",
+                "expert_w2", "expert_b2"]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        ins = list(in_shapes)
+        if d is not None:
+            if len(d) != 3:
+                raise MXNetError("MoEFFN: data must be [B, T, E]")
+            e = d[2]
+            x, h = p["num_experts"], p["hidden"]
+            ins[1] = shape_assign(ins[1], (x, e), "MoEFFN gate_weight")
+            ins[2] = shape_assign(ins[2], (x, h, e), "MoEFFN expert_w1")
+            ins[3] = shape_assign(ins[3], (x, h), "MoEFFN expert_b1")
+            ins[4] = shape_assign(ins[4], (x, e, h), "MoEFFN expert_w2")
+            ins[5] = shape_assign(ins[5], (x, e), "MoEFFN expert_b2")
+        return ins, [d], []
+
+    def forward(self, p, ins, aux, is_train, rng):
+        x, gate_w, w1, b1, w2, b2 = ins
+        gates = jax.nn.softmax(jnp.einsum("bte,xe->btx", x, gate_w),
+                               axis=-1)
+        h = jax.nn.relu(jnp.einsum("bte,xhe->btxh", x, w1)
+                        + b1[None, None])
+        y = jnp.einsum("btxh,xeh->btxe", h, w2) + b2[None, None]
+        out = jnp.einsum("btxe,btx->bte", y, gates)
+        return [out], []
+
+
+@register
 class MultiHeadAttention(OpSpec):
     """Multi-head self-attention with fused QKV projection.
 
@@ -146,8 +196,17 @@ class MultiHeadAttention(OpSpec):
             # Only valid inside shard_map (SequenceParallelTrainer) —
             # positions are derived from lax.axis_index.
             from ..parallel.ring import _ring_attention_local
-            o = _ring_attention_local(q, k, v, axis_name=p["axis_name"],
-                                      causal=p["causal"], scale=None)
+            try:
+                o = _ring_attention_local(q, k, v,
+                                          axis_name=p["axis_name"],
+                                          causal=p["causal"], scale=None)
+            except NameError as e:
+                raise MXNetError(
+                    "MultiHeadAttention impl='ring' needs mesh axis %r "
+                    "bound by shard_map — train this symbol with "
+                    "SequenceParallelTrainer, or use impl='flash'/"
+                    "'dense' for single-program execution (%s)"
+                    % (p["axis_name"], e)) from e
         else:
             raise MXNetError("MultiHeadAttention: unknown impl %r" % impl)
         o = o.reshape(b, t, e)

@@ -31,20 +31,14 @@ from jax import shard_map
 
 from ..base import MXNetError
 from .. import ndarray as nd
-from ..ndarray import NDArray
 from .. import optimizer as opt_mod
 from ..initializer import Uniform
 from .graph import make_graph_fn
 from .shard import P
 from .optim import make_functional
+from .trainer import _as_jnp
 
 __all__ = ["SequenceParallelTrainer"]
-
-
-def _as_jnp(v):
-    if isinstance(v, NDArray):
-        return v._val
-    return jnp.asarray(v)
 
 
 class SequenceParallelTrainer:

@@ -450,3 +450,41 @@ def test_sequence_parallel_adam_finite():
     assert np.isfinite(float(nll))
     for v in tr.params.values():
         assert np.isfinite(np.asarray(jax.device_get(v))).all()
+
+
+def test_moe_expert_parallel_matches_single_device():
+    """Expert parallelism: MoE transformer trained with experts sharded
+    over ep=4 must match the unsharded single-device step exactly."""
+    from mxnet_tpu.models import get_transformer_lm
+    from mxnet_tpu.models.transformer import ep_rules
+
+    vocab, B, T, E = 10, 4, 8, 8
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, vocab, (B, T)).astype(np.float32)
+    label = rng.randint(0, vocab, (B, T)).astype(np.float32)
+    shapes = {"data": (B, T), "softmax_label": (B, T)}
+    sym = get_transformer_lm(vocab, num_layers=1, embed_dim=E,
+                             num_heads=2, impl="dense", num_experts=4)
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    prng = np.random.RandomState(5)
+    init = {n: mx.nd.array(prng.uniform(-0.1, 0.1, s).astype("f"))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in shapes}
+
+    results = []
+    for mesh_axes, rules in [({"dp": 1}, None),
+                             ({"dp": 2, "ep": 4},
+                              par.ShardingRules(par.build_mesh(
+                                  {"dp": 2, "ep": 4}), param_rules=ep_rules()))]:
+        mesh = par.build_mesh(mesh_axes) if rules is None else rules.mesh
+        tr = par.ParallelTrainer(
+            sym, shapes, optimizer="sgd", mesh=mesh, rules=rules,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+        tr.init_params({k: v.copy() for k, v in init.items()})
+        for _ in range(2):
+            tr.step({"data": data, "softmax_label": label})
+        got, _ = tr.get_params()
+        results.append({k: v.asnumpy() for k, v in got.items()})
+    for n in results[0]:
+        np.testing.assert_allclose(results[0][n], results[1][n],
+                                   rtol=2e-4, atol=2e-5, err_msg=n)
