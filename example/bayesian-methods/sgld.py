@@ -1,0 +1,82 @@
+"""Stochastic Gradient Langevin Dynamics (Welling & Teh 2011).
+
+Parity: reference ``example/bayesian-methods/`` (sgld.ipynb /
+bdk.ipynb) — the SGLD optimizer draws posterior samples by adding
+N(0, lr) noise to each SGD step. Here: Bayesian linear regression with a
+known Gaussian posterior; the oracle is the SGLD sample mean/covariance
+matching the analytic posterior.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--n', type=int, default=512)
+    parser.add_argument('--dim', type=int, default=3)
+    parser.add_argument('--burn-in', type=int, default=300)
+    parser.add_argument('--samples', type=int, default=1500)
+    parser.add_argument('--lr', type=float, default=1e-3)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(args.dim).astype(np.float32)
+    x = rng.randn(args.n, args.dim).astype(np.float32)
+    noise_std = 0.5
+    y = x @ w_true + noise_std * rng.randn(args.n).astype(np.float32)
+
+    # analytic posterior with prior w ~ N(0, sigma_p^2 I):
+    #   cov = (X^T X / s^2 + I/sigma_p^2)^-1,  mean = cov X^T y / s^2
+    sigma_p = 10.0
+    prec = x.T @ x / noise_std**2 + np.eye(args.dim) / sigma_p**2
+    cov = np.linalg.inv(prec)
+    mean = cov @ (x.T @ y) / noise_std**2
+
+    # SGLD on the negative log posterior via symbol graph gradients.
+    # LinearRegressionOutput's gradient is (pred - y) summed over batch;
+    # scale to the N(0, s^2) likelihood with rescale_grad = 1/s^2 (full
+    # batch, so no minibatch stochasticity — pure Langevin dynamics).
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=1, no_bias=True,
+                               name="w")
+    net = mx.sym.LinearRegressionOutput(data=fc, name="softmax")
+    exe = net.simple_bind(mx.cpu(), grad_req="write",
+                          data=(args.n, args.dim))
+    exe.arg_dict["data"][:] = x
+    exe.arg_dict["softmax_label"][:] = y[:, None]
+    exe.arg_dict["w_weight"][:] = 0.0
+
+    opt = mx.optimizer.SGLD(learning_rate=args.lr,
+                            rescale_grad=1.0 / noise_std**2,
+                            wd=1.0 / sigma_p**2)
+    updater = mx.optimizer.get_updater(opt)
+    samples = []
+    for it in range(args.burn_in + args.samples):
+        exe.forward(is_train=True)
+        exe.backward()
+        updater(0, exe.grad_dict["w_weight"], exe.arg_dict["w_weight"])
+        if it >= args.burn_in:
+            samples.append(exe.arg_dict["w_weight"].asnumpy().ravel().copy())
+    samples = np.array(samples)
+
+    est_mean = samples.mean(axis=0)
+    est_cov = np.cov(samples.T)
+    logging.info("posterior mean  analytic %s", np.round(mean, 3))
+    logging.info("posterior mean  SGLD     %s", np.round(est_mean, 3))
+    logging.info("posterior var   analytic %s", np.round(np.diag(cov), 5))
+    logging.info("posterior var   SGLD     %s",
+                 np.round(np.diag(est_cov), 5))
+    assert np.abs(est_mean - mean).max() < 0.1, (est_mean, mean)
+    # variances within a factor of ~3 (MCMC with finite chain)
+    ratio = np.diag(est_cov) / np.diag(cov)
+    assert (ratio > 0.3).all() and (ratio < 3.0).all(), ratio
+    logging.info("SGLD samples match the analytic posterior")
+
+
+if __name__ == '__main__':
+    main()

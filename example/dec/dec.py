@@ -1,0 +1,169 @@
+"""Deep Embedded Clustering (Xie, Girshick, Farhadi 2016).
+
+Parity: reference ``example/dec/dec.py`` — pretrain an autoencoder,
+k-means the embeddings to initialize cluster centers, then refine
+encoder + centers by minimizing KL(P || Q) where Q is the student-t soft
+assignment and P its sharpened target distribution. The cluster layer is
+a custom ``NumpyOp`` exactly as in the reference.
+
+Synthetic gaussian-mixture data (no egress); the oracle is clustering
+accuracy after DEC refinement beating the raw k-means initialization.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def cluster_acc(y_pred, y):
+    """Best-permutation accuracy via greedy assignment (the reference
+    uses the Hungarian algorithm; greedy is adequate for k<=8)."""
+    d = int(max(y_pred.max(), y.max())) + 1
+    w = np.zeros((d, d))
+    for i in range(y_pred.size):
+        w[int(y_pred[i]), int(y[i])] += 1
+    total = 0
+    used_r, used_c = set(), set()
+    for _ in range(d):
+        r, c = np.unravel_index(
+            np.argmax(np.where(
+                np.isin(np.arange(d), list(used_r))[:, None] |
+                np.isin(np.arange(d), list(used_c))[None, :],
+                -1, w)), (d, d))
+        total += w[r, c]
+        used_r.add(r)
+        used_c.add(c)
+    return total / y_pred.size
+
+
+def kmeans(x, k, iters=50, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = x[rng.choice(len(x), k, replace=False)]
+    for _ in range(iters):
+        assign = np.argmin(((x[:, None] - centers[None]) ** 2).sum(-1), 1)
+        for j in range(k):
+            if (assign == j).any():
+                centers[j] = x[assign == j].mean(0)
+    return centers, assign
+
+
+class ClusterLoss(mx.operator.NumpyOp):
+    """Student-t soft assignment + KL(P||Q) gradient (reference dec.py's
+    cluster layer). Inputs: z [N,D] embeddings, mu [K,D] centers."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ['data', 'mu']
+
+    def list_outputs(self):
+        return ['output']
+
+    def infer_shape(self, in_shape):
+        z, mu = in_shape
+        return [z, mu], [(z[0], mu[0])]
+
+    @staticmethod
+    def _q(z, mu):
+        d2 = ((z[:, None] - mu[None]) ** 2).sum(-1)
+        q = 1.0 / (1.0 + d2)
+        return q / q.sum(1, keepdims=True)
+
+    def forward(self, in_data, out_data):
+        out_data[0][:] = self._q(in_data[0], in_data[1])
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        z, mu = in_data
+        q = out_data[0]
+        p = (q ** 2) / q.sum(0)
+        p = p / p.sum(1, keepdims=True)
+        diff = z[:, None] - mu[None]          # [N,K,D]
+        w = (p - q) / (1.0 + (diff ** 2).sum(-1))   # [N,K]
+        # DEC paper eq. 4/5: dL/dz_i = 2 Σ_j w_ij (z_i - μ_j),
+        # dL/dμ_j = -2 Σ_i w_ij (z_i - μ_j), w_ij = (p-q)/(1+d²)...
+        # note the sign: we MINIMIZE KL(P||Q)
+        in_grad[0][:] = 2.0 * (w[:, :, None] * diff).sum(1)
+        in_grad[1][:] = -2.0 * (w[:, :, None] * diff).sum(0)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--k', type=int, default=4)
+    parser.add_argument('--dim', type=int, default=16)
+    parser.add_argument('--embed', type=int, default=4)
+    parser.add_argument('--n', type=int, default=800)
+    parser.add_argument('--pretrain-epochs', type=int, default=20)
+    parser.add_argument('--dec-iters', type=int, default=100)
+    parser.add_argument('--lr', type=float, default=0.02)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    np.random.seed(42)     # FeedForward init draws from the global PRNG
+    mx.random.seed(42)
+
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, args.k, args.n)
+    centers = 2.0 * rng.randn(args.k, args.dim).astype(np.float32)
+    x = (centers[y] + 0.6 * rng.randn(args.n, args.dim)).astype(np.float32)
+
+    # 1. autoencoder pretraining for the encoder
+    data = mx.sym.Variable("data")
+    enc = mx.sym.FullyConnected(data=data, num_hidden=16, name="enc1")
+    enc = mx.sym.Activation(data=enc, act_type="relu", name="enc1_relu")
+    enc = mx.sym.FullyConnected(data=enc, num_hidden=args.embed,
+                                name="enc2")
+    dec_ = mx.sym.FullyConnected(data=enc, num_hidden=16, name="dec1")
+    dec_ = mx.sym.Activation(data=dec_, act_type="relu", name="dec1_relu")
+    dec_ = mx.sym.FullyConnected(data=dec_, num_hidden=args.dim,
+                                 name="dec2")
+    ae = mx.sym.LinearRegressionOutput(data=dec_, name="softmax")
+    model = mx.model.FeedForward(ctx=mx.cpu(), symbol=ae,
+                                 num_epoch=args.pretrain_epochs,
+                                 learning_rate=0.01, momentum=0.9)
+    model.fit(X=mx.io.NDArrayIter(x, x.copy(), batch_size=100,
+                                  shuffle=True,
+                                  label_name="softmax_label"),
+              eval_metric="mse")
+
+    # 2. k-means init in embedding space
+    embed_sym = mx.sym.Group([enc])
+    eexe = embed_sym.simple_bind(mx.cpu(), grad_req={"data": "null"},
+                                 data=(args.n, args.dim))
+    eexe.copy_params_from(model.arg_params, allow_extra_params=True)
+    eexe.arg_dict["data"][:] = x
+    eexe.forward()
+    z0 = eexe.outputs[0].asnumpy()
+    mu, assign0 = kmeans(z0, args.k)
+    acc0 = cluster_acc(assign0, y)
+
+    # 3. DEC refinement: encoder + centers trained through ClusterLoss
+    closs = ClusterLoss()
+    dec_sym = closs(data=enc, mu=mx.sym.Variable("mu"), name="dec")
+    dexe = dec_sym.simple_bind(mx.cpu(), grad_req="write",
+                               data=(args.n, args.dim),
+                               mu=(args.k, args.embed))
+    dexe.copy_params_from(model.arg_params, allow_extra_params=True)
+    dexe.arg_dict["data"][:] = x
+    dexe.arg_dict["mu"][:] = mu
+    opt = mx.optimizer.SGD(learning_rate=args.lr, momentum=0.9,
+                           rescale_grad=1.0 / args.n)
+    updater = mx.optimizer.get_updater(opt)
+    train_names = [n for n in dec_sym.list_arguments() if n != "data"]
+    for it in range(args.dec_iters):
+        dexe.forward(is_train=True)
+        dexe.backward()
+        for i, name in enumerate(train_names):
+            updater(i, dexe.grad_dict[name], dexe.arg_dict[name])
+    dexe.forward(is_train=False)
+    q = dexe.outputs[0].asnumpy()
+    acc1 = cluster_acc(q.argmax(1), y)
+    logging.info("clustering acc: kmeans %.3f -> DEC %.3f", acc0, acc1)
+    assert acc1 >= acc0 - 0.02, (acc0, acc1)
+    assert acc1 > 0.75, acc1
+    logging.info("DEC refinement done")
+
+
+if __name__ == '__main__':
+    main()

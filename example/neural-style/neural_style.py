@@ -1,0 +1,121 @@
+"""Neural style transfer (Gatys et al. 2015) by optimizing the input
+image.
+
+Parity: reference ``example/neural-style/`` — content loss on deep
+feature maps, style loss on their Gram matrices, gradient descent on the
+IMAGE through a fixed conv net. The reference downloads pretrained
+VGG-19; this image has no egress, so the demo uses a small fixed
+random-init conv feature extractor (style/content losses and the
+optimize-the-input machinery are identical; swap in real VGG weights via
+``--params`` for photographic results).
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def feature_net():
+    """3-stage conv feature pyramid; returns Group of stage outputs."""
+    data = mx.sym.Variable("data")
+    feats = []
+    x = data
+    for i, (nf, stride) in enumerate([(16, 1), (32, 2), (64, 2)]):
+        x = mx.sym.Convolution(data=x, num_filter=nf, kernel=(3, 3),
+                               pad=(1, 1), stride=(stride, stride),
+                               name="conv%d" % i)
+        x = mx.sym.Activation(data=x, act_type="relu", name="relu%d" % i)
+        feats.append(x)
+    return mx.sym.Group(feats)
+
+
+def gram(f):
+    c, h, w = f.shape
+    m = f.reshape(c, h * w)
+    return (m @ m.T) / (c * h * w)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--size', type=int, default=64)
+    parser.add_argument('--steps', type=int, default=80)
+    parser.add_argument('--lr', type=float, default=0.03)
+    parser.add_argument('--content-weight', type=float, default=1.0)
+    parser.add_argument('--style-weight', type=float, default=100.0)
+    parser.add_argument('--params', type=str, default=None,
+                        help='optional .params file with conv weights')
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    hw = args.size
+    # synthetic "photos": content = smooth blobs, style = stripes
+    yy, xx = np.mgrid[0:hw, 0:hw] / hw
+    content_img = np.stack([np.exp(-((xx - .3)**2 + (yy - .4)**2) * 8),
+                            np.exp(-((xx - .7)**2 + (yy - .6)**2) * 8),
+                            0.5 * np.ones_like(xx)]).astype(np.float32)
+    style_img = np.stack([np.sin(xx * 20), np.sin((xx + yy) * 15),
+                          np.sin(yy * 25)]).astype(np.float32) * .5 + .5
+
+    sym = feature_net()
+    exe = sym.simple_bind(mx.cpu(), grad_req={"data": "write"},
+                          data=(1, 3, hw, hw))
+    init = mx.initializer.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name != "data":
+            init(name, arr)
+    if args.params:
+        loaded = mx.nd.load(args.params)
+        exe.copy_params_from({k.replace("arg:", ""): v
+                              for k, v in loaded.items()})
+
+    def features(img):
+        exe.arg_dict["data"][:] = img[None]
+        exe.forward(is_train=True)
+        return [o.asnumpy()[0] for o in exe.outputs]
+
+    content_feats = features(content_img)
+    style_grams = [gram(f) for f in features(style_img)]
+
+    img = rng.rand(3, hw, hw).astype(np.float32)
+    first_loss = None
+    for step in range(args.steps):
+        exe.arg_dict["data"][:] = img[None]
+        exe.forward(is_train=True)
+        outs = [o.asnumpy()[0] for o in exe.outputs]
+        # gradients of the combined loss wrt each feature map
+        head_grads = []
+        loss = 0.0
+        for i, f in enumerate(outs):
+            g = np.zeros_like(f)
+            if i == len(outs) - 1:  # content on the deepest stage
+                diff = f - content_feats[i]
+                loss += args.content_weight * 0.5 * (diff ** 2).mean()
+                g += args.content_weight * diff / diff.size
+            c, h, w = f.shape
+            gm = gram(f)
+            gdiff = gm - style_grams[i]
+            loss += args.style_weight * 0.25 * (gdiff ** 2).sum()
+            m = f.reshape(c, h * w)
+            g += args.style_weight * (gdiff @ m).reshape(f.shape) \
+                / (c * h * w)
+            head_grads.append(mx.nd.array(g[None]))
+        exe.backward(head_grads)
+        g_img = exe.grad_dict["data"].asnumpy()[0]
+        # normalized gradient step (standard style-transfer trick: loss
+        # scale depends on the feature net, the direction does not)
+        img -= args.lr * g_img / (np.abs(g_img).max() + 1e-12)
+        img = np.clip(img, 0, 1)
+        if first_loss is None:
+            first_loss = loss
+        if step % 10 == 0:
+            logging.info("step %d  loss %.5f", step, loss)
+    logging.info("loss %.5f -> %.5f", first_loss, loss)
+    assert loss < 0.5 * first_loss, (first_loss, loss)
+    logging.info("style transfer converged")
+
+
+if __name__ == '__main__':
+    main()

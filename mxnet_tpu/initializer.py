@@ -41,7 +41,7 @@ class Initializer:
             # learned embeddings (e.g. pos_embed) init like weights
             self._init_weight(name, arr)
         elif "_expert_w" in name:
-            self._init_weight(name, arr)  # MoE expert kernels
+            self._init_expert(name, arr)  # MoE expert kernels
         elif "_expert_b" in name:
             self._init_bias(name, arr)
         elif name.endswith("moving_mean"):
@@ -81,6 +81,22 @@ class Initializer:
 
     def _init_weight(self, name, arr):
         raise NotImplementedError("Must override it")
+
+    def _init_expert(self, name, arr):
+        """MoE expert banks [X, out, in]: initialize each expert's 2-D
+        kernel independently so fan-in/out (and orthogonality) are
+        per-expert, not across the flattened bank."""
+        import numpy as _np
+        from . import ndarray as _nd
+        if arr.ndim <= 2:
+            self._init_weight(name, arr)
+            return
+        out = _np.empty(arr.shape, dtype=_np.float32)
+        for x in range(arr.shape[0]):
+            sl = _nd.empty(arr.shape[1:])
+            self._init_weight(name, sl)
+            out[x] = sl.asnumpy()
+        arr[:] = out
 
     def _init_default(self, name, _):
         raise ValueError(

@@ -87,3 +87,24 @@ def test_long_context_ring_lm():
         pytest.skip(r.stderr[-300:])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "learning across the ring" in r.stderr + r.stdout
+
+
+def test_sgld_posterior():
+    r = _run("bayesian-methods", "sgld.py", "--samples", "800",
+             "--burn-in", "200")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "match the analytic posterior" in r.stderr + r.stdout
+
+
+def test_neural_style():
+    r = _run("neural-style", "neural_style.py", "--steps", "50",
+             "--size", "48")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "style transfer converged" in r.stderr + r.stdout
+
+
+def test_dec_clustering():
+    r = _run("dec", "dec.py", "--pretrain-epochs", "12",
+             "--dec-iters", "50")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "DEC refinement done" in r.stderr + r.stdout
