@@ -23,6 +23,8 @@ def main():
     parser.add_argument('--lr', type=float, default=1e-3)
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+    np.random.seed(7)   # Xavier/SGLD noise draw from global PRNGs
+    mx.random.seed(7)
 
     rng = np.random.RandomState(0)
     w_true = rng.randn(args.dim).astype(np.float32)

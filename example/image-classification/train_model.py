@@ -51,6 +51,8 @@ def fit(args, network, data_loader):
             step=max(int(epoch_size * args.lr_factor_epoch), 1),
             factor=args.lr_factor)
 
+    if getattr(args, 'clip_gradient', None) is not None:
+        model_args['clip_gradient'] = args.clip_gradient
     model = mx.model.FeedForward(
         ctx=devs,
         symbol=network,

@@ -48,6 +48,8 @@ def main():
                         help='optional .params file with conv weights')
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+    np.random.seed(7)   # Xavier/SGLD noise draw from global PRNGs
+    mx.random.seed(7)
 
     rng = np.random.RandomState(0)
     hw = args.size

@@ -12,11 +12,52 @@ import time
 __all__ = ["do_checkpoint", "log_train_metric", "Speedometer", "ProgressBar"]
 
 
-def do_checkpoint(prefix):
-    """Epoch-end checkpoint callback (reference callback.py:11)."""
-    def _callback(iter_no, sym, arg, aux):
+def do_checkpoint(prefix, async_write=False):
+    """Epoch-end checkpoint callback (reference callback.py:11).
+
+    ``async_write=True`` snapshots params to host then writes the file
+    on a background thread, so epoch N+1's compute overlaps epoch N's
+    checkpoint IO — the cross-step overlap the reference's engine gave
+    its async ops (SURVEY §7 hard part (e)). The previous write is
+    joined before starting the next, so at most one writer runs and
+    files complete in order.
+    """
+    state = {"thread": None, "error": None}
+
+    def _write(args):
         from .model import save_checkpoint
-        save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+        try:
+            save_checkpoint(prefix, *args)
+        except BaseException as e:  # surfaced at the next join
+            state["error"] = e
+
+    def _join():
+        if state["thread"] is not None:
+            state["thread"].join()
+            state["thread"] = None
+        if state["error"] is not None:
+            err, state["error"] = state["error"], None
+            raise err
+
+    def _callback(iter_no, sym, arg, aux):
+        if not async_write:
+            from .model import save_checkpoint
+            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            return
+        import threading
+        _join()
+        # snapshot to HOST numpy on the caller's thread (values may be
+        # mutated by the next epoch; nd.save accepts numpy, so the
+        # writer never touches the device); file IO overlaps compute
+        arg_snap = {k: v.asnumpy() for k, v in arg.items()}
+        aux_snap = {k: v.asnumpy() for k, v in aux.items()}
+        t = threading.Thread(
+            target=_write, args=((iter_no + 1, sym, arg_snap, aux_snap),),
+            daemon=True)
+        t.start()
+        state["thread"] = t
+
+    _callback.finalize = _join
     return _callback
 
 

@@ -202,6 +202,22 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
                 logger.info("Epoch[%d] Validation-%s=%f", epoch, name, value)
             eval_data.reset()
 
+    # drain async writers (do_checkpoint(async_write=True)) before
+    # returning so every checkpoint file is complete; fit() also drains
+    # in a finally for the error/interrupt paths
+    _drain_async_writers(epoch_end_callback)
+
+
+def _drain_async_writers(epoch_end_callback):
+    if epoch_end_callback is None:
+        return
+    for callback in (epoch_end_callback
+                     if isinstance(epoch_end_callback, list)
+                     else [epoch_end_callback]):
+        finalize = getattr(callback, "finalize", None)
+        if finalize is not None:
+            finalize()
+
 
 def _run_callbacks(callbacks, params):
     for cb in (callbacks if isinstance(callbacks, list) else [callbacks]):
@@ -468,18 +484,25 @@ class FeedForward(BASE_ESTIMATOR):
         else:
             raise TypeError("optimizer must be str or Optimizer")
 
-        _train_multi_device(
-            self.symbol, self.ctx, arg_names, param_names, aux_names,
-            self.arg_params, self.aux_params,
-            begin_epoch=self.begin_epoch, end_epoch=self.num_epoch,
-            epoch_size=self.epoch_size, optimizer=optimizer,
-            train_data=data, eval_data=eval_data, eval_metric=eval_metric,
-            epoch_end_callback=epoch_end_callback,
-            batch_end_callback=batch_end_callback,
-            kvstore=kvstore, update_on_kvstore=update_on_kvstore,
-            logger=logger, work_load_list=work_load_list, monitor=monitor,
-            eval_batch_end_callback=eval_batch_end_callback,
-            sym_gen=self.sym_gen)
+        try:
+            _train_multi_device(
+                self.symbol, self.ctx, arg_names, param_names, aux_names,
+                self.arg_params, self.aux_params,
+                begin_epoch=self.begin_epoch, end_epoch=self.num_epoch,
+                epoch_size=self.epoch_size, optimizer=optimizer,
+                train_data=data, eval_data=eval_data,
+                eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback,
+                kvstore=kvstore, update_on_kvstore=update_on_kvstore,
+                logger=logger, work_load_list=work_load_list,
+                monitor=monitor,
+                eval_batch_end_callback=eval_batch_end_callback,
+                sym_gen=self.sym_gen)
+        finally:
+            # drain async checkpoint writers even on error/interrupt so
+            # no .params file is left truncated by a dying daemon thread
+            _drain_async_writers(epoch_end_callback)
         return self
 
     def save(self, prefix, epoch=None):

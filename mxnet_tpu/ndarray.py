@@ -576,14 +576,17 @@ def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3, mea
 _LIST_MAGIC = 0x112
 
 
-def _save_one(fo, arr: NDArray):
-    shape = arr.shape or (1,)  # no 0-dim arrays on disk (matches reference)
+def _save_one(fo, arr):
+    """arr: NDArray or numpy array (host snapshots write without a
+    device round-trip)."""
+    shape = tuple(arr.shape) or (1,)  # no 0-dim arrays on disk
     fo.write(struct.pack("<I", len(shape)))
     fo.write(struct.pack("<%dI" % len(shape), *shape))
     fo.write(struct.pack("<ii", 1, 0))  # saved as CPU context like the ref
-    type_flag = DTYPE_NP_TO_MX[arr.dtype]
+    type_flag = DTYPE_NP_TO_MX[np.dtype(arr.dtype)]
     fo.write(struct.pack("<i", type_flag))
-    data = np.ascontiguousarray(arr.asnumpy())
+    host = arr.asnumpy() if isinstance(arr, NDArray) else np.asarray(arr)
+    data = np.ascontiguousarray(host)
     if sys.byteorder != "little":  # pragma: no cover
         data = data.byteswap()
     fo.write(data.tobytes())
@@ -603,7 +606,9 @@ def _load_one(fi) -> NDArray:
 
 
 def save(fname, data):
-    """Save a list or str->NDArray dict (reference ndarray.py:565)."""
+    """Save a list or str-keyed dict of NDArrays (reference
+    ndarray.py:565). numpy arrays are also accepted (host snapshots,
+    e.g. the async checkpoint writer, skip the device round-trip)."""
     if isinstance(data, NDArray):
         data = [data]
     names = []
@@ -612,8 +617,8 @@ def save(fname, data):
         arrays = [data[k] for k in names]
     else:
         arrays = list(data)
-    if any(not isinstance(a, NDArray) for a in arrays):
-        raise MXNetError("save only accepts NDArrays")
+    if any(not isinstance(a, (NDArray, np.ndarray)) for a in arrays):
+        raise MXNetError("save only accepts NDArrays or numpy arrays")
     with open(fname, "wb") as fo:
         fo.write(struct.pack("<QQ", _LIST_MAGIC, 0))
         fo.write(struct.pack("<Q", len(arrays)))

@@ -108,3 +108,19 @@ def test_dec_clustering():
              "--dec-iters", "50")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "DEC refinement done" in r.stderr + r.stdout
+
+
+def test_train_imagenet_synthetic():
+    r = _run("image-classification", "train_imagenet.py",
+             "--num-examples", "64", "--num-epochs", "1",
+             "--batch-size", "32", "--num-classes", "8",
+             "--network", "alexnet")
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+@pytest.mark.slow
+def test_fcn_xs():
+    r = _run("fcn-xs", "fcn_xs.py", "--steps", "6", "--size", "96",
+             timeout=560)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "fcn-32s nll" in r.stderr + r.stdout

@@ -111,3 +111,29 @@ def test_checkpoint_callback(tmp_path):
     model.fit(X, y, epoch_end_callback=mx.callback.do_checkpoint(prefix))
     m2 = mx.model.FeedForward.load(prefix, 2)
     assert m2.predict(X[:8]).shape == (8, 5)
+
+
+def test_async_checkpoint(tmp_path):
+    """do_checkpoint(async_write=True) overlaps IO with the next epoch
+    and produces checkpoints identical in format to the sync path."""
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 4, 256).astype(np.float32)
+    centers = rng.randn(4, 8).astype(np.float32)
+    x = centers[labels.astype(int)] + 0.2 * rng.randn(256, 8).astype("f")
+    net = mx.sym.SoftmaxOutput(
+        data=mx.sym.FullyConnected(data=mx.sym.Variable("data"),
+                                   num_hidden=4, name="fc"),
+        name="softmax")
+    prefix = str(tmp_path / "async")
+    model = mx.model.FeedForward(ctx=mx.cpu(), symbol=net, num_epoch=3,
+                                 learning_rate=0.5)
+    model.fit(X=mx.io.NDArrayIter(x, labels, batch_size=32, shuffle=True),
+              epoch_end_callback=mx.callback.do_checkpoint(
+                  prefix, async_write=True))
+    for epoch in (1, 2, 3):
+        loaded = mx.model.FeedForward.load(prefix, epoch)
+        assert "fc_weight" in loaded.arg_params
+    # the last checkpoint matches the final trained params
+    final = mx.model.FeedForward.load(prefix, 3)
+    np.testing.assert_allclose(final.arg_params["fc_weight"].asnumpy(),
+                               model.arg_params["fc_weight"].asnumpy())
