@@ -40,7 +40,12 @@ def get_iterator(args, kv):
     n = min(args.num_examples, 4096)
     rng = np.random.RandomState(5)
     labels = rng.randint(0, args.num_classes, n).astype(np.float32)
-    x = rng.rand(n, *data_shape).astype(np.float32)
+    # fill in chunks: rng.rand is float64, so a single call would peak
+    # at ~5 GB for the full cap
+    x = np.empty((n,) + data_shape, np.float32)
+    for lo in range(0, n, 256):
+        hi = min(lo + 256, n)
+        x[lo:hi] = rng.rand(hi - lo, *data_shape).astype(np.float32)
     for c in range(min(args.num_classes, 32)):
         x[labels == c, c % 3, c % 224, (c * 7) % 224] += 2.0
     x = x[kv.rank::kv.num_workers]

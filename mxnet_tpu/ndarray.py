@@ -609,7 +609,7 @@ def save(fname, data):
     """Save a list or str-keyed dict of NDArrays (reference
     ndarray.py:565). numpy arrays are also accepted (host snapshots,
     e.g. the async checkpoint writer, skip the device round-trip)."""
-    if isinstance(data, NDArray):
+    if isinstance(data, (NDArray, np.ndarray)):
         data = [data]
     names = []
     if isinstance(data, dict):

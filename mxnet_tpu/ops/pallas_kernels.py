@@ -45,7 +45,10 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # [block_q, D]
     bq, d = q.shape
-    nkb = pl.cdiv(seq_k, block_k)
+    # plain python int: pl.cdiv yields a numpy int64 scalar, which would
+    # type the fori_loop counter as i64 — Mosaic cannot lower i64 and its
+    # int64->int32 conversion helper recurses infinitely
+    nkb = int(pl.cdiv(seq_k, block_k))
     if causal:
         # only blocks up to the diagonal contribute (explicit int32 math:
         # x64 weak-typing + Mosaic lowering disagree on int promotion)
@@ -77,7 +80,10 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
     o0 = jnp.zeros((bq, d), jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     m0 = jnp.full((bq, 1), neg_big, jnp.float32)
-    o, l, m = lax.fori_loop(0, nkb, body, (o0, l0, m0))
+    # int32 bounds: the package enables jax x64 (f64 NDArray parity), so
+    # python-int bounds would make an i64 counter Mosaic cannot lower
+    o, l, m = lax.fori_loop(jnp.int32(0), jnp.int32(nkb), body,
+                            (o0, l0, m0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (o / l).astype(o_ref.dtype)
 
@@ -94,12 +100,19 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, true_tk):
                           scale=scale),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         grid=grid,
+        # index-map literals as int32: the package enables jax x64, and
+        # python-int constants would trace to i64, which Mosaic rejects
+        # at func.return
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, i: (b, i, np.int32(0))),
+            pl.BlockSpec((1, tk, d),
+                         lambda b, i: (b, np.int32(0), np.int32(0))),
+            pl.BlockSpec((1, tk, d),
+                         lambda b, i: (b, np.int32(0), np.int32(0))),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda b, i: (b, i, np.int32(0))),
         interpret=interpret,
     )(q, k, v)
 
@@ -217,9 +230,9 @@ def fused_linear(x, w, b, act="linear", *, block_m=256, block_n=256,
         out_shape=jax.ShapeDtypeStruct((mp, np_), x.dtype),
         grid=(mp // bm, np_ // bn),
         in_specs=[
-            pl.BlockSpec((bm, kdim), lambda i, j: (i, 0)),
-            pl.BlockSpec((kdim, bn), lambda i, j: (0, j)),
-            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((bm, kdim), lambda i, j: (i, np.int32(0))),
+            pl.BlockSpec((kdim, bn), lambda i, j: (np.int32(0), j)),
+            pl.BlockSpec((1, bn), lambda i, j: (np.int32(0), j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
         interpret=interpret,
