@@ -368,3 +368,41 @@ class ParallelTrainer:
 
     def set_params(self, arg_params, aux_params=None):
         return self.init_params(arg_params, aux_params)
+
+    # -- sharded (per-process) checkpointing ---------------------------
+    def save_sharded_checkpoint(self, prefix, step=None):
+        """Write params + optimizer state + aux as per-process shard
+        files (parallel/checkpoint.py) — checkpointing for models that
+        only exist sharded across the mesh. Call from ALL processes."""
+        from .checkpoint import save_sharded
+        flat = dict(self.params)
+        for name, st in self.opt_state.items():
+            leaves = jax.tree_util.tree_leaves(st)
+            for i, leaf in enumerate(leaves):
+                flat["opt/%s/%d" % (name, i)] = leaf
+        for name, a in zip(self.aux_names, self.aux):
+            flat["aux/%s" % name] = a
+        save_sharded(prefix, flat,
+                     step=self._t if step is None else step)
+
+    def restore_sharded_checkpoint(self, prefix):
+        """Inverse of :meth:`save_sharded_checkpoint`; restores params,
+        optimizer state, aux, and the step counter in place. Works on a
+        freshly constructed trainer (no init_params needed)."""
+        from .checkpoint import load_sharded
+        flat, step, _ = load_sharded(prefix, self.mesh)
+        self.params = {n: flat[n] for n in self.param_names}
+        new_state = {}
+        for name in self.param_names:
+            # state STRUCTURE from the optimizer spec (not from a live
+            # opt_state, which a fresh trainer does not have yet)
+            template = jax.eval_shape(self._opt_init, self.params[name])
+            leaves, treedef = jax.tree_util.tree_flatten(template)
+            restored = [flat["opt/%s/%d" % (name, i)]
+                        for i in range(len(leaves))]
+            new_state[name] = jax.tree_util.tree_unflatten(treedef,
+                                                           restored)
+        self.opt_state = new_state
+        self.aux = [flat["aux/%s" % n] for n in self.aux_names]
+        self._t = step
+        return self
