@@ -22,13 +22,14 @@ Layout:
 """
 from __future__ import annotations
 
-import glob
 import json
+import os
 
 import numpy as np
 import jax
 
-__all__ = ["save_sharded", "load_sharded"]
+__all__ = ["save_sharded", "load_sharded", "flatten_train_state",
+           "restore_opt_state"]
 
 
 def _spec_to_list(spec):
@@ -73,10 +74,21 @@ def save_sharded(prefix, params, step=0, extra=None):
                 continue  # store each byte once, not once per replica
             key = "%s|%s" % (name, _index_key(shard.index, arr.shape))
             blobs[key] = np.asarray(shard.data)
-    np.savez(shard_file, **blobs)
+    # atomic write: tmp + rename, so a preempted writer never leaves a
+    # truncated shard file behind a completed-looking checkpoint
+    tmp = "%s-shards-p%d.tmp.npz" % (prefix, rank)  # np.savez needs .npz
+    np.savez(tmp, **blobs)
+    os.replace(tmp, shard_file)
+    if jax.process_count() > 1:
+        # all shard files must exist before the manifest (the
+        # completeness marker) appears
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("save_sharded:" + prefix)
     if rank == 0:
-        with open("%s-manifest.json" % prefix, "w") as f:
+        mtmp = "%s-manifest.json.tmp" % prefix
+        with open(mtmp, "w") as f:
             json.dump(manifest, f)
+        os.replace(mtmp, "%s-manifest.json" % prefix)
 
 
 def load_sharded(prefix, mesh, param_specs=None):
@@ -88,9 +100,12 @@ def load_sharded(prefix, mesh, param_specs=None):
 
     with open("%s-manifest.json" % prefix) as f:
         manifest = json.load(f)
-    # one pass over all shard files: name -> {index_key -> host array}
+    # read EXACTLY the files this checkpoint wrote (manifest nprocs) —
+    # globbing would also pick up stale files from an earlier save with
+    # more processes and silently mix old weights in
     by_name = {}
-    for path in sorted(glob.glob("%s-shards-p*.npz" % prefix)):
+    for r in range(manifest["nprocs"]):
+        path = "%s-shards-p%d.npz" % (prefix, r)
         blobs = np.load(path)
         for key in blobs.files:
             pname, idx = key.rsplit("|", 1)
@@ -116,3 +131,30 @@ def load_sharded(prefix, mesh, param_specs=None):
         params[name] = jax.make_array_from_single_device_arrays(
             shape, sharding, pieces)
     return params, manifest["step"], manifest.get("extra", {})
+
+
+def flatten_train_state(params, opt_state, aux_names=(), aux=()):
+    """Flat name->array dict covering params, optimizer state (leaves
+    keyed ``opt/<param>/<i>``), and aux states (``aux/<name>``) — the
+    shared encoding both trainers' save_sharded_checkpoint use."""
+    flat = dict(params)
+    for name, st in opt_state.items():
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(st)):
+            flat["opt/%s/%d" % (name, i)] = leaf
+    for name, a in zip(aux_names, aux):
+        flat["aux/%s" % name] = a
+    return flat
+
+
+def restore_opt_state(flat, params, opt_init):
+    """Rebuild per-param optimizer state from a flat dict: the state
+    STRUCTURE comes from ``jax.eval_shape(opt_init, param)``, so a
+    freshly constructed trainer can restore without init_params."""
+    out = {}
+    for name, param in params.items():
+        template = jax.eval_shape(opt_init, param)
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        restored = [flat["opt/%s/%d" % (name, i)]
+                    for i in range(len(leaves))]
+        out[name] = jax.tree_util.tree_unflatten(treedef, restored)
+    return out

@@ -259,28 +259,18 @@ class SequenceParallelTrainer:
     def save_sharded_checkpoint(self, prefix, step=None):
         """Per-process shard files (parallel/checkpoint.py); includes
         optimizer state and the step counter. Call from ALL processes."""
-        from .checkpoint import save_sharded
-        flat = dict(self.params)
-        for name, st in self.opt_state.items():
-            for i, leaf in enumerate(jax.tree_util.tree_leaves(st)):
-                flat["opt/%s/%d" % (name, i)] = leaf
+        from .checkpoint import save_sharded, flatten_train_state
+        flat = flatten_train_state(self.params, self.opt_state)
         save_sharded(prefix, flat,
                      step=self._t if step is None else step)
 
     def restore_sharded_checkpoint(self, prefix):
         """Works on a freshly constructed trainer (no init_params
         needed): the state structure comes from the optimizer spec."""
-        from .checkpoint import load_sharded
+        from .checkpoint import load_sharded, restore_opt_state
         flat, step, _ = load_sharded(prefix, self.mesh)
         self.params = {n: flat[n] for n in self.param_names}
-        new_state = {}
-        for name in self.param_names:
-            template = jax.eval_shape(self._opt_init, self.params[name])
-            leaves, treedef = jax.tree_util.tree_flatten(template)
-            restored = [flat["opt/%s/%d" % (name, i)]
-                        for i in range(len(leaves))]
-            new_state[name] = jax.tree_util.tree_unflatten(treedef,
-                                                           restored)
-        self.opt_state = new_state
+        self.opt_state = restore_opt_state(flat, self.params,
+                                           self._opt_init)
         self._t = step
         return self

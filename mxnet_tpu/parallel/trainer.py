@@ -238,6 +238,47 @@ class ParallelTrainer:
         in_sh = (self._param_sh, None, self._data_sh, self._repl)
         return jax.jit(run, in_shardings=in_sh)
 
+    def prefetch(self, batches, depth=2):
+        """Double-buffered infeed: yield device-resident batches while
+        the NEXT ones transfer (SURVEY hard part (f) — the reference
+        overlaps IO with compute via its Prefetcher thread + async
+        engine copies; here device_put dispatches asynchronously, so
+        keeping `depth` batches in flight overlaps h2d with the step).
+
+        ``batches``: any iterable of host batch dicts (e.g. a DataIter
+        adapter). Use as::
+
+            for dev_batch in trainer.prefetch(host_batches):
+                trainer.step(dev_batch)
+        """
+        import collections
+        depth = max(1, int(depth))
+
+        def place(batch):
+            # EAGER placement: _shard_batch leaves plain numpy untouched
+            # in single-process mode (deferring h2d to jit dispatch),
+            # which would make prefetching a no-op — force the transfer
+            # to start now
+            out = self._shard_batch(batch, "prefetch")
+            return {k: (v if isinstance(v, jax.Array)
+                        else jax.device_put(v, self._data_sh[k]))
+                    for k, v in out.items()}
+
+        queue = collections.deque()
+        it = iter(batches)
+        try:
+            for _ in range(depth):
+                queue.append(place(next(it)))
+        except StopIteration:
+            pass
+        while queue:
+            ready = queue.popleft()
+            try:
+                queue.append(place(next(it)))
+            except StopIteration:
+                pass
+            yield ready
+
     def _shard_batch(self, batch, what):
         """Place batch arrays onto the mesh (the h2d infeed edge).
 
@@ -374,14 +415,9 @@ class ParallelTrainer:
         """Write params + optimizer state + aux as per-process shard
         files (parallel/checkpoint.py) — checkpointing for models that
         only exist sharded across the mesh. Call from ALL processes."""
-        from .checkpoint import save_sharded
-        flat = dict(self.params)
-        for name, st in self.opt_state.items():
-            leaves = jax.tree_util.tree_leaves(st)
-            for i, leaf in enumerate(leaves):
-                flat["opt/%s/%d" % (name, i)] = leaf
-        for name, a in zip(self.aux_names, self.aux):
-            flat["aux/%s" % name] = a
+        from .checkpoint import save_sharded, flatten_train_state
+        flat = flatten_train_state(self.params, self.opt_state,
+                                   self.aux_names, self.aux)
         save_sharded(prefix, flat,
                      step=self._t if step is None else step)
 
@@ -389,20 +425,11 @@ class ParallelTrainer:
         """Inverse of :meth:`save_sharded_checkpoint`; restores params,
         optimizer state, aux, and the step counter in place. Works on a
         freshly constructed trainer (no init_params needed)."""
-        from .checkpoint import load_sharded
+        from .checkpoint import load_sharded, restore_opt_state
         flat, step, _ = load_sharded(prefix, self.mesh)
         self.params = {n: flat[n] for n in self.param_names}
-        new_state = {}
-        for name in self.param_names:
-            # state STRUCTURE from the optimizer spec (not from a live
-            # opt_state, which a fresh trainer does not have yet)
-            template = jax.eval_shape(self._opt_init, self.params[name])
-            leaves, treedef = jax.tree_util.tree_flatten(template)
-            restored = [flat["opt/%s/%d" % (name, i)]
-                        for i in range(len(leaves))]
-            new_state[name] = jax.tree_util.tree_unflatten(treedef,
-                                                           restored)
-        self.opt_state = new_state
+        self.opt_state = restore_opt_state(flat, self.params,
+                                           self._opt_init)
         self.aux = [flat["aux/%s" % n] for n in self.aux_names]
         self._t = step
         return self

@@ -569,3 +569,39 @@ def test_sp_sharded_checkpoint_roundtrip(tmp_path):
     for n in want:
         np.testing.assert_allclose(got[n], want[n], rtol=1e-6, atol=1e-7,
                                    err_msg=n)
+
+
+def test_trainer_prefetch_matches_direct():
+    """Double-buffered infeed (trainer.prefetch) must feed exactly the
+    same batches in order — parameters after training match the
+    unprefetched loop."""
+    sym = _mlp_symbol()
+    rng = np.random.RandomState(0)
+    host_batches = [{"data": rng.randn(16, 64).astype(np.float32),
+                     "softmax_label": rng.randint(0, 10, (16,)
+                                                  ).astype(np.float32)}
+                    for _ in range(5)]
+    shapes = {"data": (16, 64), "softmax_label": (16,)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    init = {n: mx.nd.array(np.random.RandomState(5)
+                           .uniform(-0.07, 0.07, s).astype("f"))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in shapes}
+
+    results = []
+    for use_prefetch in (False, True):
+        tr = par.ParallelTrainer(
+            sym, shapes, optimizer="sgd", mesh=par.data_parallel_mesh(1),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+        tr.init_params({k: v.copy() for k, v in init.items()})
+        if use_prefetch:
+            for dev_batch in tr.prefetch(host_batches, depth=2):
+                tr.step(dev_batch)
+        else:
+            for b in host_batches:
+                tr.step(b)
+        got, _ = tr.get_params()
+        results.append({k: v.asnumpy() for k, v in got.items()})
+    for n in results[0]:
+        np.testing.assert_allclose(results[0][n], results[1][n],
+                                   rtol=1e-6, atol=1e-7, err_msg=n)
