@@ -43,6 +43,10 @@ from . import profiler  # noqa: E402
 from . import io  # noqa: E402
 from . import kvstore  # noqa: E402
 from . import kvstore as kv  # noqa: E402
+# NOTE: kvstore_server is intentionally NOT imported here — importing it
+# in a server/scheduler-role process joins the server loop (reference
+# python/mxnet/kvstore_server.py:57-68 semantics); use
+# `import mxnet_tpu.kvstore_server` explicitly, as the reference does.
 from . import executor_manager  # noqa: E402
 from . import callback  # noqa: E402
 from . import monitor  # noqa: E402
@@ -57,6 +61,7 @@ from .image_io import ImageRecordIter  # noqa: E402
 from . import distributed  # noqa: E402
 from . import visualization  # noqa: E402
 from . import rtc  # noqa: E402
+from . import torch  # noqa: E402
 from . import predict  # noqa: E402
 from .predict import Predictor  # noqa: E402
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["MXNetError", "mx_uint", "mx_float", "string_types",
+__all__ = ["c_str", "c_array", "ctypes2buffer", "ctypes2numpy_shared", "ctypes2docstring", "MXNetError", "mx_uint", "mx_float", "string_types",
            "DTYPE_NP_TO_MX", "DTYPE_MX_TO_NP"]
 
 
@@ -59,3 +59,69 @@ def check_call(ret):
     """Kept for API parity with the ctypes binding; a no-op in-process."""
     if ret != 0:
         raise MXNetError("API call returned %s" % ret)
+
+
+# ---------------------------------------------------------------------------
+# ctypes helpers (reference base.py:79-186) — used by binding authors
+# talking to the native C ABI (cpp/c_api_graph.h) from Python.
+
+def c_str(string):
+    """Create a ctypes char* from a python string."""
+    import ctypes
+    return ctypes.c_char_p(string.encode("utf-8"))
+
+
+def c_array(ctype, values):
+    """Create a ctypes array from a python list."""
+    return (ctype * len(values))(*values)
+
+
+def ctypes2buffer(cptr, length):
+    """Convert a ctypes pointer to a bytearray of `length` bytes."""
+    import ctypes
+    if not isinstance(cptr, ctypes.POINTER(ctypes.c_char)):
+        raise TypeError("expected char pointer")
+    res = bytearray(length)
+    rptr = (ctypes.c_char * length).from_buffer(res)
+    if not ctypes.memmove(rptr, cptr, length):
+        raise RuntimeError("memmove failed")
+    return res
+
+
+def ctypes2numpy_shared(cptr, shape):
+    """View a ctypes float pointer as a numpy array sharing memory."""
+    import ctypes
+    if not isinstance(cptr, ctypes.POINTER(ctypes.c_float)):
+        raise TypeError("expected float pointer")
+    size = 1
+    for s in shape:
+        size *= s
+    dbuffer = (ctypes.c_float * size).from_address(
+        ctypes.addressof(cptr.contents))
+    return np.frombuffer(dbuffer, dtype=np.float32).reshape(shape)
+
+
+def ctypes2docstring(num_args, arg_names, arg_types, arg_descs,
+                     remove_dup=True):
+    """Convert C-registry argument metadata to a parameter docstring."""
+    param_keys = set()
+    param_str = []
+    for i in range(num_args.value if hasattr(num_args, "value")
+                   else num_args):
+        key = arg_names[i]
+        if isinstance(key, bytes):
+            key = key.decode("utf-8")
+        if key in param_keys and remove_dup:
+            continue
+        param_keys.add(key)
+        t = arg_types[i]
+        if isinstance(t, bytes):
+            t = t.decode("utf-8")
+        d = arg_descs[i]
+        if isinstance(d, bytes):
+            d = d.decode("utf-8")
+        ret = "%s : %s" % (key, t)
+        if d:
+            ret += "\n    " + d
+        param_str.append(ret)
+    return "Parameters\n----------\n%s\n" % ("\n".join(param_str))

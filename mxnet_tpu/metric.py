@@ -11,7 +11,7 @@ import numpy
 from .base import MXNetError
 from .ndarray import NDArray
 
-__all__ = ["EvalMetric", "Accuracy", "F1", "MAE", "MSE", "RMSE",
+__all__ = ["Torch", "check_label_shapes", "EvalMetric", "Accuracy", "F1", "MAE", "MSE", "RMSE",
            "CrossEntropy", "CustomMetric", "create", "np"]
 
 
@@ -169,9 +169,36 @@ def create(metric):
         return metric
     metrics = {"acc": Accuracy, "accuracy": Accuracy, "f1": F1, "mae": MAE,
                "mse": MSE, "rmse": RMSE, "ce": CrossEntropy,
-               "cross-entropy": CrossEntropy}
+               "cross-entropy": CrossEntropy,
+               "torch": lambda: Torch()}
     try:
         return metrics[metric.lower()]()
     except KeyError:
         raise ValueError("Metric must be either callable or in %s"
                          % sorted(metrics))
+
+
+def check_label_shapes(labels, preds, shape=0):
+    """Check that label/pred collections agree in size (reference
+    metric.py:9-19)."""
+    if shape == 0:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError(
+            "Shape of labels {} does not match shape of predictions {}"
+            .format(label_shape, pred_shape))
+
+
+class Torch(EvalMetric):
+    """Dummy metric for torch criterions (reference metric.py:188): the
+    criterion's forward already IS the loss, so just average it."""
+
+    def __init__(self):
+        super().__init__('torch')
+
+    def update(self, _, preds):
+        for pred in preds:
+            self.sum_metric += float(_as_numpy(pred).mean())
+        self.num_inst += 1

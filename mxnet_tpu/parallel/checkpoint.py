@@ -89,6 +89,11 @@ def save_sharded(prefix, params, step=0, extra=None):
         with open(mtmp, "w") as f:
             json.dump(manifest, f)
         os.replace(mtmp, "%s-manifest.json" % prefix)
+    if jax.process_count() > 1:
+        # and none may RETURN (and e.g. immediately restore) before the
+        # new manifest is in place
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("save_sharded_done:" + prefix)
 
 
 def load_sharded(prefix, mesh, param_specs=None):

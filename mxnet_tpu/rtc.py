@@ -25,7 +25,7 @@ from .base import MXNetError
 from . import ndarray as nd
 from .ndarray import NDArray
 
-__all__ = ["PallasOp"]
+__all__ = ["Rtc", "PallasOp"]
 
 
 class PallasOp:
@@ -98,3 +98,9 @@ class PallasOp:
                 for o in outs]
 
     __call__ = push
+
+
+class Rtc(PallasOp):
+    """Reference-named alias (python/mxnet/rtc.py Rtc): runtime-compiled
+    user kernels. The NVRTC-era signature took (name, inputs, outputs,
+    kernel_source); here the kernel is a Pallas function."""

@@ -132,3 +132,21 @@ def test_CSVIter(tmp_path):
     assert len(batches) == 3
     got = np.concatenate([b.data[0].asnumpy() for b in batches])
     assert np.allclose(got, data, atol=1e-5)
+
+
+def test_mxdataiter_wraps_c_handle(tmp_path):
+    """MXDataIter (reference io.py:426) wraps a DataIterHandle created
+    through the C graph ABI registry."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import c_api_impl as impl
+    path = str(tmp_path / "d.csv")
+    np.savetxt(path, np.arange(24).reshape(6, 4), delimiter=",")
+    hid = impl.data_iter_create(
+        "CSVIter", ("data_csv", "data_shape", "batch_size"),
+        (path, "(4,)", "2"))
+    it = mx.io.MXDataIter(hid)
+    assert it.batch_size == 2
+    shapes = [b.data[0].shape for b in it]
+    assert shapes == [(2, 4)] * 3
+    it.reset()
+    assert it.iter_next()

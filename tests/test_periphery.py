@@ -130,3 +130,31 @@ def test_executor_debug_str_memory_plan():
     exe = out.simple_bind(mx.cpu(), data=(2, 8))
     s = exe.debug_str()
     assert "Total" in s and "MB" in s
+
+
+def test_reference_api_shims():
+    """Small reference-parity surfaces: ctypes helpers (base.py:79-186),
+    metric.check_label_shapes / metric.Torch, rtc.Rtc alias."""
+    import ctypes
+    import pytest
+    assert mx.base.c_str("ab").value == b"ab"
+    arr = mx.base.c_array(ctypes.c_int, [1, 2, 3])
+    assert list(arr) == [1, 2, 3]
+    buf = (ctypes.c_char * 3)(b"x", b"y", b"z")
+    got = mx.base.ctypes2buffer(ctypes.cast(buf,
+                                            ctypes.POINTER(ctypes.c_char)), 3)
+    assert bytes(got) == b"xyz"
+    fl = (ctypes.c_float * 4)(1, 2, 3, 4)
+    view = mx.base.ctypes2numpy_shared(
+        ctypes.cast(fl, ctypes.POINTER(ctypes.c_float)), (2, 2))
+    np.testing.assert_array_equal(view, [[1, 2], [3, 4]])
+    doc = mx.base.ctypes2docstring(2, ["a", "b"], ["int", "float"],
+                                   ["first", ""])
+    assert "a : int" in doc and "first" in doc
+
+    with pytest.raises(ValueError):
+        mx.metric.check_label_shapes([1], [1, 2])
+    m = mx.metric.Torch()
+    m.update(None, [mx.nd.array(np.full((2, 2), 3.0, np.float32))])
+    assert m.get()[1] == 3.0
+    assert issubclass(mx.rtc.Rtc, mx.rtc.PallasOp)
