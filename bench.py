@@ -1,60 +1,202 @@
-"""Headline benchmark: Inception-BN-28-small on CIFAR-10-shaped data.
+"""Headline benchmark — the BASELINE.json north star.
 
-Reference baseline: 842 img/s on 1x GTX 980, batch 128
-(example/image-classification/README.md:206; BASELINE.md). This measures
-the fused ParallelTrainer step (forward+backward+SGD update in one XLA
-program) on whatever single accelerator is visible, synthetic data.
+Primary metric: ResNet-50 ImageNet-shape training throughput on one chip
+(fused ParallelTrainer step: forward+backward+SGD in ONE XLA program,
+bf16 compute / f32 master params, device-resident synthetic data).
+North-star target: >=2,000 img/s/chip (BASELINE.md; the reference's own
+published anchor is Inception-BN at ~113 img/s/GPU on 4x Titan X,
+example/image-classification/README.md:247-257).
 
-Prints ONE JSON line: {"metric","value","unit","vs_baseline"}.
+Also measured (reported in the same JSON line under "extra"):
+* resnet50 batch-128 variant and an MFU estimate (model FLOPs / peak),
+* the round-1 CIFAR Inception-BN-28-small metric (vs 842 img/s GTX 980),
+* input-pipeline throughput: fresh host batches fed through
+  trainer.prefetch (h2d overlap on the real chip) instead of a resident
+  batch, and the C++ ImageRecordIOIter on synthetic packed RecordIO.
+
+Prints ONE JSON line: {"metric","value","unit","vs_baseline","extra"}.
 """
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
 
-BASELINE_IMG_PER_SEC = 842.0  # 1x GTX 980
+NORTH_STAR_IMG_PER_SEC = 2000.0   # ResNet-50 target, img/s/chip
+CIFAR_BASELINE = 842.0            # Inception-BN-28-small, 1x GTX 980
+
+# ResNet-50 @224: ~4.1 GFLOP forward per image; backward ~2x forward.
+_RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.1e9
+
+_PEAK_FLOPS = {
+    # bf16 peak per chip
+    "TPU v4": 275e12,
+    "TPU v5e": 197e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6e": 918e12,
+}
 
 
-def main():
+def _peak_flops(dev):
+    kind = getattr(dev, "device_kind", "")
+    for k, v in _PEAK_FLOPS.items():
+        if kind.lower().startswith(k.lower()):
+            return v
+    return 197e12  # assume v5e-class
+
+
+def _timed_steps(trainer, batch, steps):
+    """Seconds per `steps` training steps.
+
+    The TPU is reached through a relay where ``block_until_ready`` can
+    return before execution finishes (apparent >1 PFLOPS — see
+    doc/performance.md). Honest method: time two chain lengths that END
+    IN A REAL VALUE FETCH (which provably forces completion of the whole
+    donated-param dependency chain) and difference them, cancelling the
+    constant fetch/dispatch overhead.
+    """
+    def chain(n):
+        tic = time.perf_counter()
+        outs = None
+        for _ in range(n):
+            outs = trainer.step(batch)
+        np.asarray(outs[0][(0,) * outs[0].ndim])  # force completion
+        return time.perf_counter() - tic
+
+    chain(3)  # warmup/compile
+    t1 = chain(steps)
+    t2 = chain(2 * steps)
+    return max(t2 - t1, 1e-9)
+
+
+def bench_resnet50(batch, steps=20):
     import jax
-    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.models import get_resnet
+
+    sym = get_resnet(num_classes=1000, num_layers=50)
+    shapes = {"data": (batch, 3, 224, 224), "softmax_label": (batch,)}
+    trainer = par.ParallelTrainer(
+        sym, shapes, optimizer="sgd", mesh=par.data_parallel_mesh(1),
+        compute_dtype="bfloat16",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                          "wd": 1e-4})
+    trainer.init_params()
+    rng = np.random.RandomState(0)
+    hostb = {"data": rng.rand(*shapes["data"]).astype(np.float32),
+             "softmax_label": rng.randint(0, 1000, (batch,)
+                                          ).astype(np.float32)}
+    # device-resident batch: the compute-bound number
+    devb = {k: jax.device_put(v, trainer._data_sh[k])
+            for k, v in hostb.items()}
+    dt = _timed_steps(trainer, devb, steps)
+    ips = batch * steps / dt
+
+    # fresh host batches through the double-buffered prefetcher: proves
+    # h2d overlap (the reference overlaps IO via its Prefetcher thread);
+    # same two-length difference method as _timed_steps
+    def host_stream(n):
+        for _ in range(n):
+            yield hostb
+
+    def chain_h2d(n):
+        tic = time.perf_counter()
+        outs = None
+        for db in trainer.prefetch(host_stream(n)):
+            outs = trainer.step(db)
+        np.asarray(outs[0][(0,) * outs[0].ndim])
+        return time.perf_counter() - tic
+
+    chain_h2d(2)
+    t1 = chain_h2d(steps // 2)
+    t2 = chain_h2d(steps)
+    ips_h2d = batch * (steps - steps // 2) / max(t2 - t1, 1e-9)
+
+    mfu = ips * _RESNET50_TRAIN_FLOPS_PER_IMG / _peak_flops(jax.devices()[0])
+    return ips, ips_h2d, mfu
+
+
+def bench_cifar(steps=30):
     from mxnet_tpu import parallel as par
     from mxnet_tpu.models import get_inception_bn_small
 
     batch = 128
     sym = get_inception_bn_small(num_classes=10)
     shapes = {"data": (batch, 3, 28, 28), "softmax_label": (batch,)}
-    mesh = par.data_parallel_mesh(1)
     trainer = par.ParallelTrainer(
-        sym, shapes, optimizer="sgd", mesh=mesh,
-        optimizer_params={"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4})
+        sym, shapes, optimizer="sgd", mesh=par.data_parallel_mesh(1),
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                          "wd": 1e-4})
     trainer.init_params()
-
     rng = np.random.RandomState(0)
-    data = rng.randn(*shapes["data"]).astype(np.float32)
-    label = rng.randint(0, 10, (batch,)).astype(np.float32)
-    batch_dict = {"data": data, "softmax_label": label}
+    batch_dict = {
+        "data": rng.randn(*shapes["data"]).astype(np.float32),
+        "softmax_label": rng.randint(0, 10, (batch,)).astype(np.float32)}
+    dt = _timed_steps(trainer, batch_dict, steps)
+    return batch * steps / dt
 
-    # warmup / compile
-    for _ in range(3):
-        outs = trainer.step(batch_dict)
-    jax.block_until_ready(outs)
 
-    steps = 30
-    tic = time.perf_counter()
-    for _ in range(steps):
-        outs = trainer.step(batch_dict)
-    jax.block_until_ready(outs)
-    toc = time.perf_counter()
+def bench_recordio_io(n_images=512, batch=128):
+    """C++ ImageRecordIOIter img/s on synthetic packed RecordIO
+    (reference publishes ~3,000 img/s from packed RecordIO on an HDD,
+    doc/tutorial/imagenet_full.md:37)."""
+    import tempfile
+    try:
+        import cv2  # noqa: F401
+        import mxnet_tpu as mx
+        from mxnet_tpu import recordio as rec
+    except Exception:
+        return None
+    tmpd = tempfile.mkdtemp(prefix="benchrec")
+    path = os.path.join(tmpd, "bench.rec")
+    rng = np.random.RandomState(0)
+    w = rec.MXRecordIO(path, "w")
+    img = (rng.rand(224, 224, 3) * 255).astype(np.uint8)
+    for i in range(n_images):
+        hdr = rec.IRHeader(0, float(i % 10), i, 0)
+        w.write(rec.pack_img(hdr, img, quality=85))
+    w.close()
+    try:
+        it = mx.ImageRecordIter(path_imgrec=path,
+                                data_shape=(3, 224, 224),
+                                batch_size=batch, shuffle=False)
+        it.reset()
+        for b in it:  # warm epoch (thread spin-up)
+            pass
+        it.reset()
+        tic = time.perf_counter()
+        n = 0
+        for b in it:
+            n += batch
+        dt = time.perf_counter() - tic
+        return n / dt
+    except Exception:
+        return None
 
-    img_per_sec = batch * steps / (toc - tic)
+
+def main():
+    r50_256, r50_256_h2d, mfu = bench_resnet50(256)
+    r50_128, _, _ = bench_resnet50(128)
+    cifar = bench_cifar()
+    io_ips = bench_recordio_io()
     print(json.dumps({
-        "metric": "cifar10_inception-bn-28-small_train_throughput",
-        "value": round(img_per_sec, 1),
-        "unit": "img/s",
-        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+        "metric": "resnet50_imagenet_train_throughput",
+        "value": round(r50_256, 1),
+        "unit": "img/s/chip",
+        "vs_baseline": round(r50_256 / NORTH_STAR_IMG_PER_SEC, 3),
+        "extra": {
+            "resnet50_b256_bf16": round(r50_256, 1),
+            "resnet50_b256_bf16_host_infeed": round(r50_256_h2d, 1),
+            "resnet50_b128_bf16": round(r50_128, 1),
+            "resnet50_mfu_estimate": round(mfu, 3),
+            "cifar10_inception-bn-28-small": round(cifar, 1),
+            "cifar_vs_gtx980_baseline": round(cifar / CIFAR_BASELINE, 3),
+            "recordio_io_img_per_sec":
+                None if io_ips is None else round(io_ips, 1),
+        },
     }))
 
 

@@ -133,35 +133,23 @@ class Executor:
         self._vjp_treedef = None
         self._residuals = None
         self._topo = symbol._topo()
+        from .ops.fusion import FusionPlan
+        self._fusion_plan = FusionPlan(self._topo, symbol._heads)
         self._base_key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
         self._step = 0
 
     # ------------------------------------------------------------------
     # graph evaluation (traced under jit)
-    def _eval_graph(self, arg_vals, aux_vals, is_train, rng):
-        env = {}
+    def _eval_graph(self, arg_vals, aux_vals, is_train, rng, fuse=True):
         # variables map positionally (list_arguments order = topo order of
-        # var nodes); distinct nodes may share a name (reference allows it)
-        var_iter = iter(arg_vals)
-        aux_cursor = 0
-        new_aux = list(aux_vals)
-        for i, n in enumerate(self._topo):
-            if n.is_var:
-                env[(id(n), 0)] = next(var_iter)
-                continue
-            ins = [env[(id(inp), idx)] for inp, idx in n.inputs]
-            n_aux = len(n.spec.aux_states(n.params))
-            aux_in = list(aux_vals[aux_cursor:aux_cursor + n_aux])
-            node_rng = jax.random.fold_in(rng, i)
-            outs, aux_out = n.spec.forward(n.params, ins, aux_in,
-                                           is_train, node_rng)
-            for j, o in enumerate(outs):
-                env[(id(n), j)] = o
-            if n_aux:
-                new_aux[aux_cursor:aux_cursor + n_aux] = list(aux_out)
-            aux_cursor += n_aux
-        heads = [env[(id(h), i)] for h, i in self._symbol._heads]
-        return heads, new_aux, env
+        # var nodes); distinct nodes may share a name (reference allows it).
+        # The walk + fused-kernel selection live in ops.fusion (the
+        # CreateOp-time cuDNN-analogue); monitor runs pass fuse=False so
+        # every node's output exists for inspection.
+        from .ops.fusion import eval_graph
+        return eval_graph(self._topo, self._symbol._heads, arg_vals,
+                          aux_vals, is_train, rng,
+                          plan=self._fusion_plan if fuse else None)
 
     # ------------------------------------------------------------------
     def _build_infer(self):
@@ -349,8 +337,10 @@ class Executor:
         self._monitor_should_run = should_run
 
     def _run_monitor(self, arg_vals, aux_vals, is_train, rng):
+        # fuse=False: the monitor inspects EVERY node's output, so fused
+        # chains must run as their individual ops here
         _, _, env = self._eval_graph(list(arg_vals), list(aux_vals),
-                                     is_train, rng)
+                                     is_train, rng, fuse=False)
         for n in self._topo:
             if n.is_var:
                 continue

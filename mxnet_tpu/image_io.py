@@ -172,6 +172,7 @@ class _PyEngine:
         self.random_l = random_l
         self.mean_arr = None
         self._mean_img_path = mean_img
+        self.part_index = part_index
         # scan offsets once
         reader = rec.MXRecordIO(path, "r")
         offsets = []
@@ -181,6 +182,7 @@ class _PyEngine:
                 break
             offsets.append(pos)
         reader.close()
+        self._all_offsets = offsets  # every record (mean-img is global)
         self.offsets = offsets[part_index::num_parts]
         if not self.offsets:
             raise MXNetError("empty shard")
@@ -192,8 +194,20 @@ class _PyEngine:
     def _setup_mean_img(self, path):
         """Load the (c,h,w) mean image, computing and caching it on first
         use like the reference (iter_normalize.h: compute over the dataset
-        with augmentation off, save, then subtract per sample)."""
+        with augmentation off, save, then subtract per sample).
+
+        Under ``num_parts>1`` only part 0 computes (the mean is over ALL
+        records — decoding the whole dataset once, not once per worker);
+        other parts wait for the cache file to appear."""
         import os
+        import time as _time
+        if self.part_index != 0 and not os.path.exists(path):
+            deadline = _time.time() + float(
+                os.environ.get("MXNET_MEAN_IMG_TIMEOUT", 600))
+            while not os.path.exists(path):
+                if _time.time() > deadline:
+                    break  # fall through: compute locally (same result)
+                _time.sleep(0.2)
         from . import ndarray as _nd
         if os.path.exists(path):
             loaded = _nd.load(path)
@@ -212,14 +226,22 @@ class _PyEngine:
             self.random_l = 0
         self.means = np.zeros(3, np.float32)
         self.scale = 1.0
+        # mean over ALL records, not this worker's num_parts shard —
+        # every worker must subtract the SAME mean or distributed runs
+        # silently train on inconsistently normalized data
         total = np.zeros(self.data_shape, np.float64)
         count = 0
-        for off in self.offsets:
+        for off in self._all_offsets:
             img, _ = self._load(off)
             total += img
             count += 1
         self.mean_arr = (total / max(count, 1)).astype(np.float32)
-        _nd.save(path, {"mean_img": _nd.array(self.mean_arr)})
+        # atomic cache write: workers may race on a shared filesystem;
+        # tmp (unique per pid) + os.replace means readers only ever see
+        # a complete file, last writer wins with identical content
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        _nd.save(tmp, {"mean_img": _nd.array(self.mean_arr)})
+        os.replace(tmp, path)
         (self.rand_crop, self.rand_mirror, self.max_rotate_angle,
          self.random_h, self.random_s, self.random_l, self.means,
          self.scale) = saved

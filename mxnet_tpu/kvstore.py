@@ -19,15 +19,27 @@ This module keeps the KVStore *API* as a compatibility facade:
 * ``local``/``device`` (and the ``local_allreduce_*`` aliases): aggregation
   of per-device NDArray copies inside one process. The reduce is a single
   jnp tree-sum — XLA's fusion replaces kvstore_local.h's chunked OMP loops.
-* ``dist_sync``/``dist_async``: same semantics over jax.distributed
-  process groups. On a single process it degrades to local (the way the
-  reference's dist kvstore with one worker does); multi-host uses
-  ``jax.experimental.multihost_utils`` allreduce over DCN.
+* ``dist_sync``: same BSP semantics over multiple processes, but the
+  cross-process reduce is an IN-PROGRAM XLA all-reduce over DCN: each
+  process contributes its locally-merged gradient as shards of one global
+  array on the global device mesh and a jitted sum replaces ps-lite's
+  ZPush/ZPull round trip. Arrays >= ``MXNET_KVSTORE_BIGARRAY_BOUND``
+  (1e6 elements, the reference's bound) come back REDUCE-SCATTERED: the
+  stored value stays sharded across the mesh (the analogue of the
+  reference's range partitioning across servers,
+  ``kvstore_dist.h:230-268``) and ``pull`` all-gathers on demand.
+* ``dist_async``: a real host-driven parameter server
+  (``kvstore_dist.py``): one server thread per process, update-per-push
+  with no worker lockstep (reference ``kvstore_dist_server.h:194-202``),
+  key-hash ownership plus range partitioning for big arrays. Collectives
+  are inherently synchronous, so async rides TCP like ps-lite rode ZMQ.
 * ``_set_updater``: weight update runs where the reference's "update on
-  kvstore" runs (here: on the aggregated value before broadcast).
+  kvstore" runs (sync: on the aggregated value before broadcast; async:
+  inside the owning server thread).
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 import numpy as np
@@ -38,6 +50,10 @@ from .ndarray import NDArray
 from . import optimizer as opt
 
 __all__ = ["KVStore", "create"]
+
+
+def _bigarray_bound():
+    return int(float(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1e6)))
 
 
 def _ctype_key_value(key, vals):
@@ -62,15 +78,20 @@ class KVStore:
     def __init__(self, kv_type="local"):
         self.type = kv_type
         self._store = {}
+        self._sharded = {}  # key -> _ShardedValue (big-array sync path)
         self._updater = None
         self._is_dist = kv_type.startswith("dist")
+        self._is_async = kv_type == "dist_async"
+        self._ps = None
         if self._is_dist:
             from . import distributed
             distributed.initialize()  # no-op if single-process/already up
-        # NOTE: dist_async degrades to synchronous collectives here — the
-        # reference's async path exists because ps-lite servers can apply
-        # updates out of lockstep; with in-program DCN collectives there is
-        # no server to be async against.
+        if self._is_async and _num_processes() > 1:
+            # real update-per-push parameter server (host-driven over TCP,
+            # like the reference's ps-lite over ZMQ): collectives are
+            # synchronous by construction, so async cannot ride them
+            from .kvstore_dist import PSBackend
+            self._ps = PSBackend()
 
     # ------------------------------------------------------------------
     def init(self, key, value):
@@ -81,26 +102,61 @@ class KVStore:
                 raise MXNetError("key %d already initialized" % k)
             v = vlist[0]
             self._store[k] = v.copyto(v.context)
+            if self._ps is not None:
+                self._ps.init(k, v.asnumpy())
+
+    def _merge_local(self, k, vlist):
+        """Sum this process's device copies ON THE STORE'S DEVICE
+        (reference kvstore_local.h MergePushValue: per-device grads into
+        pinned merge buffers) — the updater then mixes merged and stored
+        values without committed-device conflicts."""
+        import jax
+        dev = self._store[k].context.jax_device()
+        merged = jax.device_put(vlist[0]._val, dev)
+        for v in vlist[1:]:
+            merged = merged + jax.device_put(v._val, dev)
+        return merged
 
     def push(self, key, value, priority=0):
         """Push value(s); multiple device copies of one key are summed
         (reference kvstore_local.h MergePushValue). With an updater set,
         the aggregate is applied via updater(key, merged, stored) instead
-        of overwriting — matching reference local-update semantics."""
-        import jax
+        of overwriting — matching reference local-update semantics.
+
+        dist_sync: the cross-process reduce is one in-program XLA
+        all-reduce; big arrays come back reduce-scattered (see
+        ``_allreduce_dcn``). dist_async: the merged gradient goes to the
+        key's owning server, which applies its updater immediately — no
+        worker lockstep (reference kvstore_dist_server.h:194-202).
+        """
         key, vals = _ctype_key_value(key, value)
         for k, vlist in zip(key, vals):
             if k not in self._store:
                 raise MXNetError("key %d not initialized" % k)
-            # device copies live on different chips: gather to the store's
-            # device before reducing (reference kvstore_local.h copies each
-            # device grad into pinned host merge buffers)
-            dev = self._store[k].context.jax_device()
-            merged = jax.device_put(vlist[0]._val, dev)
-            for v in vlist[1:]:
-                merged = merged + jax.device_put(v._val, dev)
+            merged = self._merge_local(k, vlist)
+            if self._ps is not None:
+                self._ps.push(k, np.asarray(merged))
+                continue
             if self._is_dist and _num_processes() > 1:
-                merged = _allreduce_dcn(merged)
+                # updater path needs the full value on every process;
+                # pure-aggregation big arrays stay reduce-scattered
+                red = _allreduce_dcn(merged,
+                                     shard_big=self._updater is None)
+                if isinstance(red, _ShardedValue):
+                    self._sharded[k] = red
+                    continue
+                import jax
+                pending = self._sharded.pop(k, None)
+                if pending is not None:
+                    # an updater was installed after a big-array push:
+                    # fold the still-sharded aggregate into the store
+                    # first (reference overwrite semantics) so it isn't
+                    # silently dropped
+                    self._store[k]._set(jax.device_put(
+                        pending.gather(),
+                        self._store[k].context.jax_device()))
+                merged = jax.device_put(
+                    red, self._store[k].context.jax_device())
             merged_nd = NDArray._from_jax(merged, self._store[k].context)
             if self._updater is not None:
                 self._updater(k, merged_nd, self._store[k])
@@ -109,20 +165,45 @@ class KVStore:
 
     def pull(self, key, out=None, priority=0):
         """Pull current value into out array(s) — broadcast to all device
-        copies (reference kvstore_local.h Pull → CopyFromTo fan-out)."""
+        copies (reference kvstore_local.h Pull → CopyFromTo fan-out).
+        Reduce-scattered big arrays are all-gathered here (in-program);
+        async keys are fetched from their owning servers."""
         assert out is not None
         key, outs = _ctype_key_value(key, out)
         for k, olist in zip(key, outs):
             if k not in self._store:
                 raise MXNetError("key %d not initialized" % k)
             import jax
+            if self._ps is not None:
+                val = self._ps.pull(k)
+                for o in olist:
+                    o._set(jax.device_put(val, o.context.jax_device()))
+                continue
+            if k in self._sharded:
+                full = self._sharded[k].gather()
+                self._store[k]._set(jax.device_put(
+                    full, self._store[k].context.jax_device()))
+                del self._sharded[k]
             src = self._store[k]
             for o in olist:
                 o._set(jax.device_put(src._val, o.context.jax_device()))
 
     # ------------------------------------------------------------------
     def _set_updater(self, updater):
-        """Install updater(key, recv, local) (reference _set_updater)."""
+        """Install updater(key, recv, local) (reference _set_updater).
+        In dist_async mode the updater runs inside the owning SERVER
+        thread (reference: servers apply updates), so it must be
+        picklable (a module-level function or an Optimizer-based
+        updater). Like the reference (rank 0 sends the pickled optimizer,
+        command 0), only rank 0 installs it — otherwise a slow worker's
+        late set would REPLACE the updater and silently zero optimizer
+        state accumulated from earlier pushes; the barrier guarantees
+        it is installed before anyone returns."""
+        if self._ps is not None:
+            if self.rank == 0:
+                self._ps.set_optimizer(pickle.dumps(updater))
+            self.barrier()
+            return
         self._updater = updater
 
     set_updater = _set_updater
@@ -135,6 +216,11 @@ class KVStore:
         the reference's local path."""
         if self._is_dist:
             optimizer = pickle.loads(pickle.dumps(optimizer))
+        if self._ps is not None:
+            if self.rank == 0:  # reference: rank 0 sends, others wait
+                self._ps.set_optimizer(pickle.dumps(optimizer))
+            self.barrier()
+            return
         self._set_updater(opt.get_updater(optimizer))
 
     # --- node roles (reference kvstore.h:154-178; DMLC_ROLE env) --------
@@ -155,8 +241,18 @@ class KVStore:
     def send_command_to_servers(self, head, body):
         """No-op in-process (reference SendCommandToServers RPC)."""
 
+    def close(self):
+        """Release the async parameter-server sockets (if any), so a new
+        dist_async store can bind the ports in the same process."""
+        if self._ps is not None:
+            self._ps.close()
+            self._ps = None
+
     def __del__(self):
-        pass
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def _num_processes():
@@ -169,15 +265,90 @@ def _process_index():
     return jax.process_index()
 
 
-def _allreduce_dcn(val):
-    """Cross-process sum over DCN (replaces ps-lite ZPush/ZPull).
+_dcn_state = {}
 
-    Takes the host-value path (process_allgather over numpy) because
-    KVStore arrays are per-process host-resident NDArrays, not arrays on a
-    shared global mesh — the fused parallel trainer is the in-program path.
+
+def _dcn_mesh():
+    """One-axis mesh over EVERY device of every process (cached)."""
+    if "mesh" not in _dcn_state:
+        import jax
+        from jax.sharding import Mesh
+        devs = np.array(jax.devices())
+        _dcn_state["mesh"] = Mesh(devs, ("dcn",))
+    return _dcn_state["mesh"]
+
+
+def _allreduce_dcn(val, shard_big=True):
+    """Cross-process sum as an IN-PROGRAM XLA collective over DCN
+    (replaces ps-lite ZPush/ZPull — and the round-1 host
+    ``process_allgather`` path, which moved O(nprocs x size) bytes
+    through every host's Python heap).
+
+    Each of this process's L local devices contributes ``val / L`` as one
+    row of a global ``[n_devices, ...]`` array; a jitted ``sum(axis=0)``
+    lowers to one XLA all-reduce (intra-host reduce over ICI/shared
+    memory, then DCN). Returns a host ndarray for small values; for big
+    values (>= MXNET_KVSTORE_BIGARRAY_BOUND) with ``shard_big`` the
+    result stays REDUCE-SCATTERED on the mesh (a jax.Array, stored
+    as-is; ``pull`` all-gathers) — the reference's range partitioning
+    across servers (``kvstore_dist.h:230-268``) in mesh terms.
     """
-    from jax.experimental import multihost_utils
-    return multihost_utils.process_allgather(np.asarray(val)).sum(axis=0)
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _dcn_mesh()
+    ndev = mesh.devices.size
+    nlocal = len(jax.local_devices())
+    x = np.asarray(val)
+    big = shard_big and x.size >= _bigarray_bound()
+    rows = np.broadcast_to(x[None] / nlocal, (nlocal,) + x.shape)
+    in_sh = NamedSharding(mesh, P("dcn", *([None] * x.ndim)))
+    stacked = jax.make_array_from_process_local_data(in_sh, rows)
+
+    key = ("fn", stacked.shape, str(x.dtype), big)
+    if key not in _dcn_state:
+        if big:
+            # pad the leading dim so the reduce-scattered shards divide
+            pad_to = -(-x.shape[0] // ndev) * ndev
+            out_sh = NamedSharding(mesh, P("dcn", *([None] * (x.ndim - 1))))
+
+            def reduce_fn(a):
+                s = a.sum(axis=0)
+                if pad_to != s.shape[0]:
+                    s = jax.numpy.pad(
+                        s, [(0, pad_to - s.shape[0])] +
+                        [(0, 0)] * (s.ndim - 1))
+                return s
+        else:
+            out_sh = NamedSharding(mesh, P())
+
+            def reduce_fn(a):
+                return a.sum(axis=0)
+        _dcn_state[key] = jax.jit(reduce_fn, out_shardings=out_sh)
+    out = _dcn_state[key](stacked)
+    if big:
+        return _ShardedValue(out, x.shape)
+    return np.asarray(out)
+
+
+class _ShardedValue:
+    """A reduce-scattered stored value: lives sharded on the global mesh
+    (leading dim padded to the device count); gathered only on pull."""
+
+    def __init__(self, arr, true_shape):
+        self.arr = arr
+        self.true_shape = tuple(true_shape)
+
+    def gather(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        key = ("gather", self.arr.shape, str(self.arr.dtype))
+        if key not in _dcn_state:
+            _dcn_state[key] = jax.jit(
+                lambda a: a,
+                out_shardings=NamedSharding(_dcn_mesh(), P()))
+        full = np.asarray(_dcn_state[key](self.arr))
+        return full[:self.true_shape[0]].reshape(self.true_shape)
 
 
 def create(name="local"):

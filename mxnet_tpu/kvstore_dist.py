@@ -1,0 +1,265 @@
+"""``dist_async``: a real update-per-push parameter server.
+
+The reference's async mode lives in ps-lite server processes: every
+worker push is applied to the weights immediately, with NO worker
+lockstep (``/root/reference/src/kvstore/kvstore_dist_server.h:194-202``);
+workers pull whatever the current weights are. XLA collectives cannot
+express that (they are synchronous by construction), so this backend is
+deliberately HOST-driven, like the reference's: each process runs one
+server thread (the reference colocates via ps-lite roles; here every
+worker hosts a server, so ``-n N`` gives N servers like ``num_servers =
+num_workers`` launches), and requests ride length-prefixed pickle over
+TCP where ps-lite rode ZMQ.
+
+Key placement mirrors ``EncodeKey`` (``kvstore_dist.h:230-268``):
+
+* small keys hash to one server: ``(key * 9973) % num_servers``;
+* arrays >= ``MXNET_KVSTORE_BIGARRAY_BOUND`` are RANGE-PARTITIONED along
+  their first axis across all servers, so no single host stores or
+  updates a whole embedding-sized array.
+
+Updates run in the owning server's thread, serialized per server by the
+request loop (the reference serializes through the ps handler thread) —
+``updater(key, recv, stored)`` with the optimizer the workers sent via
+``set_optimizer`` (pickled, command 0 in the reference protocol).
+
+Server addresses: ``MXNET_KVSTORE_SERVER_HOSTS`` (comma list, one per
+process) or 127.0.0.1 for single-machine multi-process runs;
+``MXNET_KVSTORE_PORT_BASE`` (default 24500) + rank.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from .base import MXNetError
+from .kvstore import _bigarray_bound  # single source for the threshold
+
+__all__ = ["PSBackend"]
+
+_LEN = struct.Struct("!Q")
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _port_base():
+    if "MXNET_KVSTORE_PORT_BASE" in os.environ:
+        return int(os.environ["MXNET_KVSTORE_PORT_BASE"])
+    # derive from the coordinator port so concurrent launches on one
+    # machine (each with its own free coordinator port) don't collide
+    coord = os.environ.get("MXNET_TPU_COORDINATOR")
+    if coord and ":" in coord:
+        return int(coord.rsplit(":", 1)[1]) + 1000
+    return 24500
+
+
+class _Server(threading.Thread):
+    """One server thread: owns a slice of the key space; applies pushes
+    immediately (async semantics). Daemon — dies with the process."""
+
+    def __init__(self, rank):
+        super().__init__(daemon=True, name="mxnet-ps-server-%d" % rank)
+        self.rank = rank
+        self.store = {}        # (key, part) -> np.ndarray
+        self.updater = None
+        self.lock = threading.Lock()
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("0.0.0.0", _port_base() + rank))
+        self.sock.listen(64)
+
+    def run(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return  # socket closed at shutdown
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                op = msg[0]
+                if op == "init":
+                    _, key, part, val = msg
+                    with self.lock:
+                        # first init wins (every worker inits every key)
+                        self.store.setdefault((key, part), val.copy())
+                    _send_msg(conn, ("ok",))
+                elif op == "push":
+                    _, key, part, val = msg
+                    with self.lock:
+                        if (key, part) not in self.store:
+                            _send_msg(conn, ("err",
+                                             "key %s not init" % key))
+                            continue
+                        stored = self.store[(key, part)]
+                        if self.updater is not None:
+                            # update-per-push, reference
+                            # kvstore_dist_server.h:194-202
+                            from . import ndarray as nd
+                            recv = nd.array(val)
+                            dst = nd.array(stored)
+                            self.updater(key, recv, dst)
+                            self.store[(key, part)] = dst.asnumpy()
+                        else:
+                            # no updater: plain overwrite-with-merged,
+                            # like the reference server without optimizer
+                            self.store[(key, part)] = val.copy()
+                    _send_msg(conn, ("ok",))
+                elif op == "pull":
+                    _, key, part = msg
+                    with self.lock:
+                        val = self.store.get((key, part))
+                    if val is None:
+                        _send_msg(conn, ("err", "key %s not init" % key))
+                    else:
+                        _send_msg(conn, ("ok", val))
+                elif op == "set_optimizer":
+                    from . import optimizer as opt_mod
+                    optimizer = pickle.loads(msg[1])
+                    with self.lock:
+                        if isinstance(optimizer, opt_mod.Optimizer):
+                            self.updater = opt_mod.get_updater(optimizer)
+                        else:
+                            self.updater = optimizer  # pre-built updater
+                    _send_msg(conn, ("ok",))
+                elif op == "stop":
+                    _send_msg(conn, ("ok",))
+                    return
+                else:
+                    _send_msg(conn, ("err", "bad op %r" % (op,)))
+        except (ConnectionError, EOFError):
+            pass
+        finally:
+            conn.close()
+
+
+class PSBackend:
+    """Worker-side client + this process's colocated server."""
+
+    def __init__(self):
+        import jax
+        self.rank = jax.process_index()
+        self.nserv = jax.process_count()
+        hosts = os.environ.get("MXNET_KVSTORE_SERVER_HOSTS")
+        if hosts:
+            self.hosts = [h.strip() for h in hosts.split(",")]
+            if len(self.hosts) != self.nserv:
+                raise MXNetError(
+                    "MXNET_KVSTORE_SERVER_HOSTS lists %d hosts for %d "
+                    "processes" % (len(self.hosts), self.nserv))
+        else:
+            self.hosts = ["127.0.0.1"] * self.nserv
+        self.server = _Server(self.rank)
+        self.server.start()
+        self._conns = {}
+        self._lock = threading.Lock()
+        self._layout = {}  # key -> [(server, slice)] fixed at init
+        # make sure every server is listening before anyone pushes
+        from . import distributed
+        distributed.barrier("ps_backend_up")
+        logging.info("dist_async parameter server up: rank %d/%d",
+                     self.rank, self.nserv)
+
+    # -- transport ----------------------------------------------------
+    def _conn_locked(self, server):
+        c = self._conns.get(server)
+        if c is None:
+            c = socket.create_connection(
+                (self.hosts[server], _port_base() + server), timeout=120)
+            self._conns[server] = c
+        return c
+
+    def _request(self, server, msg):
+        with self._lock:  # one in-flight request per worker (like the
+            c = self._conn_locked(server)  # engine var serializing pushes)
+            _send_msg(c, msg)
+            reply = _recv_msg(c)
+        if reply[0] != "ok":
+            raise MXNetError("parameter server: %s" % (reply[1],))
+        return reply[1] if len(reply) > 1 else None
+
+    # -- key placement (reference EncodeKey, kvstore_dist.h:230-268) --
+    def _owner(self, key):
+        return (key * 9973) % self.nserv
+
+    def _partition(self, key, shape):
+        """[(server, slice)] — whole-array for small keys, first-axis
+        ranges across every server for big ones."""
+        size = int(np.prod(shape)) if shape else 1
+        if size < _bigarray_bound() or not shape or shape[0] < self.nserv:
+            return [(self._owner(key), slice(None))]
+        rows = shape[0]
+        per = -(-rows // self.nserv)
+        parts = []
+        for s in range(self.nserv):
+            lo = min(s * per, rows)
+            hi = min(lo + per, rows)
+            if lo < hi:
+                parts.append((s, slice(lo, hi)))
+        return parts
+
+    # -- API ----------------------------------------------------------
+    def init(self, key, value):
+        value = np.asarray(value)
+        self._layout[key] = self._partition(key, value.shape)
+        for part, (server, sl) in enumerate(self._layout[key]):
+            self._request(server, ("init", key, part, value[sl]))
+
+    def push(self, key, value):
+        value = np.asarray(value)
+        for part, (server, sl) in enumerate(self._layout[key]):
+            self._request(server, ("push", key, part, value[sl]))
+
+    def pull(self, key):
+        parts = [self._request(server, ("pull", key, part))
+                 for part, (server, _) in enumerate(self._layout[key])]
+        if len(parts) == 1:
+            return parts[0]
+        return np.concatenate(parts, axis=0)
+
+    def set_optimizer(self, pickled):
+        for s in range(self.nserv):
+            self._request(s, ("set_optimizer", pickled))
+
+    def close(self):
+        """Close client connections and the server's listening socket
+        (unblocks a later dist_async store binding the same port)."""
+        with self._lock:
+            for c in self._conns.values():
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+        try:
+            self.server.sock.close()
+        except OSError:
+            pass

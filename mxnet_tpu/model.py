@@ -12,6 +12,7 @@ name prefixes) — interchangeable with reference checkpoints.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from collections import namedtuple
 
@@ -208,6 +209,155 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
     _drain_async_writers(epoch_end_callback)
 
 
+def _fused_fit_eligible(ctx, kvstore, monitor, sym_gen, work_load_list,
+                        optimizer):
+    """Should fit() run the fused ParallelTrainer step instead of the
+    per-device executor loop?
+
+    Default policy: fused on an all-TPU ctx (the flagship path —
+    train_imagenet.py on tpu devices runs ONE XLA program per step);
+    legacy executors elsewhere (cpu debugging, parity with the
+    reference's loop). ``MXNET_FUSED_FIT=1`` forces fused on any ctx,
+    ``=0`` forces legacy. Features only the legacy loop supports
+    (monitor hooks, bucketing sym_gen, uneven work loads, dist kvstore,
+    per-index lr_scale, custom optimizers without functional adapters)
+    fall back automatically.
+    """
+    flag = os.environ.get("MXNET_FUSED_FIT")
+    if flag == "0":
+        return False
+    if monitor is not None or sym_gen is not None:
+        return False
+    if work_load_list is not None and len(set(work_load_list)) > 1:
+        return False
+    if kvstore is not None and "dist" in kvstore.type:
+        return False
+    if getattr(optimizer, "lr_scale", None):
+        return False
+    try:
+        from .parallel.optim import make_functional
+        make_functional(optimizer)
+    except MXNetError:
+        return False
+    if flag == "1":
+        return True
+    if any(c.device_type != "tpu" for c in ctx):
+        return False
+    import jax
+    return len(jax.devices()) >= len(ctx)
+
+
+def _mesh_for_ctx(ctx):
+    """A dp mesh over the jax devices the ctx list names (by device_id
+    when resolvable, else the first len(ctx) devices)."""
+    import jax
+    from .parallel import build_mesh
+    devices = jax.devices()
+    by_id = {d.id: d for d in devices}
+    picked = []
+    for c in ctx:
+        d = by_id.get(c.device_id)
+        if d is None or d in picked:
+            picked = devices[:len(ctx)]
+            break
+        picked.append(d)
+    return build_mesh({"dp": len(picked)}, picked)
+
+
+def _train_fused(symbol, ctx, arg_params, aux_params, begin_epoch,
+                 end_epoch, epoch_size, optimizer, train_data,
+                 eval_data=None, eval_metric=None, epoch_end_callback=None,
+                 batch_end_callback=None, logger=None, kvstore=None,
+                 eval_batch_end_callback=None):
+    """The fused training loop: protocol-identical to
+    ``_train_multi_device`` (metrics, callbacks, epoch_size semantics),
+    but each step is ONE donated XLA program on a dp mesh
+    (``ParallelTrainer``) — forward, backward, gradient aggregation, and
+    the optimizer update fused, with the cross-device reduce as an
+    in-program psum instead of kvstore copies (reference
+    model.py:118-308 runs these as separate host-driven phases)."""
+    from .parallel import ParallelTrainer
+    if logger is None:
+        logger = logging
+    if kvstore is not None:
+        logger.info("fused fit: '%s' kvstore is subsumed by the "
+                    "in-program gradient reduction", kvstore.type)
+    mesh = _mesh_for_ctx(ctx)
+    input_shapes = dict(train_data.provide_data + train_data.provide_label)
+    trainer = ParallelTrainer(symbol, input_shapes, optimizer=optimizer,
+                              mesh=mesh)
+    trainer.init_params(arg_params, aux_params)
+    data_names = [x[0] for x in train_data.provide_data]
+    label_names = [x[0] for x in train_data.provide_label]
+
+    def sync_params():
+        ap, xp = trainer.get_params()
+        for k, v in ap.items():
+            v.copyto(arg_params[k])
+        for k, v in xp.items():
+            v.copyto(aux_params[k])
+
+    train_data.reset()
+    for epoch in range(begin_epoch, end_epoch):
+        tic = time.time()
+        eval_metric.reset()
+        nbatch = 0
+        while True:
+            do_reset = True
+            for data_batch in train_data:
+                batch = dict(zip(data_names, data_batch.data))
+                batch.update(zip(label_names, data_batch.label))
+                outs = trainer.step(batch)
+                out_nds = [nd.array(np.asarray(o)) for o in outs]
+                eval_metric.update(data_batch.label, out_nds)
+                nbatch += 1
+                if batch_end_callback is not None:
+                    batch_end_params = BatchEndParam(epoch=epoch,
+                                                     nbatch=nbatch,
+                                                     eval_metric=eval_metric,
+                                                     locals=locals())
+                    _run_callbacks(batch_end_callback, batch_end_params)
+                if epoch_size is not None and nbatch >= epoch_size:
+                    do_reset = False
+                    break
+            if do_reset:
+                logger.info("Epoch[%d] Resetting Data Iterator", epoch)
+                train_data.reset()
+            if epoch_size is None or nbatch >= epoch_size:
+                break
+        toc = time.time()
+        logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
+
+        if epoch_end_callback or epoch + 1 == end_epoch:
+            sync_params()
+        if epoch_end_callback is not None:
+            for callback in (epoch_end_callback
+                             if isinstance(epoch_end_callback, list)
+                             else [epoch_end_callback]):
+                callback(epoch, symbol, arg_params, aux_params)
+
+        if eval_data:
+            eval_metric.reset()
+            eval_data.reset()
+            for i, eval_batch in enumerate(eval_data):
+                batch = dict(zip(data_names, eval_batch.data))
+                batch.update(zip(label_names, eval_batch.label))
+                outs = trainer.forward(batch)
+                out_nds = [nd.array(np.asarray(o)) for o in outs]
+                eval_metric.update(eval_batch.label, out_nds)
+                if eval_batch_end_callback is not None:
+                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=i,
+                                                     eval_metric=eval_metric,
+                                                     locals=locals())
+                    _run_callbacks(eval_batch_end_callback, batch_end_params)
+            name_value = [eval_metric.get()]
+            for name, value in name_value:
+                logger.info("Epoch[%d] Validation-%s=%f", epoch, name, value)
+            eval_data.reset()
+
+    _drain_async_writers(epoch_end_callback)
+
+
 def _drain_async_writers(epoch_end_callback):
     if epoch_end_callback is None:
         return
@@ -225,12 +375,20 @@ def _run_callbacks(callbacks, params):
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """Save prefix-symbol.json + prefix-%04d.params (reference :311)."""
+    """Save prefix-symbol.json + prefix-%04d.params (reference :311).
+
+    The .params file is written via tmp + os.replace so a writer dying
+    mid-write (e.g. do_checkpoint(async_write=True)'s daemon thread at
+    interpreter exit) never leaves a truncated file that looks complete.
+    """
+    import os
     symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    tmp_name = param_name + ".tmp"
+    nd.save(tmp_name, save_dict)
+    os.replace(tmp_name, param_name)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 
@@ -485,20 +643,33 @@ class FeedForward(BASE_ESTIMATOR):
             raise TypeError("optimizer must be str or Optimizer")
 
         try:
-            _train_multi_device(
-                self.symbol, self.ctx, arg_names, param_names, aux_names,
-                self.arg_params, self.aux_params,
-                begin_epoch=self.begin_epoch, end_epoch=self.num_epoch,
-                epoch_size=self.epoch_size, optimizer=optimizer,
-                train_data=data, eval_data=eval_data,
-                eval_metric=eval_metric,
-                epoch_end_callback=epoch_end_callback,
-                batch_end_callback=batch_end_callback,
-                kvstore=kvstore, update_on_kvstore=update_on_kvstore,
-                logger=logger, work_load_list=work_load_list,
-                monitor=monitor,
-                eval_batch_end_callback=eval_batch_end_callback,
-                sym_gen=self.sym_gen)
+            if _fused_fit_eligible(self.ctx, kvstore, monitor, self.sym_gen,
+                                   work_load_list, optimizer):
+                _train_fused(
+                    self.symbol, self.ctx, self.arg_params, self.aux_params,
+                    begin_epoch=self.begin_epoch, end_epoch=self.num_epoch,
+                    epoch_size=self.epoch_size, optimizer=optimizer,
+                    train_data=data, eval_data=eval_data,
+                    eval_metric=eval_metric,
+                    epoch_end_callback=epoch_end_callback,
+                    batch_end_callback=batch_end_callback,
+                    kvstore=kvstore, logger=logger,
+                    eval_batch_end_callback=eval_batch_end_callback)
+            else:
+                _train_multi_device(
+                    self.symbol, self.ctx, arg_names, param_names, aux_names,
+                    self.arg_params, self.aux_params,
+                    begin_epoch=self.begin_epoch, end_epoch=self.num_epoch,
+                    epoch_size=self.epoch_size, optimizer=optimizer,
+                    train_data=data, eval_data=eval_data,
+                    eval_metric=eval_metric,
+                    epoch_end_callback=epoch_end_callback,
+                    batch_end_callback=batch_end_callback,
+                    kvstore=kvstore, update_on_kvstore=update_on_kvstore,
+                    logger=logger, work_load_list=work_load_list,
+                    monitor=monitor,
+                    eval_batch_end_callback=eval_batch_end_callback,
+                    sym_gen=self.sym_gen)
         finally:
             # drain async checkpoint writers even on error/interrupt so
             # no .params file is left truncated by a dying daemon thread

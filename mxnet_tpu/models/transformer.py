@@ -67,38 +67,61 @@ def moe_transformer_block(data, num_heads, hidden, embed_dim, num_experts,
 
 def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
                        ffn_hidden=None, seq_len=None, impl="flash",
-                       dropout=0.0, num_experts=0):
+                       dropout=0.0, num_experts=0, pipeline_stages=None):
     """Decoder-only LM: Embedding -> N blocks -> tied-free FC -> softmax
     over vocab per position (multi_output SoftmaxOutput, the reference's
-    per-position softmax mode, softmax_output-inl.h multi_output)."""
+    per-position softmax mode, softmax_output-inl.h multi_output).
+
+    ``pipeline_stages=S`` tags every node with ``ctx_group='stage<K>'``
+    (the reference's model-parallel graph-cut attribute,
+    graph_executor.cc:341-458): embedding with the first block group,
+    final LN + head + loss with the last; blocks spread evenly. The
+    tagged symbol drives ``parallel.PipelineTrainer``.
+    """
+    from ..attribute import AttrScope
+
     if ffn_hidden is None:
         ffn_hidden = 4 * embed_dim
-    data = sym.Variable("data")  # [B, T] int tokens
-    net = sym.Embedding(data=data, input_dim=vocab_size,
-                        output_dim=embed_dim, name="embed")
-    # learned additive positional embedding, rows sharded with their
-    # positions under sequence parallelism
-    net = sym.PositionalEmbedding(data=net,
-                                  pos=sym.Variable("pos_embed"),
-                                  name="pos_add")
-    for i in range(num_layers):
-        if num_experts:
-            net = moe_transformer_block(net, num_heads, ffn_hidden,
-                                        embed_dim, num_experts,
-                                        "layer%d" % i, impl=impl,
-                                        dropout=dropout)
+
+    def scope(i=None, last=False):
+        if not pipeline_stages:
+            return AttrScope()
+        if last:
+            s = pipeline_stages - 1
         else:
-            net = transformer_block(net, num_heads, ffn_hidden, embed_dim,
-                                    "layer%d" % i, impl=impl,
-                                    dropout=dropout)
-    ln_f = sym.LayerNorm(data=net, gamma=sym.Variable("lnf_gamma"),
-                         beta=sym.Variable("lnf_beta"), name="lnf")
-    logits = sym.FullyConnected(data=ln_f, num_hidden=vocab_size,
-                                name="lm_head", flatten=False)
-    # per-position softmax: label [B, T]
-    logits_t = sym.SwapAxis(data=logits, dim1=1, dim2=2, name="logits_t")
-    return sym.SoftmaxOutput(data=logits_t, name="softmax",
-                             multi_output=True)
+            s = 0 if i is None else i * pipeline_stages // num_layers
+        return AttrScope(ctx_group="stage%d" % s)
+
+    with scope(0):
+        data = sym.Variable("data")  # [B, T] int tokens
+        net = sym.Embedding(data=data, input_dim=vocab_size,
+                            output_dim=embed_dim, name="embed")
+        # learned additive positional embedding, rows sharded with their
+        # positions under sequence parallelism
+        net = sym.PositionalEmbedding(data=net,
+                                      pos=sym.Variable("pos_embed"),
+                                      name="pos_add")
+    for i in range(num_layers):
+        with scope(i):
+            if num_experts:
+                net = moe_transformer_block(net, num_heads, ffn_hidden,
+                                            embed_dim, num_experts,
+                                            "layer%d" % i, impl=impl,
+                                            dropout=dropout)
+            else:
+                net = transformer_block(net, num_heads, ffn_hidden,
+                                        embed_dim, "layer%d" % i,
+                                        impl=impl, dropout=dropout)
+    with scope(last=True):
+        ln_f = sym.LayerNorm(data=net, gamma=sym.Variable("lnf_gamma"),
+                             beta=sym.Variable("lnf_beta"), name="lnf")
+        logits = sym.FullyConnected(data=ln_f, num_hidden=vocab_size,
+                                    name="lm_head", flatten=False)
+        # per-position softmax: label [B, T]
+        logits_t = sym.SwapAxis(data=logits, dim1=1, dim2=2,
+                                name="logits_t")
+        return sym.SoftmaxOutput(data=logits_t, name="softmax",
+                                 multi_output=True)
 
 
 def tp_rules():

@@ -185,7 +185,10 @@ class MultiHeadAttention(OpSpec):
             from ..parallel.ring import blockwise_attention
             o = blockwise_attention(q, k, v, causal=p["causal"])
         elif impl == "dense":
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+            # float(): np.sqrt returns a STRONG f64 scalar under x64,
+            # which would silently promote the whole graph (and f64 is
+            # emulated, ~10x slower, on TPU)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / float(np.sqrt(d))
             if p["causal"]:
                 mask = jnp.tril(jnp.ones((t, t), bool))
                 s = jnp.where(mask[None, None], s, -jnp.inf)

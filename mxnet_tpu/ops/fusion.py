@@ -1,0 +1,192 @@
+"""Graph-level fused-kernel selection — the CreateOp-time cuDNN analogue.
+
+The reference picks its fused/fast operator variants when the executor
+creates ops: ``CreateOp`` returns the ``cudnn_*`` implementation when
+cuDNN is available (``/root/reference/src/operator/convolution.cu``,
+``cudnn_convolution-inl.h``, ``cudnn_batch_norm-inl.h``). The TPU
+analogue happens at graph-walk time: ``FusionPlan`` statically matches
+fusible chains in the topo order, and the shared ``eval_graph`` walk
+(used by both the Executor and ``parallel.make_graph_fn``) executes each
+chain as ONE Pallas kernel instead of separate XLA ops:
+
+* ``FullyConnected -> Activation`` (relu/sigmoid/tanh) — train and eval;
+  gradient via ``fused_linear``'s custom_vjp.
+* ``Convolution -> BatchNorm [-> Activation(relu)]`` — eval only: the
+  moving-stats normalization folds into a per-channel scale/bias GEMM
+  epilogue (``fused_conv_bn_act``). Training BatchNorm needs batch stats
+  of the full conv output, so the train path keeps the XLA ops (XLA
+  already fuses the normalize+relu elementwise chain into the conv's
+  epilogue; measured in doc/performance.md).
+
+Selection control: ``MXNET_PALLAS_FUSION=1`` forces on (any backend,
+interpreter on CPU), ``=0`` forces off; default = on when running on
+TPU. A chain is only fused when the intermediate outputs have exactly
+one consumer and are not executor heads.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["FusionPlan", "eval_graph"]
+
+
+def fusion_enabled():
+    flag = os.environ.get("MXNET_PALLAS_FUSION")
+    if flag == "0":
+        return False
+    if flag == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
+_FC_ACTS = ("relu", "sigmoid", "tanh")
+
+
+class FusionPlan:
+    """Static chain matching over a Symbol's topo order."""
+
+    def __init__(self, topo, heads):
+        # chains are keyed by their LAST node: by the time the walk
+        # reaches it, every outside input of every chain member (e.g. the
+        # BatchNorm gamma/beta variables, which topo-sort AFTER the conv)
+        # is in env. Earlier members are 'covered' (skipped while active).
+        self.chains = {}   # id(last_node) -> (kind, [nodes...])
+        self.covered = {}  # id(earlier_node) -> kind
+        self.aux_off = {}  # id(node) -> aux cursor at that node
+        cursor = 0
+        consumers = {}
+        for n in topo:
+            if n.is_var:
+                continue
+            self.aux_off[id(n)] = cursor
+            cursor += len(n.spec.aux_states(n.params))
+            for inp, idx in n.inputs:
+                consumers.setdefault((id(inp), idx), []).append(n)
+        head_set = {(id(h), i) for h, i in heads}
+
+        def sole_consumer(node, idx=0):
+            if (id(node), idx) in head_set:
+                return None
+            cs = consumers.get((id(node), idx), [])
+            return cs[0] if len(cs) == 1 else None
+
+        for n in topo:
+            if n.is_var or id(n) in self.covered:
+                continue
+            op = n.spec.name
+            if op == "FullyConnected":
+                act = sole_consumer(n)
+                if act is not None and act.spec.name == "Activation" \
+                        and act.params.get("act_type") in _FC_ACTS \
+                        and act.inputs[0][0] is n:
+                    self.chains[id(act)] = ("fc_act", [n, act])
+                    self.covered[id(n)] = "fc_act"
+            elif op == "Convolution" and n.params.get("num_group", 1) == 1:
+                bn = sole_consumer(n)
+                if bn is None or bn.spec.name != "BatchNorm" \
+                        or bn.inputs[0][0] is not n:
+                    continue
+                act = sole_consumer(bn)
+                if act is not None and act.spec.name == "Activation" \
+                        and act.params.get("act_type") == "relu" \
+                        and act.inputs[0][0] is bn:
+                    self.chains[id(act)] = ("conv_bn_relu", [n, bn, act])
+                    self.covered[id(n)] = "conv_bn_relu"
+                    self.covered[id(bn)] = "conv_bn_relu"
+                else:
+                    self.chains[id(bn)] = ("conv_bn", [n, bn])
+                    self.covered[id(n)] = "conv_bn"
+
+    @staticmethod
+    def _active(kind, is_train):
+        # conv+bn folding needs the moving stats — inference only
+        return kind == "fc_act" or not is_train
+
+    def is_covered(self, n, is_train):
+        kind = self.covered.get(id(n))
+        return kind is not None and self._active(kind, is_train)
+
+    def execute(self, n, env, aux_vals, is_train):
+        """If ``n`` ends an active chain, compute the fused result into
+        its env slot and return True."""
+        entry = self.chains.get(id(n))
+        if entry is None or not self._active(entry[0], is_train):
+            return False
+        from . import pallas_kernels as pk
+        kind, nodes = entry
+        ins = [env[(id(inp), idx)] for inp, idx in nodes[0].inputs]
+        if kind == "fc_act":
+            fc, act = nodes
+            p = fc.params
+            x = ins[0]
+            orig_shape = x.shape
+            if p["flatten"]:
+                x = x.reshape(x.shape[0], -1)
+            else:
+                x = x.reshape(-1, x.shape[-1])
+            b = ins[2] if not p["no_bias"] else \
+                jnp.zeros((p["num_hidden"],), ins[1].dtype)
+            out = pk.fused_linear(x, ins[1].T, b,
+                                  act.params["act_type"])
+            if not p["flatten"]:
+                out = out.reshape(orig_shape[:-1] + (p["num_hidden"],))
+            env[(id(act), 0)] = out
+            return True
+        # conv_bn / conv_bn_relu (eval: fold moving stats)
+        conv, bn = nodes[0], nodes[1]
+        p = conv.params
+        bp = bn.params
+        gamma, beta = (env[(id(inp), idx)] for inp, idx in bn.inputs[1:3])
+        if bp["fix_gamma"]:
+            gamma = jnp.ones_like(gamma)
+        off = self.aux_off[id(bn)]
+        mmean, mvar = aux_vals[off], aux_vals[off + 1]
+        inv = gamma * jax.lax.rsqrt(mvar + bp["eps"])
+        bias = beta - mmean * inv
+        if not p["no_bias"]:
+            bias = bias + ins[2] * inv  # conv bias folds through the BN
+        out = pk.fused_conv_bn_act(
+            ins[0], ins[1], inv, bias, stride=p["stride"], pad=p["pad"],
+            dilate=p["dilate"],
+            act="relu" if kind == "conv_bn_relu" else "linear")
+        env[(id(nodes[-1]), 0)] = out
+        return True
+
+
+def eval_graph(topo, heads, arg_vals, aux_vals, is_train, rng, plan=None):
+    """The shared topological walk (reference: per-node RunOps,
+    ``graph_executor.cc:776-819``; here ONE trace → one XLA program).
+    Returns (head_outs, new_aux, env)."""
+    env = {}
+    var_iter = iter(arg_vals)
+    aux_cursor = 0
+    new_aux = list(aux_vals)
+    fuse = plan is not None and fusion_enabled()
+    for i, n in enumerate(topo):
+        if n.is_var:
+            env[(id(n), 0)] = next(var_iter)
+            continue
+        n_aux = len(n.spec.aux_states(n.params))
+        if fuse and plan.is_covered(n, is_train):
+            # produced by a fused chain head; aux (BN moving stats) pass
+            # through unchanged — fusion is inference-only for stateful ops
+            aux_cursor += n_aux
+            continue
+        if fuse and plan.execute(n, env, aux_vals, is_train):
+            aux_cursor += n_aux
+            continue
+        ins = [env[(id(inp), idx)] for inp, idx in n.inputs]
+        aux_in = list(aux_vals[aux_cursor:aux_cursor + n_aux])
+        node_rng = jax.random.fold_in(rng, i)
+        outs, aux_out = n.spec.forward(n.params, ins, aux_in, is_train,
+                                       node_rng)
+        for j, o in enumerate(outs):
+            env[(id(n), j)] = o
+        if n_aux:
+            new_aux[aux_cursor:aux_cursor + n_aux] = list(aux_out)
+        aux_cursor += n_aux
+    outs = [env[(id(h), i)] for h, i in heads]
+    return outs, new_aux, env

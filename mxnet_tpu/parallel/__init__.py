@@ -27,4 +27,5 @@ from .checkpoint import save_sharded, load_sharded  # noqa: F401
 from . import collectives  # noqa: F401
 from .ring import (ring_attention, blockwise_attention,  # noqa: F401
                    ring_self_attention)
-from .pipeline import pipeline_spmd  # noqa: F401
+from .pipeline import (pipeline_spmd, partition_stages,  # noqa: F401
+                       PipelineTrainer)

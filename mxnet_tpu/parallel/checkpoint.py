@@ -131,8 +131,19 @@ def load_sharded(prefix, mesh, param_specs=None):
         pieces = []
         for dev, index in sharding.addressable_devices_indices_map(
                 shape).items():
-            piece = shards[_index_key(index, shape)]
-            pieces.append(jax.device_put(piece, dev))
+            key = _index_key(index, shape)
+            if key not in shards:
+                from ..base import MXNetError
+                raise MXNetError(
+                    "load_sharded: no saved shard %s for param %r "
+                    "(saved shards: %s). Shards are keyed by their "
+                    "global index at SAVE time — loading under a "
+                    "different mesh shape or param_specs that reshard "
+                    "the array is not supported; load with the saving "
+                    "topology/specs, or gather to a FeedForward-style "
+                    "checkpoint for cross-topology restores."
+                    % (key, name, sorted(shards)))
+            pieces.append(jax.device_put(shards[key], dev))
         params[name] = jax.make_array_from_single_device_arrays(
             shape, sharding, pieces)
     return params, manifest["step"], manifest.get("extra", {})

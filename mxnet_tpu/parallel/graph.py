@@ -9,43 +9,36 @@ memory allocator, and inplace planning are all XLA's job afterwards.
 """
 from __future__ import annotations
 
-import jax
+from ..ops.fusion import FusionPlan, eval_graph
 
 __all__ = ["make_graph_fn"]
 
 
-def make_graph_fn(symbol):
+def make_graph_fn(symbol, allow_fusion=True):
     """Build ``fn(arg_vals, aux_vals, is_train, rng) -> (outs, new_aux)``.
 
     ``arg_vals`` is a list in ``symbol.list_arguments()`` order (the
     topological order of variable nodes); ``aux_vals`` a list in
     ``symbol.list_auxiliary_states()`` order. The returned function is pure
-    and traceable; ``is_train`` must be a static Python bool.
+    and traceable; ``is_train`` must be a static Python bool. The walk and
+    the fused-Pallas-kernel selection live in ``ops.fusion``.
+
+    ``allow_fusion=False`` suppresses DEFAULT fusion (callers that trace
+    under GSPMD sharding on a multi-device mesh, where a pallas_call has
+    no partitioning rule and would force operands replicated);
+    ``MXNET_PALLAS_FUSION=1`` still force-enables.
     """
+    import os
     topo = symbol._topo()
     heads = symbol._heads
+    if allow_fusion or os.environ.get("MXNET_PALLAS_FUSION") == "1":
+        plan = FusionPlan(topo, heads)
+    else:
+        plan = None
 
     def fn(arg_vals, aux_vals, is_train, rng):
-        env = {}
-        var_iter = iter(arg_vals)
-        aux_cursor = 0
-        new_aux = list(aux_vals)
-        for i, n in enumerate(topo):
-            if n.is_var:
-                env[(id(n), 0)] = next(var_iter)
-                continue
-            ins = [env[(id(inp), idx)] for inp, idx in n.inputs]
-            n_aux = len(n.spec.aux_states(n.params))
-            aux_in = list(aux_vals[aux_cursor:aux_cursor + n_aux])
-            node_rng = jax.random.fold_in(rng, i)
-            outs, aux_out = n.spec.forward(n.params, ins, aux_in,
-                                           is_train, node_rng)
-            for j, o in enumerate(outs):
-                env[(id(n), j)] = o
-            if n_aux:
-                new_aux[aux_cursor:aux_cursor + n_aux] = list(aux_out)
-            aux_cursor += n_aux
-        out_vals = [env[(id(h), i)] for h, i in heads]
-        return out_vals, new_aux
+        outs, new_aux, _ = eval_graph(topo, heads, arg_vals, aux_vals,
+                                      is_train, rng, plan=plan)
+        return outs, new_aux
 
     return fn

@@ -1,47 +1,73 @@
-"""SPMD pipeline parallelism over the ``pp`` mesh axis.
+"""Pipeline parallelism over the ``pp`` mesh axis.
 
-The reference's only model parallelism is graph partitioning by
-``ctx_group`` with copy nodes between devices
-(``src/symbol/graph_executor.cc:341-458``) — each device runs a different
-sub-graph, serially per batch. The TPU-native form is an SPMD GPipe
-schedule: every device runs the SAME program holding its own stage's
-parameters; activations advance one stage per tick via
-``lax.ppermute``, and microbatches stream through to fill the pipeline
-(bubble = (S-1)/(M+S-1)).
+The reference's only model parallelism is graph partitioning by the
+``ctx_group`` attribute with automatic copy-node insertion between
+devices (``/root/reference/src/symbol/graph_executor.cc:341-458``,
+tested by ``tests/python/unittest/test_model_parallel.py``): each device
+runs a different sub-graph serially. The TPU-native promotion of that
+mechanism is an SPMD GPipe schedule driven by the SAME ``ctx_group``
+attribute:
 
-Constraint (standard for SPMD pipelining): all stages must map equal
-activation shapes — true for the repeated-block middle of deep nets,
-which is where pipelining pays.
+* ``partition_stages`` cuts a loss-headed Symbol into S stages from
+  ``ctx_group="stageK"`` node attributes (the reference's graph-cut
+  tags), validating that the cut is a chain with ONE boundary activation
+  of a uniform shape between consecutive stages.
+* ``PipelineTrainer`` compiles ONE program for the whole mesh: every
+  device runs the same ``lax.fori_loop`` schedule; ``lax.switch`` on the
+  stage index runs that device's sub-graph (stages may be UNEQUAL —
+  different ops, different parameter counts — because each is its own
+  switch branch), and activations advance one stage per tick via
+  ``lax.ppermute`` over ICI neighbours.
+* Microbatches stream through to fill the pipe: the schedule is GPipe
+  with bubble fraction (S-1)/(M+S-1) — documented, not hidden; the
+  backward pass is ``jax.vjp`` THROUGH the schedule (the transpose of
+  ``ppermute`` is the reverse rotation), so gradients drain the pipe in
+  reverse order — the same wave 1F1B exploits, scheduled by XLA.
+
+Honest trade-off: parameters are passed replicated and each device
+reads only its own stage's (the non-taken switch branches contribute
+zero gradients, and the cross-stage psum reassembles full gradients).
+That costs parameter HBM compared to per-stage placement, in exchange
+for a single SPMD program; the reference's ``ctx_group`` executor holds
+per-device sub-graphs but runs them serially with host-driven copies.
 """
 from __future__ import annotations
 
+import numpy as np
+import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import NamedSharding
+from jax import shard_map
 
-__all__ = ["pipeline_spmd"]
+from ..base import MXNetError
+from .. import ndarray as nd
+from .. import optimizer as opt_mod
+from ..initializer import Uniform
+from .shard import P
+from .optim import make_functional
+from .trainer import _as_jnp
 
+__all__ = ["pipeline_spmd", "partition_stages", "PipelineTrainer"]
+
+
+# ---------------------------------------------------------------------------
+# legacy equal-shape helper (kept: dryrun/backward-compat surface)
 
 def pipeline_spmd(stage_fn, stage_params, x_microbatches, axis_name="pp"):
-    """Run a GPipe pipeline inside a ``shard_map`` over ``axis_name``.
+    """Run a GPipe pipeline inside a ``shard_map`` over ``axis_name``
+    with HOMOGENEOUS stages (one shared ``stage_fn``, per-stage params
+    sharded over the axis). See ``PipelineTrainer`` for the
+    heterogeneous Symbol-level form.
 
-    stage_fn(params, x) -> y        one stage's computation (shape-preserving
-                                    across stages)
-    stage_params                    THIS stage's parameter pytree (i.e. the
-                                    caller shard_maps params with stage dim
-                                    sharded over ``axis_name``)
-    x_microbatches : [M, mb, ...]   microbatched input, replicated; only
-                                    stage 0 reads it
-    returns        : [M, mb, ...]   valid on the LAST stage (zeros elsewhere);
-                                    callers typically ppermute/psum it out.
+    stage_fn(params, x) -> y        shape-preserving across stages
+    x_microbatches : [M, mb, ...]   microbatched input; stage 0 reads it
+    returns        : [M, mb, ...]   valid on the LAST stage (zeros
+                                    elsewhere); psum to broadcast.
     """
     S = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     M = x_microbatches.shape[0]
-    perm_fwd = None  # built lazily: needs concrete S
-
-    # S is a traced-constant under shard_map (mesh size is static), so
-    # Python arithmetic on it is fine only when it's concrete; shard_map
-    # gives a concrete int.
     n = int(S) if not hasattr(S, "aval") else None
     if n is None:
         raise ValueError("pipeline_spmd must run inside shard_map "
@@ -68,3 +94,385 @@ def pipeline_spmd(stage_fn, stage_params, x_microbatches, axis_name="pp"):
 
     _, out = lax.fori_loop(0, M + n - 1, body, (state0, out0))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Symbol-level stage partitioning (the reference's ctx_group cut)
+
+def partition_stages(symbol, num_stages=None):
+    """Cut a Symbol's topo order into stages from ``ctx_group`` attrs.
+
+    Node attr ``ctx_group="stageK"`` assigns the node to stage K
+    (reference: ``AttrScope(ctx_group=...)`` + ``group2ctx`` at bind).
+    Untagged op nodes inherit the max stage of their inputs; variables
+    belong to their (single-stage) consumers. Returns
+    ``(stage_nodes, boundaries, stage_of)`` where ``boundaries[s]`` is
+    the (node, idx) data entry crossing from stage s to s+1.
+    """
+    topo = symbol._topo()
+    stage_of = {}
+    for n in topo:
+        if n.is_var:
+            continue
+        tag = n.attrs.get("ctx_group")
+        if tag is not None:
+            if not tag.startswith("stage"):
+                raise MXNetError(
+                    "pipeline: ctx_group %r is not 'stage<K>'" % tag)
+            stage_of[id(n)] = int(tag[len("stage"):])
+    if not stage_of:
+        raise MXNetError(
+            "pipeline: no ctx_group='stage<K>' attrs found — tag the "
+            "symbol (e.g. models.get_transformer_lm(pipeline_stages=S), "
+            "or AttrScope(ctx_group='stage0'), the reference's "
+            "model-parallel mechanism)")
+
+    # propagate: untagged op nodes inherit max input stage
+    for n in topo:
+        if n.is_var or id(n) in stage_of:
+            continue
+        in_stages = [stage_of.get(id(inp), 0) for inp, _ in n.inputs
+                     if not inp.is_var]
+        stage_of[id(n)] = max(in_stages) if in_stages else 0
+    # variables join their consumers' stage
+    for n in topo:
+        if n.is_var:
+            continue
+        for inp, _ in n.inputs:
+            if inp.is_var:
+                s = stage_of[id(n)]
+                prev = stage_of.get(id(inp))
+                if prev is not None and prev != s:
+                    raise MXNetError(
+                        "pipeline: variable %s consumed by stages %d "
+                        "and %d" % (inp.name, prev, s))
+                stage_of[id(inp)] = s
+
+    S = max(stage_of.values()) + 1
+    if num_stages is not None and S != num_stages:
+        raise MXNetError("pipeline: symbol has %d stages, mesh wants %d"
+                         % (S, num_stages))
+    stage_nodes = [[] for _ in range(S)]
+    for n in topo:
+        stage_nodes[stage_of[id(n)]].append(n)
+
+    # boundary entries: edges from stage s to stage s+1 (chain only)
+    boundaries = [None] * (S - 1)
+    for n in topo:
+        if n.is_var:
+            continue
+        s = stage_of[id(n)]
+        for inp, idx in n.inputs:
+            ps = stage_of[id(inp)]
+            if ps == s or inp.is_var:
+                continue
+            if ps > s:
+                raise MXNetError("pipeline: backward edge stage %d -> %d"
+                                 % (ps, s))
+            if ps != s - 1:
+                raise MXNetError(
+                    "pipeline: edge skips stages (%d -> %d); ctx_group "
+                    "cuts must form a chain" % (ps, s))
+            entry = (inp, idx)
+            if boundaries[ps] is None:
+                boundaries[ps] = entry
+            elif boundaries[ps] != entry:
+                raise MXNetError(
+                    "pipeline: stage %d has multiple boundary "
+                    "activations; exactly one tensor may cross each "
+                    "cut" % ps)
+    for s, b in enumerate(boundaries):
+        if b is None:
+            raise MXNetError("pipeline: no edge from stage %d to %d"
+                             % (s, s + 1))
+    return stage_nodes, boundaries, stage_of
+
+
+class PipelineTrainer:
+    """Train a ``ctx_group``-staged Symbol with GPipe over a ``pp`` mesh.
+
+    Parameters
+    ----------
+    symbol : loss-headed Symbol with ``ctx_group='stage<K>'`` attrs
+        (stage count must equal the mesh's ``pp`` size). Input data
+        variables must be consumed by stage 0, labels by the last stage.
+    input_shapes : dict of GLOBAL input shapes, batch-first.
+    mesh : Mesh with a ``pp`` axis (only axis used).
+    num_microbatches : batch is split into M microbatches; GPipe bubble
+        is (S-1)/(M+S-1).
+    """
+
+    def __init__(self, symbol, input_shapes, mesh, num_microbatches=None,
+                 optimizer="sgd", optimizer_params=None, initializer=None,
+                 seed=0, label_name="softmax_label"):
+        if "pp" not in mesh.shape:
+            raise MXNetError("PipelineTrainer: mesh needs a 'pp' axis")
+        if symbol.list_auxiliary_states():
+            raise MXNetError("PipelineTrainer: aux states unsupported "
+                             "under the SPMD schedule")
+        if len(symbol._heads) != 1:
+            # the schedule gates the (single) loss head's input on
+            # fill/drain ticks; ungated extra heads would inject
+            # spurious gradients (loss ops ignore head cotangents)
+            raise MXNetError("PipelineTrainer: symbol must have exactly "
+                             "one (loss) head, got %d"
+                             % len(symbol._heads))
+        self.symbol = symbol
+        self.mesh = mesh
+        self.S = mesh.shape["pp"]
+        self.label_name = label_name
+        self.input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        batch = self.input_shapes["data"][0]
+        self.M = num_microbatches or self.S
+        if batch % self.M:
+            raise MXNetError("batch %d not divisible into %d microbatches"
+                             % (batch, self.M))
+        self.mb = batch // self.M
+        self.global_batch = batch
+
+        self.stage_nodes, self.boundaries, self.stage_of = \
+            partition_stages(symbol, self.S)
+
+        self.arg_names = symbol.list_arguments()
+        self.param_names = [n for n in self.arg_names
+                            if n not in self.input_shapes]
+        # shapes at MICROBATCH size (the per-tick compute unit)
+        mb_shapes = {k: (self.mb,) + tuple(v[1:])
+                     for k, v in self.input_shapes.items()}
+        arg_shapes, out_shapes, _ = symbol.infer_shape(**mb_shapes)
+        if arg_shapes is None:
+            raise MXNetError("PipelineTrainer: shape inference failed")
+        self.arg_shapes = dict(zip(self.arg_names, arg_shapes))
+        self.out_shape = tuple(out_shapes[0])
+        self._mb_shapes = mb_shapes
+
+        # boundary (uniform) activation shape — validated equal across cuts
+        self._infer_boundary_meta()
+
+        # input variables must sit at the pipe ends
+        for n in symbol._topo():
+            if not n.is_var or n.name not in self.input_shapes:
+                continue
+            s = self.stage_of.get(id(n), 0)
+            if n.name == self.label_name:
+                if s != self.S - 1:
+                    raise MXNetError("pipeline: label %r consumed by "
+                                     "stage %d, must be last stage"
+                                     % (n.name, s))
+            elif s != 0:
+                raise MXNetError("pipeline: input %r consumed by stage "
+                                 "%d, must be stage 0" % (n.name, s))
+
+        if isinstance(optimizer, str):
+            okw = dict(optimizer_params or {})
+            okw.setdefault("rescale_grad", 1.0 / batch)
+            optimizer = opt_mod.create(optimizer, **okw)
+        self.optimizer = optimizer
+        self._opt_init, self._opt_update = make_functional(optimizer)
+        self._initializer = initializer or Uniform(0.05)
+        self._rng = jax.random.PRNGKey(seed)
+        self.params = None
+        self.opt_state = None
+        self._t = 0
+        self._jit_step = None
+
+    # ------------------------------------------------------------------
+    def _infer_boundary_meta(self):
+        """Shapes of every node output at microbatch size (to fix the
+        carried boundary shape and check uniformity)."""
+        from ..ops.fusion import eval_graph
+        topo = self.symbol._topo()
+        heads = self.symbol._heads
+        arg_vals = [jax.ShapeDtypeStruct(self.arg_shapes[n], jnp.float32)
+                    for n in self.arg_names]
+
+        def run(args):
+            _, _, env = eval_graph(topo, heads, args, [], False,
+                                   jax.random.PRNGKey(0), plan=None)
+            return {k: v for k, v in env.items()}
+
+        env = jax.eval_shape(run, arg_vals)
+        shapes = set()
+        self._boundary_dtype = jnp.float32
+        for node, idx in self.boundaries:
+            meta = env[(id(node), idx)]
+            shapes.add(tuple(meta.shape))
+            self._boundary_dtype = meta.dtype
+        if len(shapes) != 1:
+            raise MXNetError(
+                "pipeline: boundary activations differ in shape (%s); "
+                "the SPMD schedule carries ONE uniform tensor between "
+                "stages — cut at equal-shape points" % (sorted(shapes),))
+        self._boundary_shape = shapes.pop()
+
+    # ------------------------------------------------------------------
+    def init_params(self, arg_params=None):
+        params = {}
+        for name in self.param_names:
+            shape = self.arg_shapes[name]
+            if arg_params and name in arg_params:
+                val = _as_jnp(arg_params[name])
+            else:
+                arr = nd.zeros(shape)
+                self._initializer(name, arr)
+                val = arr._val
+            params[name] = jax.device_put(
+                np.asarray(val), NamedSharding(self.mesh, P()))
+        with self.mesh:
+            self.opt_state = jax.jit(lambda p: {
+                k: self._opt_init(v) for k, v in p.items()})(params)
+        self.params = params
+        self._t = 0
+        return self
+
+    # ------------------------------------------------------------------
+    def _make_branch(self, s, x_mb, label_mb, params, rng, is_train):
+        """Branch fn for stage s: (state, t) -> (boundary_out, out_val).
+        Stage 0 reads microbatch t from x_mb (ignoring state); the last
+        stage reads label t-(S-1) and emits the head output."""
+        nodes = self.stage_nodes[s]
+        in_entry = None if s == 0 else self.boundaries[s - 1]
+        out_entry = None if s == self.S - 1 else self.boundaries[s]
+        heads = self.symbol._heads
+        M, S = self.M, self.S
+
+        def branch(state, t):
+            env = {}
+            if in_entry is not None:
+                env[(id(in_entry[0]), in_entry[1])] = state
+            mb_idx = jnp.clip(t - s, 0, M - 1)
+            # pipe-fill/drain ticks process garbage microbatches whose
+            # OUTPUT is masked — but loss ops inject gradients that
+            # ignore the head cotangent (the reference loss contract),
+            # so masking the output alone would let garbage ticks leak
+            # spurious gradients. Gating the loss node's INPUT by the
+            # validity flag zeroes the whole fused gradient chain on
+            # invalid ticks (flag * dz == 0).
+            tick_valid = ((t - s >= 0) & (t - s < M))
+            for i, n in enumerate(nodes):
+                if n.is_var:
+                    if n.name in params:
+                        env[(id(n), 0)] = params[n.name]
+                    else:
+                        # x_mb: dict of ALL non-label inputs keyed by
+                        # name (a second data input gets its own array,
+                        # never the tokens); label rides separately
+                        src = label_mb if n.name == self.label_name \
+                            else x_mb[n.name]
+                        env[(id(n), 0)] = lax.dynamic_index_in_dim(
+                            src, mb_idx, keepdims=False)
+                    continue
+                ins = [env[(id(inp), idx)] for inp, idx in n.inputs]
+                if s == S - 1 and n is heads[0][0]:
+                    ins[0] = ins[0] * tick_valid.astype(ins[0].dtype)
+                node_rng = jax.random.fold_in(
+                    jax.random.fold_in(rng, t), i + s * 10000)
+                outs, _ = n.spec.forward(n.params, ins, [], is_train,
+                                         node_rng)
+                for j, o in enumerate(outs):
+                    env[(id(n), j)] = o
+            if s == S - 1:
+                out_val = env[(id(heads[0][0]), heads[0][1])]
+                boundary = jnp.zeros(self._boundary_shape,
+                                     self._boundary_dtype)
+            else:
+                out_val = jnp.zeros(self.out_shape, jnp.float32)
+                boundary = env[(id(out_entry[0]), out_entry[1])]
+            return boundary.astype(self._boundary_dtype), out_val
+
+        return branch
+
+    def _build_step(self):
+        S, M = self.S, self.M
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        param_specs = {n: P() for n in self.param_names}
+        data_names = [k for k in self.input_shapes
+                      if k != self.label_name]
+
+        def local_step(params, opt_state, data_mb, label_mb, lr, t_opt,
+                       rng):
+            idx = lax.axis_index("pp")
+
+            def fwd(p):
+                branches = [self._make_branch(s, data_mb, label_mb, p,
+                                              rng, True)
+                            for s in range(S)]
+                state0 = jnp.zeros(self._boundary_shape,
+                                   self._boundary_dtype)
+                out0 = jnp.zeros((M,) + self.out_shape, jnp.float32)
+
+                def body(carry, t):
+                    state, out = carry
+                    y, out_val = lax.switch(idx, branches, state, t)
+                    w = t - (S - 1)
+                    valid = (idx == S - 1) & (w >= 0) & (w < M)
+                    written = lax.dynamic_update_index_in_dim(
+                        out, out_val, jnp.clip(w, 0, M - 1), 0)
+                    out = jnp.where(valid, written, out)
+                    state = lax.ppermute(y, "pp", perm)
+                    return (state, out), None
+
+                # scan (not fori_loop): statically unrollable schedule
+                # that reverse-differentiates — the vjp drains the pipe
+                # backwards, the wave 1F1B schedules by hand
+                (_, out), _ = lax.scan(body, (state0, out0),
+                                       jnp.arange(M + S - 1))
+                # only the last stage wrote `out`; broadcast to all
+                return lax.psum(out, "pp")
+
+            out, vjp_fn = jax.vjp(fwd, params)
+            (grads,) = vjp_fn(jnp.ones_like(out))
+            new_params, new_state = {}, {}
+            for name in self.param_names:
+                # each param's gradient lives on its stage's device;
+                # psum reassembles (other stages contribute zeros from
+                # the non-taken switch branches)
+                g = lax.psum(grads[name], "pp")
+                w, st = self._opt_update(params[name], g,
+                                         opt_state[name], lr, t_opt, rng)
+                new_params[name] = w
+                new_state[name] = st
+            return new_params, new_state, out
+
+        mapped = shard_map(
+            local_step, mesh=self.mesh,
+            in_specs=(param_specs, param_specs,
+                      {k: P() for k in data_names}, P(), P(), P(), P()),
+            out_specs=(param_specs, param_specs, P()),
+            check_vma=False)
+
+        def step(params, opt_state, data_dict, label, lr, t):
+            t = t + 1  # 1-based update count (Adam bias correction)
+            rng = jax.random.fold_in(self._rng, t)
+            data_mb = {k: v.reshape((self.M, self.mb) + v.shape[1:])
+                       for k, v in data_dict.items()}
+            label_mb = label.reshape((self.M, self.mb) + label.shape[1:])
+            return mapped(params, opt_state, data_mb, label_mb, lr, t,
+                          rng)
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+    def step(self, batch):
+        """One pipelined train step on a GLOBAL batch dict. Returns the
+        head output [B, ...] (microbatches re-flattened)."""
+        if self.params is None:
+            self.init_params()
+        if self._jit_step is None:
+            self._jit_step = self._build_step()
+        data_dict = {k: _as_jnp(batch[k]) for k in self.input_shapes
+                     if k != self.label_name}
+        label = _as_jnp(batch[self.label_name])
+        if self.optimizer.lr_scheduler is not None:
+            lr = self.optimizer.lr_scheduler(self._t + 1)
+        else:
+            lr = self.optimizer.lr
+        self.params, self.opt_state, out = self._jit_step(
+            self.params, self.opt_state, data_dict, label,
+            np.float32(lr), np.int32(self._t))
+        self._t += 1
+        return out.reshape((self.global_batch,) + tuple(out.shape[2:]))
+
+    def get_params(self):
+        return {n: nd.array(np.asarray(jax.device_get(v)))
+                for n, v in self.params.items()}

