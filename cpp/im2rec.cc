@@ -1,0 +1,121 @@
+// im2rec: pack an image list into a RecordIO file — the production
+// packer (reference /root/reference/tools/im2rec.cc:1, OpenCV +
+// dmlc::RecordIOWriter; the Python tools/im2rec.py remains the scripting
+// surface, this is its native equivalent for dataset-scale packing).
+//
+// Usage: im2rec <listfile> <imgroot> <out.rec> [quality=85] [resize=0]
+//        [color=1]
+//
+// List format (reference make_list.py): index\tlabel\trelative_path
+// Record payload (bit-compatible with python/mxnet/recordio.py pack_img):
+//   [flag:u32][label:f32][id:u64][id2:u64][jpeg bytes]
+//
+// Build: make -C cpp im2rec
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include <opencv2/imgcodecs.hpp>
+#include <opencv2/imgproc.hpp>
+
+#include "recordio.h"
+
+namespace {
+
+#pragma pack(push, 1)
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s <listfile> <imgroot> <out.rec> [quality=85] "
+                 "[resize=0] [color=1]\n",
+                 argv[0]);
+    return 2;
+  }
+  const std::string listfile = argv[1], root = argv[2], out = argv[3];
+  const int quality = argc > 4 ? std::atoi(argv[4]) : 85;
+  const int resize = argc > 5 ? std::atoi(argv[5]) : 0;
+  const int color = argc > 6 ? std::atoi(argv[6]) : 1;
+
+  std::ifstream lf(listfile);
+  if (!lf) {
+    std::fprintf(stderr, "cannot open list %s\n", listfile.c_str());
+    return 1;
+  }
+  mxtpu::RecordIOWriter writer(out);
+  if (!writer.is_open()) {
+    std::fprintf(stderr, "cannot open output %s\n", out.c_str());
+    return 1;
+  }
+
+  std::vector<int> jpeg_params = {cv::IMWRITE_JPEG_QUALITY, quality};
+  std::string line;
+  size_t n_ok = 0, n_bad = 0;
+  while (std::getline(lf, line)) {
+    if (line.empty()) continue;
+    std::istringstream ss(line);
+    uint64_t index;
+    float label;
+    std::string rel;
+    if (!(ss >> index >> label)) {
+      std::fprintf(stderr, "bad list line: %s\n", line.c_str());
+      ++n_bad;
+      continue;
+    }
+    std::getline(ss, rel);
+    // strip leading whitespace/tab from the remainder-of-line path
+    size_t start = rel.find_first_not_of(" \t");
+    if (start == std::string::npos) {
+      ++n_bad;
+      continue;
+    }
+    rel = rel.substr(start);
+    std::string path = root.empty() ? rel : root + "/" + rel;
+    cv::Mat img = cv::imread(
+        path, color ? cv::IMREAD_COLOR : cv::IMREAD_GRAYSCALE);
+    if (img.empty()) {
+      std::fprintf(stderr, "skip unreadable image %s\n", path.c_str());
+      ++n_bad;
+      continue;
+    }
+    if (resize > 0) {
+      // resize the SHORTER edge to `resize`, like the reference packer
+      double s = static_cast<double>(resize) /
+                 std::min(img.rows, img.cols);
+      cv::resize(img, img, cv::Size(), s, s,
+                 s < 1.0 ? cv::INTER_AREA : cv::INTER_LINEAR);
+    }
+    std::vector<unsigned char> jpg;
+    if (!cv::imencode(".jpg", img, jpg, jpeg_params)) {
+      std::fprintf(stderr, "encode failed for %s\n", path.c_str());
+      ++n_bad;
+      continue;
+    }
+    IRHeader hdr;
+    hdr.flag = 0;
+    hdr.label = label;
+    hdr.id = index;
+    hdr.id2 = 0;
+    std::string payload(sizeof(hdr) + jpg.size(), '\0');
+    std::memcpy(&payload[0], &hdr, sizeof(hdr));
+    std::memcpy(&payload[sizeof(hdr)], jpg.data(), jpg.size());
+    writer.WriteRecord(payload.data(), payload.size());
+    ++n_ok;
+  }
+  std::fprintf(stderr, "packed %zu records (%zu skipped) -> %s\n", n_ok,
+               n_bad, out.c_str());
+  return n_ok > 0 ? 0 : 1;
+}

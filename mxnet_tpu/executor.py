@@ -135,6 +135,8 @@ class Executor:
         self._topo = symbol._topo()
         from .ops.fusion import FusionPlan
         self._fusion_plan = FusionPlan(self._topo, symbol._heads)
+        self._jit_monitor = {}
+        self._monitor_names = {}
         self._base_key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
         self._step = 0
 
@@ -337,18 +339,49 @@ class Executor:
         self._monitor_should_run = should_run
 
     def _run_monitor(self, arg_vals, aux_vals, is_train, rng):
-        # fuse=False: the monitor inspects EVERY node's output, so fused
-        # chains must run as their individual ops here
-        _, _, env = self._eval_graph(list(arg_vals), list(aux_vals),
-                                     is_train, rng, fuse=False)
-        for n in self._topo:
-            if n.is_var:
-                continue
-            for j, out_name in enumerate(n.output_names()):
-                val = env.get((id(n), j))
-                if val is not None:
-                    self._monitor_callback(out_name,
-                                           nd.array(np.asarray(val)))
+        # ONE compiled debug program returning every node output —
+        # cheaper than eager per-op dispatch, though still an extra
+        # evaluation per monitored batch (the reference piggybacks on the
+        # running executor, graph_executor.cc:803-817; here the fast path
+        # is one fused XLA program whose internals aren't addressable, so
+        # the debug program is the price of inspection). fuse=False so
+        # fused chains report their individual ops' outputs.
+        key = bool(is_train)
+        if key not in self._jit_monitor:
+            def run(av, xv, r, _train=key):
+                _, _, env = self._eval_graph(list(av), list(xv), _train,
+                                             r, fuse=False)
+                names, vals = [], []
+                for n in self._topo:
+                    if n.is_var:
+                        continue
+                    for j, out_name in enumerate(n.output_names()):
+                        v = env.get((id(n), j))
+                        if v is not None:
+                            names.append(out_name)
+                            vals.append(v)
+                self._monitor_names[_train] = names  # host capture @trace
+                return tuple(vals)
+            self._jit_monitor[key] = jax.jit(run)
+        vals = self._jit_monitor[key](tuple(arg_vals), tuple(aux_vals),
+                                      rng)
+        for name, val in zip(self._monitor_names[key], vals):
+            self._monitor_callback(name, nd.array(np.asarray(val)))
+
+    def _compiled_infer(self):
+        """The AOT-compiled infer program, cached — debug_str and
+        profiler.compiled_stats both read XLA analyses from it without
+        paying a recompile per call."""
+        cached = getattr(self, "_compiled_infer_cache", None)
+        if cached is None:
+            arg_vals = [a._val for a in self.arg_arrays]
+            aux_vals = [a._val for a in self.aux_arrays]
+            if self._jit_infer is None:
+                self._jit_infer = self._build_infer()
+            cached = self._jit_infer.lower(
+                arg_vals, aux_vals, jax.random.PRNGKey(0)).compile()
+            self._compiled_infer_cache = cached
+        return cached
 
     def debug_str(self):
         """Execution-plan dump: the graph plus the compiled program's
@@ -365,13 +398,7 @@ class Executor:
             m = None
         try:
             if m is None:
-                arg_vals = [a._val for a in self.arg_arrays]
-                aux_vals = [a._val for a in self.aux_arrays]
-                if self._jit_infer is None:
-                    self._jit_infer = self._build_infer()
-                compiled = self._jit_infer.lower(
-                    arg_vals, aux_vals, jax.random.PRNGKey(0)).compile()
-                m = compiled.memory_analysis()
+                m = self._compiled_infer().memory_analysis()
                 self._plan_memory = m  # compile once; plan is static
             if m is not None:
                 mb = 2.0 ** 20

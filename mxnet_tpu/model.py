@@ -377,18 +377,25 @@ def _run_callbacks(callbacks, params):
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     """Save prefix-symbol.json + prefix-%04d.params (reference :311).
 
-    The .params file is written via tmp + os.replace so a writer dying
-    mid-write (e.g. do_checkpoint(async_write=True)'s daemon thread at
-    interpreter exit) never leaves a truncated file that looks complete.
+    Local .params files are written via tmp + os.replace so a writer
+    dying mid-write (e.g. do_checkpoint(async_write=True)'s daemon thread
+    at interpreter exit) never leaves a truncated file that looks
+    complete. URI prefixes (s3://, hdfs://; the dmlc::Stream surface)
+    write directly — object stores publish atomically on close and
+    os.replace cannot rename a URI.
     """
     import os
+    from .stream import is_uri
     symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    tmp_name = param_name + ".tmp"
-    nd.save(tmp_name, save_dict)
-    os.replace(tmp_name, param_name)
+    if is_uri(prefix):
+        nd.save(param_name, save_dict)
+    else:
+        tmp_name = param_name + ".tmp"
+        nd.save(tmp_name, save_dict)
+        os.replace(tmp_name, param_name)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 

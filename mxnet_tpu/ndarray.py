@@ -619,7 +619,8 @@ def save(fname, data):
         arrays = list(data)
     if any(not isinstance(a, (NDArray, np.ndarray)) for a in arrays):
         raise MXNetError("save only accepts NDArrays or numpy arrays")
-    with open(fname, "wb") as fo:
+    from .stream import open_stream  # URI dispatch (dmlc::Stream)
+    with open_stream(fname, "wb") as fo:
         fo.write(struct.pack("<QQ", _LIST_MAGIC, 0))
         fo.write(struct.pack("<Q", len(arrays)))
         for arr in arrays:
@@ -648,8 +649,11 @@ def _load_stream(fi):
 
 
 def load(fname):
-    """Load a list or dict saved by :func:`save` (or the reference)."""
-    with open(fname, "rb") as fi:
+    """Load a list or dict saved by :func:`save` (or the reference).
+    ``fname`` may be a URI (``s3://``, ``hdfs://``, ``file://``) — the
+    reference's dmlc::Stream checkpoint surface."""
+    from .stream import open_stream
+    with open_stream(fname, "rb") as fi:
         return _load_stream(fi)
 
 

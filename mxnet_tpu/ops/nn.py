@@ -33,6 +33,25 @@ from .registry import OpSpec, Param, register, shape_assign, same_shape_infer
 _DIMNUMS = ("NCHW", "OIHW", "NCHW")
 
 
+def _use_nhwc():
+    """Run convs/pools internally in NHWC (API stays NCHW).
+
+    Measured on the v5e chip: a SINGLE-op jit pays ~38x for NCHW (host
+    interface pins the layout; the MXU wants channels minor), while
+    inside a whole-model program XLA's layout assignment mostly fixes it
+    — explicit NHWC still measures ~3% faster end-to-end on ResNet-50
+    (2,354 vs 2,289 img/s) and guarantees the good layout for imperative
+    /small-jit use. ``MXNET_CONV_NHWC=0/1`` overrides; default on TPU.
+    """
+    import os
+    flag = os.environ.get("MXNET_CONV_NHWC")
+    if flag == "0":
+        return False
+    if flag == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
 def _conv_out(h, k, s, p, d):
     eff = d * (k - 1) + 1
     return (h + 2 * p - eff) // s + 1
@@ -133,6 +152,20 @@ class Convolution(OpSpec):
 
     def forward(self, p, ins, aux, is_train, rng):
         ph, pw = p["pad"]
+        if _use_nhwc():
+            x = jnp.transpose(ins[0], (0, 2, 3, 1))
+            w = jnp.transpose(ins[1], (2, 3, 1, 0))  # OIHW -> HWIO
+            out = lax.conv_general_dilated(
+                x, w,
+                window_strides=p["stride"],
+                padding=((ph, ph), (pw, pw)),
+                rhs_dilation=p["dilate"],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=p["num_group"],
+            )
+            if not p["no_bias"]:
+                out = out + ins[2]
+            return [jnp.transpose(out, (0, 3, 1, 2))], []
         out = lax.conv_general_dilated(
             ins[0], ins[1],
             window_strides=p["stride"],
@@ -196,10 +229,23 @@ class Deconvolution(OpSpec):
             w = w.reshape(g, cin // g, nf_per_g, kh, kw) \
                  .transpose(1, 0, 2, 3, 4) \
                  .reshape(cin // g, g * nf_per_g, kh, kw)
+        pad2 = ((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw))
+        if _use_nhwc():
+            x = jnp.transpose(ins[0], (0, 2, 3, 1))
+            w = jnp.transpose(w, (2, 3, 0, 1))  # IOHW -> HWIO
+            out = lax.conv_general_dilated(
+                x, w, window_strides=(1, 1), padding=pad2,
+                lhs_dilation=(sh, sw),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=g,
+            )
+            if not p["no_bias"]:
+                out = out + ins[2]
+            return [jnp.transpose(out, (0, 3, 1, 2))], []
         out = lax.conv_general_dilated(
             ins[0], w,
             window_strides=(1, 1),
-            padding=((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)),
+            padding=pad2,
             lhs_dilation=(sh, sw),
             dimension_numbers=("NCHW", "IOHW", "NCHW"),
             feature_group_count=g,
@@ -382,9 +428,16 @@ class Pooling(OpSpec):
         # right/bottom padding extended so ceil-mode windows fit
         eh = max((oh - 1) * sh + kh - x.shape[2] - ph, ph)
         ew = max((ow - 1) * sw + kw - x.shape[3] - pw, pw)
-        dims = (1, 1, kh, kw)
-        strides = (1, 1, sh, sw)
-        pads = ((0, 0), (0, 0), (ph, eh), (pw, ew))
+        nhwc = _use_nhwc()
+        if nhwc:  # channels-minor windows (see _use_nhwc)
+            x = jnp.transpose(x, (0, 2, 3, 1))
+            dims = (1, kh, kw, 1)
+            strides = (1, sh, sw, 1)
+            pads = ((0, 0), (ph, eh), (pw, ew), (0, 0))
+        else:
+            dims = (1, 1, kh, kw)
+            strides = (1, 1, sh, sw)
+            pads = ((0, 0), (0, 0), (ph, eh), (pw, ew))
         # NB: init values must be concrete (np) scalars — a traced jnp scalar
         # stops JAX pattern-matching the monoid, losing the autodiff rule.
         if p["pool_type"] == "max":
@@ -399,6 +452,8 @@ class Pooling(OpSpec):
                 out = out / (kh * kw)
         else:
             raise MXNetError("Pooling: unknown pool_type " + p["pool_type"])
+        if nhwc:
+            out = jnp.transpose(out, (0, 3, 1, 2))
         return [out], []
 
 

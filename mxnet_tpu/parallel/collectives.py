@@ -39,3 +39,83 @@ def reduce_scatter(x, axis_name, *, scatter_dimension=0, tiled=True):
     """Sum across ``axis_name`` then scatter slices of ``scatter_dimension``."""
     return lax.psum_scatter(x, axis_name,
                             scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+def broadcast(x, axis_name, root=0):
+    """Value from shard ``root`` to every shard on ``axis_name``
+    (reference: kvstore Pull's CopyFromTo fan-out; here one in-program
+    collective: zero every non-root contribution, then sum)."""
+    idx = lax.axis_index(axis_name)
+    contrib = jax.numpy.where(idx == root, x, jax.numpy.zeros_like(x))
+    return lax.psum(contrib, axis_name)
+
+
+def barrier(axis_name):
+    """In-program barrier token: a zero-sum all devices must reach.
+    Returns the (zero) token; thread it into downstream computation to
+    order effects (reference Postoffice::Barrier is host-side; in-program
+    ordering is data dependence)."""
+    return lax.psum(jax.numpy.zeros((), jax.numpy.float32), axis_name)
+
+
+def ring_exchange(x, axis_name, shift=1):
+    """Rotate shards around the axis ring by ``shift`` hops (the
+    ring-attention / pipeline primitive; lowers to collective-permute on
+    neighbouring ICI links)."""
+    n = axis_size(axis_name)
+    n = int(n) if not hasattr(n, "aval") else n
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def bucketed_psum(grads, axis_name, bucket_bytes=4 * 1024 * 1024):
+    """All-reduce a dict/list of gradient arrays in size-bucketed fused
+    collectives.
+
+    The reference chunks big arrays for its CPU reduction
+    (`MXNET_KVSTORE_BIGARRAY_BOUND`, kvstore_local.h:180-235) and ships
+    each key separately over ps-lite; fusing MANY SMALL gradients into
+    few large all-reduces is the inverse optimization (collective launch
+    overhead dominates for small buffers — the NCCL-bucketing insight).
+    XLA's combiner does this for naked psums inside one program too;
+    this helper makes the bucketing explicit and available to custom
+    training loops and shard_map regions.
+
+    Exact-value semantics: result equals per-leaf ``psum``.
+    """
+    import numpy as np
+    items = list(grads.items()) if isinstance(grads, dict) else \
+        list(enumerate(grads))
+    buckets, cur, cur_bytes = [], [], 0
+    for key, g in items:
+        nbytes = int(np.prod(g.shape)) * g.dtype.itemsize if g.ndim else \
+            g.dtype.itemsize
+        if cur and cur_bytes + nbytes > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append((key, g))
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    out = {}
+    for bucket in buckets:
+        if len(bucket) == 1:
+            key, g = bucket[0]
+            out[key] = lax.psum(g, axis_name)
+            continue
+        flats = [g.reshape(-1) for _, g in bucket]
+        # common dtype per bucket: upcast to the widest member
+        dt = jax.numpy.result_type(*[f.dtype for f in flats])
+        fused = jax.numpy.concatenate([f.astype(dt) for f in flats])
+        red = lax.psum(fused, axis_name)
+        off = 0
+        for (key, g), f in zip(bucket, flats):
+            n = f.shape[0]
+            out[key] = red[off:off + n].astype(g.dtype).reshape(g.shape)
+            off += n
+    if isinstance(grads, dict):
+        return out
+    return [out[i] for i in range(len(items))]
+
+
+__all__ += ["broadcast", "barrier", "ring_exchange", "bucketed_psum"]

@@ -56,3 +56,80 @@ def scope(name: str):
 def device_memory_profile() -> bytes:
     """Snapshot of current device memory (pprof format)."""
     return jax.profiler.device_memory_profile()
+
+
+# ---------------------------------------------------------------------------
+# step statistics (Speedometer-adjacent, but library-level: the reference
+# logs samples/sec from a callback; this accumulates step wall-times so
+# perf regressions are visible without TensorBoard — important on relay
+# environments where trace capture is awkward)
+
+import time as _time
+
+_steps = {"times": []}
+
+
+@contextlib.contextmanager
+def record_step():
+    """Time one training step:  ``with mx.profiler.record_step(): step()``.
+    Includes device wait only if the caller blocks (as FeedForward's
+    metric update does); pair with get_step_stats()."""
+    tic = _time.perf_counter()
+    try:
+        yield
+    finally:
+        _steps["times"].append(_time.perf_counter() - tic)
+
+
+def reset_step_stats():
+    _steps["times"] = []
+
+
+def get_step_stats():
+    """dict(count, mean_ms, p50_ms, p99_ms, total_s) over recorded steps."""
+    ts = sorted(_steps["times"])
+    if not ts:
+        return {"count": 0, "mean_ms": 0.0, "p50_ms": 0.0, "p99_ms": 0.0,
+                "total_s": 0.0}
+    n = len(ts)
+    return {
+        "count": n,
+        "mean_ms": 1e3 * sum(ts) / n,
+        "p50_ms": 1e3 * ts[n // 2],
+        "p99_ms": 1e3 * ts[min(n - 1, (99 * n) // 100)],
+        "total_s": sum(ts),
+    }
+
+
+# ---------------------------------------------------------------------------
+# compiled-program analysis (the reference's example/memcost tool reports
+# the memory planner's totals; XLA's equivalents are memory_analysis and
+# cost_analysis on the compiled executable)
+
+def compiled_stats(compiled):
+    """FLOPs/bytes/memory for a compiled jax function (the object
+    returned by ``jax.jit(f).lower(...).compile()``) or for an Executor
+    (uses its infer program). Returns a dict with whatever the backend
+    reports: flops, bytes_accessed, argument/output/temp sizes."""
+    if hasattr(compiled, "_compiled_infer"):  # Executor duck-type
+        compiled = compiled._compiled_infer()  # cached; no recompile
+    out = {}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        for k in ("flops", "bytes accessed"):
+            if k in cost:
+                out[k.replace(" ", "_")] = float(cost[k])
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "generated_code_size_in_bytes"):
+            v = getattr(ma, k, None)
+            if v is not None:
+                out[k] = int(v)
+    except Exception:
+        pass
+    return out

@@ -403,7 +403,8 @@ class Symbol:
         }, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
+        from .stream import open_stream  # URI dispatch (dmlc::Stream)
+        with open_stream(fname, "w") as f:
             f.write(self.tojson())
 
     def debug_str(self):
@@ -538,8 +539,12 @@ def load_json(json_str):
 
 
 def load(fname):
-    with open(fname) as f:
-        return load_json(f.read())
+    from .stream import open_stream
+    with open_stream(fname, "r") as f:
+        data = f.read()
+        if isinstance(data, bytes):
+            data = data.decode("utf-8")
+        return load_json(data)
 
 
 def _sym_or_scalar_binop(sym_op, scalar_op, name):

@@ -170,3 +170,79 @@ def test_bucketing_executor_groups_share_params():
     mgr.forward(is_train=False)
     p = mgr.curr_execgrp.train_execs[0].outputs[0].asnumpy()
     np.testing.assert_allclose(p, 1.0 / classes, atol=1e-5)
+
+
+def test_bucketing_compile_cache_policy():
+    """The compile-cache policy (reference GraphStoragePool sharing,
+    graph_executor.h:48-55 → SURVEY §7 'compilation cache keyed by
+    bucket shapes'): one executor (= one compiled program set) per
+    bucket key, created on FIRST sight and REUSED on every revisit — no
+    executor rebuild, no recompile, for the whole training run."""
+    import logging
+    from mxnet_tpu.executor_manager import DataParallelExecutorManager
+
+    vocab, classes, batch_size = 8, 4, 2
+
+    def sym_gen(seq_len):
+        data = mx.symbol.Variable("data")
+        emb = mx.symbol.Embedding(data=data, name="embed",
+                                  input_dim=vocab, output_dim=4)
+        sl = mx.symbol.SliceChannel(emb, num_outputs=seq_len, axis=1,
+                                    squeeze_axis=True, name="slice")
+        total = mx.symbol.ElementWiseSum(*[sl[i] for i in range(seq_len)],
+                                         name="sum")
+        fc = mx.symbol.FullyConnected(data=total, name="fc",
+                                      num_hidden=classes)
+        return mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+
+    class _Batch:
+        def __init__(self, key, seed):
+            rng = np.random.RandomState(seed)
+            self.bucket_key = key
+            self.data = [mx.nd.array(
+                rng.randint(0, vocab, (batch_size, key)
+                            ).astype(np.float32))]
+            self.label = [mx.nd.array(
+                rng.randint(0, classes, (batch_size,)
+                            ).astype(np.float32))]
+            self.pad = 0
+            self.provide_data = [("data", (batch_size, key))]
+            self.provide_label = [("softmax_label", (batch_size,))]
+
+    class _Iter:
+        batch_size = 2
+        default_bucket_key = 2
+        provide_data = [("data", (2, 2))]
+        provide_label = [("softmax_label", (2,))]
+
+    sym = sym_gen(2)
+    arg_names = sym.list_arguments()
+    param_names = [n for n in arg_names
+                   if n not in ("data", "softmax_label")]
+    mgr = DataParallelExecutorManager(
+        sym, [mx.cpu()], _Iter(), arg_names, param_names,
+        sym.list_auxiliary_states(), logger=logging, sym_gen=sym_gen)
+    rng = np.random.RandomState(0)
+    shapes = dict(zip(arg_names, sym.infer_shape(data=(2, 2))[0]))
+    mgr.set_params({n: mx.nd.array(rng.uniform(-0.5, 0.5,
+                                               shapes[n]).astype("f"))
+                    for n in param_names}, {})
+
+    # first pass creates one executor per key; record identities and
+    # the compiled-function objects
+    execs, jits = {}, {}
+    for key in (2, 4, 2, 4, 2):
+        b = _Batch(key, seed=key)
+        mgr.load_data_batch(b)
+        mgr.forward(is_train=True)
+        mgr.backward()
+        exe = mgr.curr_execgrp.train_execs[0]
+        if key in execs:
+            assert exe is execs[key], "bucket %d executor rebuilt" % key
+            assert exe._jit_train is jits[key], \
+                "bucket %d recompiled" % key
+        else:
+            execs[key] = exe
+            assert exe._jit_train is not None
+            jits[key] = exe._jit_train
+    assert len(mgr.execgrp_bucket) == 2

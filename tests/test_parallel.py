@@ -737,3 +737,34 @@ def test_pipeline_unequal_stages():
     for n in want:
         np.testing.assert_allclose(got[n].asnumpy(), want[n].asnumpy(),
                                    rtol=2e-4, atol=2e-5, err_msg=n)
+
+
+def test_collectives_broadcast_ring_bucketed():
+    """broadcast/ring_exchange/bucketed_psum exact values on the CPU
+    mesh (bucketed_psum must equal per-leaf psum regardless of bucket
+    packing)."""
+    from mxnet_tpu.parallel import collectives as coll
+    from jax.sharding import PartitionSpec
+
+    mesh = par.build_mesh({"dp": 8})
+    x = np.arange(8, dtype=np.float32)
+
+    def f(xs):
+        r = coll.axis_index("dp").astype(np.float32)
+        b = coll.broadcast(r * 10.0, "dp", root=3)
+        ring = coll.ring_exchange(xs, "dp", shift=1)
+        grads = {"a": xs * 2.0, "b": jnp.ones((3,)) * r,
+                 "c": xs.reshape(1, 1) + r}
+        red = coll.bucketed_psum(grads, "dp", bucket_bytes=8)
+        ref = {k: coll.psum(v, "dp") for k, v in grads.items()}
+        diff = sum(jnp.abs(red[k] - ref[k]).sum() for k in grads)
+        return b, ring, diff
+
+    b, ring, diff = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=PartitionSpec("dp"),
+        out_specs=(PartitionSpec(), PartitionSpec("dp"),
+                   PartitionSpec())))(x)
+    np.testing.assert_allclose(np.asarray(b), 30.0)  # root 3's value
+    np.testing.assert_allclose(np.asarray(ring),
+                               np.roll(np.arange(8, dtype=np.float32), 1))
+    np.testing.assert_allclose(np.asarray(diff), 0.0)

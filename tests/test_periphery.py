@@ -158,3 +158,32 @@ def test_reference_api_shims():
     m.update(None, [mx.nd.array(np.full((2, 2), 3.0, np.float32))])
     assert m.get()[1] == 3.0
     assert issubclass(mx.rtc.Rtc, mx.rtc.PallasOp)
+
+
+def test_profiler_step_stats():
+    """Step-time accumulation: count/mean/percentiles."""
+    mx.profiler.reset_step_stats()
+    for _ in range(5):
+        with mx.profiler.record_step():
+            pass
+    st = mx.profiler.get_step_stats()
+    assert st["count"] == 5 and st["total_s"] >= 0
+    mx.profiler.reset_step_stats()
+    assert mx.profiler.get_step_stats()["count"] == 0
+
+
+def test_profiler_compiled_stats_executor():
+    """compiled_stats reports XLA memory/cost analysis for an Executor
+    (the example/memcost capability: the reference dumps its memory
+    planner's totals, graph_executor.cc:852-853)."""
+    data = mx.symbol.Variable("data")
+    fc = mx.symbol.FullyConnected(data=data, name="fc", num_hidden=16)
+    net = mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+    shapes = {"data": (8, 32), "softmax_label": (8,)}
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    args = {n: mx.nd.zeros(s)
+            for n, s in zip(net.list_arguments(), arg_shapes)}
+    exe = net.bind(mx.cpu(), args)
+    stats = mx.profiler.compiled_stats(exe)
+    assert stats, "no stats reported"
+    assert any(k.endswith("_in_bytes") or k == "flops" for k in stats)
