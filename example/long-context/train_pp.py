@@ -1,0 +1,98 @@
+"""Pipeline-parallel transformer training (GPipe over ctx_group stages).
+
+The other half of the model-scale story next to train_lm.py's sequence
+parallelism: when the MODEL no longer fits one chip, cut it into stages
+with the reference's ``ctx_group`` attribute
+(``get_transformer_lm(pipeline_stages=S)``) and stream microbatches
+through the SPMD GPipe schedule (``parallel.PipelineTrainer``). Compose
+with data parallelism by giving the mesh a ``dp`` axis.
+
+Run on the virtual CPU mesh:
+
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python train_pp.py --dp 2 --pp 2
+
+or on a real TPU slice with the plain command.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx  # noqa: F401  (registers ops)
+from mxnet_tpu import parallel as par
+from mxnet_tpu.models import get_transformer_lm
+
+
+def markov_batches(vocab, batch, seq_len, n_batches, seed=0):
+    """Order-1 Markov token streams — learnable structure for the LM."""
+    rng = np.random.RandomState(seed)
+    trans = rng.dirichlet(np.ones(vocab) * 0.1, size=vocab)
+    for _ in range(n_batches):
+        toks = np.zeros((batch, seq_len + 1), np.int64)
+        toks[:, 0] = rng.randint(0, vocab, batch)
+        for t in range(seq_len):
+            p = trans[toks[:, t]]
+            toks[:, t + 1] = [rng.choice(vocab, p=pi) for pi in p]
+        yield {"data": toks[:, :-1].astype(np.float32),
+               "softmax_label": toks[:, 1:].astype(np.float32)}
+
+
+def nll_per_token(out, label, vocab):
+    picked = np.take_along_axis(np.asarray(out),
+                                label[:, None, :].astype(int), 1)[:, 0, :]
+    return float(-np.log(picked + 1e-8).mean())
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--dp', type=int, default=2)
+    parser.add_argument('--pp', type=int, default=2)
+    parser.add_argument('--microbatches', type=int, default=4)
+    parser.add_argument('--seq-len', type=int, default=64)
+    parser.add_argument('--batch-size', type=int, default=8)
+    parser.add_argument('--vocab', type=int, default=32)
+    parser.add_argument('--embed', type=int, default=32)
+    parser.add_argument('--layers', type=int, default=4)
+    parser.add_argument('--heads', type=int, default=4)
+    parser.add_argument('--steps', type=int, default=25)
+    parser.add_argument('--lr', type=float, default=0.3)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    sym = get_transformer_lm(args.vocab, num_layers=args.layers,
+                             embed_dim=args.embed, num_heads=args.heads,
+                             impl="dense", pipeline_stages=args.pp)
+    axes = {"pp": args.pp} if args.dp == 1 else \
+        {"dp": args.dp, "pp": args.pp}
+    mesh = par.build_mesh(axes)
+    trainer = par.PipelineTrainer(
+        sym, {"data": (args.batch_size, args.seq_len),
+              "softmax_label": (args.batch_size, args.seq_len)},
+        mesh, num_microbatches=args.microbatches, optimizer="sgd",
+        optimizer_params={
+            "learning_rate": args.lr, "momentum": 0.9,
+            # multi_output LM loss sums over batch AND positions:
+            # normalize per token, like SequenceParallelTrainer's default
+            "rescale_grad": 1.0 / (args.batch_size * args.seq_len)})
+    trainer.init_params()
+
+    losses = []
+    for i, batch in enumerate(markov_batches(
+            args.vocab, args.batch_size, args.seq_len, args.steps)):
+        out = trainer.step(batch)
+        nll = nll_per_token(out, batch["softmax_label"], args.vocab)
+        losses.append(nll)
+        if i % 5 == 0:
+            logging.info("step %d  nll/token %.4f  (uniform %.4f, "
+                         "bubble %.0f%%)", i, nll, np.log(args.vocab),
+                         100.0 * (args.pp - 1)
+                         / (args.microbatches + args.pp - 1))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    logging.info("final nll/token %.4f < initial %.4f — learning through "
+                 "the pipe", losses[-1], losses[0])
+
+
+if __name__ == '__main__':
+    main()

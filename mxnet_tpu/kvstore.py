@@ -95,11 +95,33 @@ class KVStore:
 
     # ------------------------------------------------------------------
     def init(self, key, value):
-        """Initialize key(s) once (reference kvstore.py init)."""
+        """Initialize key(s) once (reference kvstore.py init).
+
+        dist semantics: the reference's server holds ONE copy of every
+        key (first init wins), so after init all workers PULL the same
+        value even though each passed its own (differently-seeded)
+        initial weights. Here the sync store is per-process, so init
+        broadcasts rank 0's value — without this, workers start from
+        different params and BSP updates preserve the skew forever.
+        """
         key, vals = _ctype_key_value(key, value)
-        for k, vlist in zip(key, vals):
+        for k in key:
             if k in self._store:
                 raise MXNetError("key %d already initialized" % k)
+        sync_bcast = (self._ps is None and self._is_dist
+                      and _num_processes() > 1)
+        if sync_bcast:
+            # ONE pytree broadcast for the whole call (per-key
+            # collectives cost a cross-process round trip each — minutes
+            # at hundreds of params over a slow DCN link)
+            from jax.experimental import multihost_utils
+            host_vals = multihost_utils.broadcast_one_to_all(
+                [vlist[0].asnumpy() for vlist in vals])
+            for k, vlist, val in zip(key, vals, host_vals):
+                self._store[k] = nd.array(np.asarray(val),
+                                          ctx=vlist[0].context)
+            return
+        for k, vlist in zip(key, vals):
             v = vlist[0]
             self._store[k] = v.copyto(v.context)
             if self._ps is not None:

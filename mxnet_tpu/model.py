@@ -73,10 +73,13 @@ def _create_kvstore(kvstore, num_device, arg_params):
 
 def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
                         update_on_kvstore):
-    """Init kvstore keys and broadcast initial weights (reference :78-97)."""
-    for idx, param_on_devs in enumerate(param_arrays):
-        kvstore.init(idx, arg_params[param_names[idx]])
-        if update_on_kvstore:
+    """Init kvstore keys and broadcast initial weights (reference :78-97).
+    Keys go in ONE list-form init so dist stores pay a single
+    cross-process broadcast for the whole model."""
+    keys = list(range(len(param_arrays)))
+    kvstore.init(keys, [arg_params[param_names[i]] for i in keys])
+    if update_on_kvstore:
+        for idx, param_on_devs in enumerate(param_arrays):
             kvstore.pull(idx, param_on_devs, priority=-idx)
 
 
@@ -377,25 +380,26 @@ def _run_callbacks(callbacks, params):
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     """Save prefix-symbol.json + prefix-%04d.params (reference :311).
 
-    Local .params files are written via tmp + os.replace so a writer
-    dying mid-write (e.g. do_checkpoint(async_write=True)'s daemon thread
-    at interpreter exit) never leaves a truncated file that looks
-    complete. URI prefixes (s3://, hdfs://; the dmlc::Stream surface)
-    write directly — object stores publish atomically on close and
-    os.replace cannot rename a URI.
+    Local files (plain paths and file:// URIs) are written via tmp +
+    os.replace so a writer dying mid-write (e.g.
+    do_checkpoint(async_write=True)'s daemon thread at interpreter exit)
+    never leaves a truncated file that looks complete. Remote URIs
+    (s3://, hdfs://; the dmlc::Stream surface) write directly — object
+    stores publish atomically on successful close.
     """
     import os
-    from .stream import is_uri
     symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    if is_uri(prefix):
+    local = param_name[len("file://"):] \
+        if param_name.startswith("file://") else param_name
+    if local.startswith(("s3://", "hdfs://")):
         nd.save(param_name, save_dict)
     else:
-        tmp_name = param_name + ".tmp"
+        tmp_name = local + ".tmp"
         nd.save(tmp_name, save_dict)
-        os.replace(tmp_name, param_name)
+        os.replace(tmp_name, local)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 

@@ -62,8 +62,7 @@ def ring_exchange(x, axis_name, shift=1):
     """Rotate shards around the axis ring by ``shift`` hops (the
     ring-attention / pipeline primitive; lowers to collective-permute on
     neighbouring ICI links)."""
-    n = axis_size(axis_name)
-    n = int(n) if not hasattr(n, "aval") else n
+    n = int(axis_size(axis_name))  # mesh sizes are static
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 
@@ -81,20 +80,25 @@ def bucketed_psum(grads, axis_name, bucket_bytes=4 * 1024 * 1024):
     this helper makes the bucketing explicit and available to custom
     training loops and shard_map regions.
 
-    Exact-value semantics: result equals per-leaf ``psum``.
+    Exact-value semantics: result equals per-leaf ``psum`` — buckets
+    are formed PER DTYPE (mixing dtypes in one buffer would upcast and
+    round differently than a native-dtype psum, breaking BSP
+    bit-determinism contracts).
     """
     import numpy as np
     items = list(grads.items()) if isinstance(grads, dict) else \
         list(enumerate(grads))
-    buckets, cur, cur_bytes = [], [], 0
+    buckets, cur, cur_bytes, cur_dt = [], [], 0, None
     for key, g in items:
         nbytes = int(np.prod(g.shape)) * g.dtype.itemsize if g.ndim else \
             g.dtype.itemsize
-        if cur and cur_bytes + nbytes > bucket_bytes:
+        if cur and (cur_bytes + nbytes > bucket_bytes
+                    or g.dtype != cur_dt):
             buckets.append(cur)
             cur, cur_bytes = [], 0
         cur.append((key, g))
         cur_bytes += nbytes
+        cur_dt = g.dtype
     if cur:
         buckets.append(cur)
     out = {}
@@ -104,14 +108,12 @@ def bucketed_psum(grads, axis_name, bucket_bytes=4 * 1024 * 1024):
             out[key] = lax.psum(g, axis_name)
             continue
         flats = [g.reshape(-1) for _, g in bucket]
-        # common dtype per bucket: upcast to the widest member
-        dt = jax.numpy.result_type(*[f.dtype for f in flats])
-        fused = jax.numpy.concatenate([f.astype(dt) for f in flats])
+        fused = jax.numpy.concatenate(flats)  # same dtype by grouping
         red = lax.psum(fused, axis_name)
         off = 0
         for (key, g), f in zip(bucket, flats):
             n = f.shape[0]
-            out[key] = red[off:off + n].astype(g.dtype).reshape(g.shape)
+            out[key] = red[off:off + n].reshape(g.shape)
             off += n
     if isinstance(grads, dict):
         return out

@@ -197,9 +197,11 @@ class PipelineTrainer:
         (stage count must equal the mesh's ``pp`` size). Input data
         variables must be consumed by stage 0, labels by the last stage.
     input_shapes : dict of GLOBAL input shapes, batch-first.
-    mesh : Mesh with a ``pp`` axis (only axis used).
-    num_microbatches : batch is split into M microbatches; GPipe bubble
-        is (S-1)/(M+S-1).
+    mesh : Mesh with a ``pp`` axis, optionally also ``dp`` — with both,
+        the batch shards over ``dp`` replica groups and each group runs
+        its own pipeline; gradients psum over (dp, pp).
+    num_microbatches : each dp group's batch is split into M
+        microbatches; GPipe bubble is (S-1)/(M+S-1).
     """
 
     def __init__(self, symbol, input_shapes, mesh, num_microbatches=None,
@@ -220,14 +222,16 @@ class PipelineTrainer:
         self.symbol = symbol
         self.mesh = mesh
         self.S = mesh.shape["pp"]
+        self.dp = mesh.shape.get("dp", 1)
         self.label_name = label_name
         self.input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
         batch = self.input_shapes["data"][0]
         self.M = num_microbatches or self.S
-        if batch % self.M:
-            raise MXNetError("batch %d not divisible into %d microbatches"
-                             % (batch, self.M))
-        self.mb = batch // self.M
+        if batch % (self.M * self.dp):
+            raise MXNetError(
+                "batch %d not divisible into %d microbatches x %d dp "
+                "groups" % (batch, self.M, self.dp))
+        self.mb = batch // (self.M * self.dp)
         self.global_batch = batch
 
         self.stage_nodes, self.boundaries, self.stage_of = \
@@ -388,10 +392,20 @@ class PipelineTrainer:
         param_specs = {n: P() for n in self.param_names}
         data_names = [k for k in self.input_shapes
                       if k != self.label_name]
+        has_dp = "dp" in self.mesh.shape
+        # microbatch arrays are [M, dp*mb, ...]: dim 1 shards over dp
+        batch_spec = P(None, "dp") if has_dp else P()
+        grad_axes = ("dp", "pp") if has_dp else ("pp",)
 
         def local_step(params, opt_state, data_mb, label_mb, lr, t_opt,
                        rng):
             idx = lax.axis_index("pp")
+            opt_rng = rng  # REPLICATED: stochastic optimizers (SGLD)
+            # must apply identical noise to replicated params everywhere
+            if has_dp:
+                # decorrelate stochastic forward ops (dropout) across
+                # dp replicas only
+                rng = jax.random.fold_in(rng, lax.axis_index("dp"))
 
             def fwd(p):
                 branches = [self._make_branch(s, data_mb, label_mb, p,
@@ -426,10 +440,12 @@ class PipelineTrainer:
             for name in self.param_names:
                 # each param's gradient lives on its stage's device;
                 # psum reassembles (other stages contribute zeros from
-                # the non-taken switch branches)
-                g = lax.psum(grads[name], "pp")
+                # the non-taken switch branches); with dp, replicas'
+                # batch-shard gradients sum in the same collective
+                g = lax.psum(grads[name], grad_axes)
                 w, st = self._opt_update(params[name], g,
-                                         opt_state[name], lr, t_opt, rng)
+                                         opt_state[name], lr, t_opt,
+                                         opt_rng)
                 new_params[name] = w
                 new_state[name] = st
             return new_params, new_state, out
@@ -437,16 +453,19 @@ class PipelineTrainer:
         mapped = shard_map(
             local_step, mesh=self.mesh,
             in_specs=(param_specs, param_specs,
-                      {k: P() for k in data_names}, P(), P(), P(), P()),
-            out_specs=(param_specs, param_specs, P()),
+                      {k: batch_spec for k in data_names}, batch_spec,
+                      P(), P(), P()),
+            out_specs=(param_specs, param_specs, batch_spec),
             check_vma=False)
 
         def step(params, opt_state, data_dict, label, lr, t):
             t = t + 1  # 1-based update count (Adam bias correction)
             rng = jax.random.fold_in(self._rng, t)
-            data_mb = {k: v.reshape((self.M, self.mb) + v.shape[1:])
+            # [B, ...] -> [M, dp*mb, ...]; dim 1 shards over dp
+            row = self.dp * self.mb
+            data_mb = {k: v.reshape((self.M, row) + v.shape[1:])
                        for k, v in data_dict.items()}
-            label_mb = label.reshape((self.M, self.mb) + label.shape[1:])
+            label_mb = label.reshape((self.M, row) + label.shape[1:])
             return mapped(params, opt_state, data_mb, label_mb, lr, t,
                           rng)
 

@@ -85,9 +85,36 @@ class _TextStream(io.TextIOWrapper):
         return super().__exit__(exc_type, exc_val, exc_tb)
 
 
+class _HdfsWriteStream(io.BytesIO):
+    """Memory-buffered HDFS write: upload on SUCCESSFUL close only —
+    same abort-on-exception contract as _S3Stream, so a failed save
+    never publishes a truncated file."""
+
+    def __init__(self, hdfs, path):
+        super().__init__()
+        self._hdfs = hdfs
+        self._path = path
+        self._abort = False
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            self._abort = True
+        self.close()
+        return False
+
+    def close(self):
+        if not self.closed and not self._abort:
+            with self._hdfs.open_output_stream(self._path) as out:
+                out.write(self.getvalue())
+        super().close()
+
+
 def open_stream(path, mode="rb"):
     """Open ``path`` by URI scheme (the ``dmlc::Stream::Create``
-    dispatch). Returns a file-like object usable as a context manager."""
+    dispatch). Returns a file-like object usable as a context manager.
+    Remote schemes support plain read ("r"/"rb") and whole-object write
+    ("w"/"wb") only — append/update modes raise (the reference's
+    dmlc::Stream has the same read-or-create contract)."""
     if not isinstance(path, (str, os.PathLike)):
         raise MXNetError("open_stream: path must be str, got %r"
                          % type(path))
@@ -95,6 +122,13 @@ def open_stream(path, mode="rb"):
     if p.startswith("file://"):
         p = p[len("file://"):]
         return open(p, mode)
+    if p.startswith(("s3://", "hdfs://")):
+        base = mode.replace("b", "")
+        if base not in ("r", "w"):
+            raise MXNetError(
+                "%s: remote streams support only 'r'/'w' modes, got %r "
+                "(append/update need read-modify-write through a local "
+                "copy)" % (p, mode))
     if p.startswith("s3://"):
         s = _S3Stream(p, mode)
         if "b" not in mode:
@@ -109,13 +143,18 @@ def open_stream(path, mode="rb"):
                 "in this image (the reference gates this behind "
                 "USE_HDFS=1, make/config.mk:92). Copy to a local path "
                 "first." % p)
+        rest = p.split("://", 1)[1]
+        if "/" not in rest:
+            raise MXNetError("malformed HDFS uri (no path): %s" % p)
         hdfs = pafs.HadoopFileSystem.from_uri(p)
-        rel = p.split("://", 1)[1].split("/", 1)[1]
+        rel = "/" + rest.split("/", 1)[1]
         if "w" in mode:
-            stream = hdfs.open_output_stream("/" + rel)
-        else:
-            stream = hdfs.open_input_stream("/" + rel)
-        if "b" not in mode:  # text mode parity with the s3 branch
+            stream = _HdfsWriteStream(hdfs, rel)
+            if "b" not in mode:
+                return _TextStream(stream, encoding="utf-8")
+            return stream
+        stream = hdfs.open_input_stream(rel)
+        if "b" not in mode:
             return io.TextIOWrapper(stream, encoding="utf-8")
         return stream
     return open(p, mode)

@@ -126,8 +126,44 @@ def check_trainer():
     print("OK trainer rank=%d csum=%.6f" % (rank, csum), flush=True)
 
 
+def check_fit_dist():
+    """FeedForward.fit with kvstore='dist_sync' across real processes —
+    the reference's nightly dist_lenet convergence oracle
+    (tests/nightly/dist_lenet.py): every worker sees its shard, updates
+    ride the cross-process reduce, and the model converges."""
+    rs = np.random.RandomState(11)
+    n_samples, d, k = 400, 16, 4
+    X = rs.randn(n_samples, d).astype(np.float32)
+    w = rs.randn(d, k)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    Xs, ys = X[rank::n], y[rank::n]  # per-worker shard
+
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=32)
+    a1 = mx.symbol.Activation(data=fc1, act_type="relu", name="r1")
+    fc2 = mx.symbol.FullyConnected(data=a1, name="fc2", num_hidden=k)
+    sym = mx.symbol.SoftmaxOutput(data=fc2, name="softmax")
+
+    # 25 epochs / lr 0.2: the dist job takes HALF the optimizer steps of
+    # a single-process run (global batch doubles), so the single-process
+    # convergence recipe needs proportionally more epochs
+    kv = mx.kv.create("dist_sync")
+    model = mx.model.FeedForward(sym, ctx=mx.cpu(), num_epoch=25,
+                                 learning_rate=0.2, momentum=0.9,
+                                 numpy_batch_size=50)
+    model.fit(Xs, ys, kvstore=kv)
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=100))
+    assert acc > 0.9, "dist fit failed to converge: %f" % acc
+    # BSP determinism: all workers end with identical params
+    csum = float(sum(np.abs(v.asnumpy()).sum()
+                     for v in model.arg_params.values()))
+    print("OK fit rank=%d fitsum=%.6f acc=%.3f" % (rank, csum, acc),
+          flush=True)
+
+
 check_kvstore()
 check_async()
 check_trainer()
+check_fit_dist()
 distributed.barrier("done")
 print("OK all rank=%d" % rank, flush=True)

@@ -25,6 +25,7 @@ def _launch(n, local_devices):
     assert proc.returncode == 0, out[-4000:]
     assert out.count("OK kvstore") == n, out[-4000:]
     assert out.count("OK async") == n, out[-4000:]
+    assert out.count("OK fit") == n, out[-4000:]
     assert out.count("OK all") == n, out[-4000:]
     return out
 
@@ -41,6 +42,9 @@ def test_dist_four_workers():
 @pytest.mark.slow
 def test_dist_sync_two_workers():
     out = _launch(2, 4)
+    # BSP determinism of the fit path: identical final params
+    fsums = [float(m) for m in re.findall(r"fitsum=([0-9.]+)", out)]
+    assert len(fsums) == 2 and abs(fsums[0] - fsums[1]) < 1e-5, fsums
     # both workers converge to identical parameters (BSP determinism)…
     csums = [float(m) for m in re.findall(r"csum=([0-9.]+)", out)]
     assert len(csums) == 2 and abs(csums[0] - csums[1]) < 1e-5, csums

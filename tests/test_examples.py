@@ -89,6 +89,16 @@ def test_long_context_ring_lm():
     assert "learning across the ring" in r.stderr + r.stdout
 
 
+def test_pipeline_parallel_lm():
+    r = _run("long-context", "train_pp.py", "--seq-len", "32",
+             "--steps", "12", "--embed", "32", "--heads", "2",
+             "--layers", "2", "--dp", "2", "--pp", "2")
+    if r.returncode != 0 and "devices" in (r.stderr or ""):
+        pytest.skip(r.stderr[-300:])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "learning through the pipe" in r.stderr + r.stdout
+
+
 def test_sgld_posterior():
     r = _run("bayesian-methods", "sgld.py", "--samples", "800",
              "--burn-in", "200")

@@ -202,3 +202,34 @@ def test_fit_fused_convergence_and_checkpoint(monkeypatch, tmp_path):
     loaded = mx.model.FeedForward.load(prefix, 10, ctx=mx.cpu())
     lacc = loaded.score(mx.io.NDArrayIter(X, y, batch_size=100))
     assert abs(lacc - acc) < 1e-6
+
+
+def test_fit_fused_multi_device_matches_single(monkeypatch):
+    """Fused fit over an 8-device ctx list (dp mesh) produces the SAME
+    parameters as fused fit on one device — the in-program psum replaces
+    the kvstore reduction with identical BSP semantics."""
+    import jax
+
+    monkeypatch.setenv("MXNET_FUSED_FIT", "1")
+    X, y = _make_problem(n=256, d=16, k=4)
+    sym = _mlp_symbol(num_hidden=32, k=4)
+    shapes = {"data": (32, 16), "softmax_label": (32,)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    prng = np.random.RandomState(13)
+    init = {n: prng.uniform(-0.1, 0.1, s).astype(np.float32)
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in shapes}
+
+    def run(ctx):
+        model = mx.model.FeedForward(
+            sym, ctx=ctx, num_epoch=2,
+            arg_params={n: mx.nd.array(v.copy()) for n, v in init.items()},
+            learning_rate=0.1, momentum=0.9, numpy_batch_size=32)
+        model.fit(mx.io.NDArrayIter(X, y, batch_size=32, shuffle=False))
+        return {n: v.asnumpy() for n, v in model.arg_params.items()}
+
+    single = run(mx.cpu())
+    multi = run([mx.cpu(i) for i in range(len(jax.devices()))])
+    for n in single:
+        np.testing.assert_allclose(multi[n], single[n], rtol=2e-4,
+                                   atol=2e-5, err_msg=n)
