@@ -26,6 +26,9 @@ import numpy as np
 
 NORTH_STAR_IMG_PER_SEC = 2000.0   # ResNet-50 target, img/s/chip
 CIFAR_BASELINE = 842.0            # Inception-BN-28-small, 1x GTX 980
+# Inception-BN ImageNet: 2,844 s/epoch on 4x Titan X = ~113 img/s/GPU
+# (reference example/image-classification/README.md:254)
+INCEPTION_BN_TITANX_BASELINE = 113.0
 
 # ResNet-50 @224: ~4.1 GFLOP forward per image; backward ~2x forward.
 _RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.1e9
@@ -119,6 +122,30 @@ def bench_resnet50(batch, steps=20):
     return ips, ips_h2d, mfu
 
 
+def bench_inception_bn(batch=128, steps=15):
+    """Inception-BN ImageNet-shape (the reference's BIG published
+    table — INCEPTION_BN_TITANX_BASELINE img/s/GPU)."""
+    import jax
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.models import get_inception_bn
+
+    sym = get_inception_bn(num_classes=1000)
+    shapes = {"data": (batch, 3, 224, 224), "softmax_label": (batch,)}
+    trainer = par.ParallelTrainer(
+        sym, shapes, optimizer="sgd", mesh=par.data_parallel_mesh(1),
+        compute_dtype="bfloat16",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    trainer.init_params()
+    rng = np.random.RandomState(0)
+    hostb = {"data": rng.rand(*shapes["data"]).astype(np.float32),
+             "softmax_label": rng.randint(0, 1000, (batch,)
+                                          ).astype(np.float32)}
+    devb = {k: jax.device_put(v, trainer._data_sh[k])
+            for k, v in hostb.items()}
+    dt = _timed_steps(trainer, devb, steps)
+    return batch * steps / dt
+
+
 def bench_cifar(steps=30):
     from mxnet_tpu import parallel as par
     from mxnet_tpu.models import get_inception_bn_small
@@ -180,6 +207,7 @@ def bench_recordio_io(n_images=512, batch=128):
 def main():
     r50_256, r50_256_h2d, mfu = bench_resnet50(256)
     r50_128, _, _ = bench_resnet50(128)
+    incbn = bench_inception_bn()
     cifar = bench_cifar()
     io_ips = bench_recordio_io()
     print(json.dumps({
@@ -192,6 +220,9 @@ def main():
             "resnet50_b256_bf16_host_infeed": round(r50_256_h2d, 1),
             "resnet50_b128_bf16": round(r50_128, 1),
             "resnet50_mfu_estimate": round(mfu, 3),
+            "inception-bn_imagenet_b128": round(incbn, 1),
+            "inception-bn_vs_titanx_per_gpu":
+                round(incbn / INCEPTION_BN_TITANX_BASELINE, 1),
             "cifar10_inception-bn-28-small": round(cifar, 1),
             "cifar_vs_gtx980_baseline": round(cifar / CIFAR_BASELINE, 3),
             "recordio_io_img_per_sec":

@@ -212,13 +212,6 @@ class PipelineTrainer:
         if symbol.list_auxiliary_states():
             raise MXNetError("PipelineTrainer: aux states unsupported "
                              "under the SPMD schedule")
-        if len(symbol._heads) != 1:
-            # the schedule gates the (single) loss head's input on
-            # fill/drain ticks; ungated extra heads would inject
-            # spurious gradients (loss ops ignore head cotangents)
-            raise MXNetError("PipelineTrainer: symbol must have exactly "
-                             "one (loss) head, got %d"
-                             % len(symbol._heads))
         self.symbol = symbol
         self.mesh = mesh
         self.S = mesh.shape["pp"]
@@ -236,6 +229,15 @@ class PipelineTrainer:
 
         self.stage_nodes, self.boundaries, self.stage_of = \
             partition_stages(symbol, self.S)
+        for h, _ in symbol._heads:
+            if self.stage_of.get(id(h)) != self.S - 1:
+                raise MXNetError(
+                    "PipelineTrainer: head %r lives in stage %s, but "
+                    "every output head must be computed by the LAST "
+                    "stage (%d) — tag it (or what feeds it) with "
+                    "ctx_group='stage%d'"
+                    % (h.name, self.stage_of.get(id(h)), self.S - 1,
+                       self.S - 1))
 
         self.arg_names = symbol.list_arguments()
         self.param_names = [n for n in self.arg_names
@@ -247,7 +249,7 @@ class PipelineTrainer:
         if arg_shapes is None:
             raise MXNetError("PipelineTrainer: shape inference failed")
         self.arg_shapes = dict(zip(self.arg_names, arg_shapes))
-        self.out_shape = tuple(out_shapes[0])
+        self.out_shapes = [tuple(s) for s in out_shapes]
         self._mb_shapes = mb_shapes
 
         # boundary (uniform) activation shape — validated equal across cuts
@@ -367,7 +369,7 @@ class PipelineTrainer:
                             src, mb_idx, keepdims=False)
                     continue
                 ins = [env[(id(inp), idx)] for inp, idx in n.inputs]
-                if s == S - 1 and n is heads[0][0]:
+                if s == S - 1 and any(n is h for h, _ in heads):
                     ins[0] = ins[0] * tick_valid.astype(ins[0].dtype)
                 node_rng = jax.random.fold_in(
                     jax.random.fold_in(rng, t), i + s * 10000)
@@ -376,11 +378,12 @@ class PipelineTrainer:
                 for j, o in enumerate(outs):
                     env[(id(n), j)] = o
             if s == S - 1:
-                out_val = env[(id(heads[0][0]), heads[0][1])]
+                out_val = tuple(env[(id(h), j)] for h, j in heads)
                 boundary = jnp.zeros(self._boundary_shape,
                                      self._boundary_dtype)
             else:
-                out_val = jnp.zeros(self.out_shape, jnp.float32)
+                out_val = tuple(jnp.zeros(os_, jnp.float32)
+                                for os_ in self.out_shapes)
                 boundary = env[(id(out_entry[0]), out_entry[1])]
             return boundary.astype(self._boundary_dtype), out_val
 
@@ -413,29 +416,33 @@ class PipelineTrainer:
                             for s in range(S)]
                 state0 = jnp.zeros(self._boundary_shape,
                                    self._boundary_dtype)
-                out0 = jnp.zeros((M,) + self.out_shape, jnp.float32)
+                out0 = tuple(jnp.zeros((M,) + os_, jnp.float32)
+                             for os_ in self.out_shapes)
 
                 def body(carry, t):
-                    state, out = carry
-                    y, out_val = lax.switch(idx, branches, state, t)
+                    state, outs = carry
+                    y, out_vals = lax.switch(idx, branches, state, t)
                     w = t - (S - 1)
                     valid = (idx == S - 1) & (w >= 0) & (w < M)
-                    written = lax.dynamic_update_index_in_dim(
-                        out, out_val, jnp.clip(w, 0, M - 1), 0)
-                    out = jnp.where(valid, written, out)
+                    wc = jnp.clip(w, 0, M - 1)
+                    outs = tuple(
+                        jnp.where(valid,
+                                  lax.dynamic_update_index_in_dim(
+                                      o, v, wc, 0), o)
+                        for o, v in zip(outs, out_vals))
                     state = lax.ppermute(y, "pp", perm)
-                    return (state, out), None
+                    return (state, outs), None
 
                 # scan (not fori_loop): statically unrollable schedule
                 # that reverse-differentiates — the vjp drains the pipe
                 # backwards, the wave 1F1B schedules by hand
-                (_, out), _ = lax.scan(body, (state0, out0),
-                                       jnp.arange(M + S - 1))
-                # only the last stage wrote `out`; broadcast to all
-                return lax.psum(out, "pp")
+                (_, outs), _ = lax.scan(body, (state0, out0),
+                                        jnp.arange(M + S - 1))
+                # only the last stage wrote `outs`; broadcast to all
+                return tuple(lax.psum(o, "pp") for o in outs)
 
             out, vjp_fn = jax.vjp(fwd, params)
-            (grads,) = vjp_fn(jnp.ones_like(out))
+            (grads,) = vjp_fn(tuple(jnp.ones_like(o) for o in out))
             new_params, new_state = {}, {}
             for name in self.param_names:
                 # each param's gradient lives on its stage's device;
@@ -455,7 +462,8 @@ class PipelineTrainer:
             in_specs=(param_specs, param_specs,
                       {k: batch_spec for k in data_names}, batch_spec,
                       P(), P(), P()),
-            out_specs=(param_specs, param_specs, batch_spec),
+            out_specs=(param_specs, param_specs,
+                       tuple(batch_spec for _ in self.out_shapes)),
             check_vma=False)
 
         def step(params, opt_state, data_dict, label, lr, t):
@@ -474,7 +482,9 @@ class PipelineTrainer:
     # ------------------------------------------------------------------
     def step(self, batch):
         """One pipelined train step on a GLOBAL batch dict. Returns the
-        head output [B, ...] (microbatches re-flattened)."""
+        head output [B, ...] (microbatches re-flattened); a list when
+        the symbol has multiple heads (every head's input is gated on
+        fill/drain ticks, so none injects spurious gradients)."""
         if self.params is None:
             self.init_params()
         if self._jit_step is None:
@@ -486,11 +496,13 @@ class PipelineTrainer:
             lr = self.optimizer.lr_scheduler(self._t + 1)
         else:
             lr = self.optimizer.lr
-        self.params, self.opt_state, out = self._jit_step(
+        self.params, self.opt_state, outs = self._jit_step(
             self.params, self.opt_state, data_dict, label,
             np.float32(lr), np.int32(self._t))
         self._t += 1
-        return out.reshape((self.global_batch,) + tuple(out.shape[2:]))
+        outs = [o.reshape((self.global_batch,) + tuple(o.shape[2:]))
+                for o in outs]
+        return outs[0] if len(outs) == 1 else outs
 
     def get_params(self):
         return {n: nd.array(np.asarray(jax.device_get(v)))

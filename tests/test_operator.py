@@ -704,3 +704,54 @@ def test_reshape_flatten():
                            [x.reshape(2, 12)], [x.reshape(2, 6, 2)])
     fl = mx.sym.Flatten(data=data)
     check_symbolic_forward(fl, [x], [x.reshape(2, 12)])
+
+
+def test_nhwc_internal_layout_matches_nchw():
+    """MXNET_CONV_NHWC=1 (the TPU default) must match the NCHW path
+    bit-for-tolerance on a full convnet forward+backward."""
+    import os
+    data = mx.symbol.Variable("data")
+    c1 = mx.symbol.Convolution(data=data, name="c1", kernel=(3, 3),
+                               num_filter=8, pad=(1, 1), stride=(2, 2))
+    b1 = mx.symbol.BatchNorm(data=c1, name="bn1")
+    r1 = mx.symbol.Activation(data=b1, act_type="relu", name="r1")
+    p1 = mx.symbol.Pooling(data=r1, name="p1", kernel=(2, 2),
+                           stride=(2, 2), pool_type="max")
+    d1 = mx.symbol.Deconvolution(data=p1, name="d1", kernel=(2, 2),
+                                 stride=(2, 2), num_filter=4)
+    g1 = mx.symbol.Pooling(data=d1, name="g1", kernel=(1, 1),
+                           pool_type="avg", global_pool=True)
+    fc = mx.symbol.FullyConnected(data=mx.symbol.Flatten(data=g1),
+                                  name="fc", num_hidden=3)
+    net = mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+    shapes = {"data": (2, 3, 16, 16), "softmax_label": (2,)}
+
+    def run(flag):
+        prev = os.environ.get("MXNET_CONV_NHWC")
+        os.environ["MXNET_CONV_NHWC"] = flag
+        try:
+            rng = np.random.RandomState(0)
+            arg_shapes, _, _ = net.infer_shape(**shapes)
+            args = {n: mx.nd.array(rng.uniform(-0.5, 0.5, s).astype("f"))
+                    for n, s in zip(net.list_arguments(), arg_shapes)}
+            grads = {n: mx.nd.zeros(s)
+                     for n, s in zip(net.list_arguments(), arg_shapes)
+                     if n not in shapes}
+            exe = net.bind(mx.cpu(), args, args_grad=grads)
+            exe.forward(is_train=True)
+            exe.backward()
+            return ([o.asnumpy() for o in exe.outputs],
+                    {n: g.asnumpy() for n, g in grads.items()})
+        finally:
+            if prev is None:
+                del os.environ["MXNET_CONV_NHWC"]
+            else:
+                os.environ["MXNET_CONV_NHWC"] = prev
+
+    o1, g1v = run("1")
+    o2, g2v = run("0")
+    for a, b in zip(o1, o2):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    for n in g2v:
+        np.testing.assert_allclose(g1v[n], g2v[n], rtol=1e-4, atol=1e-5,
+                                   err_msg=n)

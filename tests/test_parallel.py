@@ -814,3 +814,59 @@ def test_pipeline_dp_pp_matches_single_device():
     for n in want:
         np.testing.assert_allclose(got[n].asnumpy(), want[n].asnumpy(),
                                    rtol=2e-4, atol=2e-5, err_msg=n)
+
+
+def test_pipeline_multi_head():
+    """Group-headed symbols pipeline correctly: every head's input is
+    gated on fill/drain ticks (loss heads inject cotangent-independent
+    gradients, so ungated extras would corrupt training); params must
+    match the single-device trainer and the monitoring head's output
+    must match the reference forward."""
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=16)
+    r1 = mx.symbol.Activation(data=fc1, act_type="relu", name="r1")
+    with mx.AttrScope(ctx_group="stage1"):
+        fc2 = mx.symbol.FullyConnected(data=r1, name="fc2", num_hidden=5)
+        loss = mx.symbol.SoftmaxOutput(data=fc2, name="softmax")
+        probe = mx.symbol.BlockGrad(data=fc2, name="probe")
+    grouped = mx.symbol.Group([loss, probe])
+    # tag the trunk
+    for n in grouped._topo():
+        if not n.is_var and n.attrs.get("ctx_group") is None:
+            n.attrs["ctx_group"] = "stage0"
+
+    B = 8
+    rng = np.random.RandomState(3)
+    datav = rng.randn(B, 12).astype(np.float32)
+    label = rng.randint(0, 5, (B,)).astype(np.float32)
+    shapes = {"data": (B, 12), "softmax_label": (B,)}
+    arg_shapes, _, _ = grouped.infer_shape(**shapes)
+    prng = np.random.RandomState(4)
+    init = {n: mx.nd.array(prng.uniform(-0.2, 0.2, s).astype("f"))
+            for n, s in zip(grouped.list_arguments(), arg_shapes)
+            if n not in shapes}
+
+    ref = par.ParallelTrainer(
+        grouped, shapes, optimizer="sgd", mesh=par.data_parallel_mesh(1),
+        optimizer_params={"learning_rate": 0.2, "momentum": 0.9})
+    ref.init_params({k: v.copy() for k, v in init.items()})
+    for _ in range(2):
+        ref_outs = ref.step({"data": datav, "softmax_label": label})
+    want, _ = ref.get_params()
+
+    pp = par.PipelineTrainer(
+        grouped, shapes, par.build_mesh({"pp": 2}), num_microbatches=4,
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.2, "momentum": 0.9,
+                          "rescale_grad": 1.0 / B})
+    pp.init_params({k: v.copy() for k, v in init.items()})
+    for _ in range(2):
+        outs = pp.step({"data": datav, "softmax_label": label})
+    assert isinstance(outs, list) and len(outs) == 2
+    got = pp.get_params()
+    for n in want:
+        np.testing.assert_allclose(got[n].asnumpy(), want[n].asnumpy(),
+                                   rtol=2e-4, atol=2e-5, err_msg=n)
+    np.testing.assert_allclose(np.asarray(outs[1]),
+                               np.asarray(ref_outs[1]),
+                               rtol=2e-4, atol=2e-5)
