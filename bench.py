@@ -75,26 +75,35 @@ def _timed_steps(trainer, batch, steps):
     return max(t2 - t1, 1e-9)
 
 
-def bench_resnet50(batch, steps=20):
+def _make_trainer_and_batches(sym, shapes, n_classes, compute_dtype,
+                              opt_params):
+    """Shared setup: fused trainer + synthetic host/device batches."""
     import jax
     from mxnet_tpu import parallel as par
+
+    trainer = par.ParallelTrainer(
+        sym, shapes, optimizer="sgd", mesh=par.data_parallel_mesh(1),
+        compute_dtype=compute_dtype, optimizer_params=opt_params)
+    trainer.init_params()
+    rng = np.random.RandomState(0)
+    batch = shapes["data"][0]
+    hostb = {"data": rng.rand(*shapes["data"]).astype(np.float32),
+             "softmax_label": rng.randint(0, n_classes, (batch,)
+                                          ).astype(np.float32)}
+    devb = {k: jax.device_put(v, trainer._data_sh[k])
+            for k, v in hostb.items()}
+    return trainer, hostb, devb
+
+
+def bench_resnet50(batch, steps=20):
     from mxnet_tpu.models import get_resnet
 
     sym = get_resnet(num_classes=1000, num_layers=50)
     shapes = {"data": (batch, 3, 224, 224), "softmax_label": (batch,)}
-    trainer = par.ParallelTrainer(
-        sym, shapes, optimizer="sgd", mesh=par.data_parallel_mesh(1),
-        compute_dtype="bfloat16",
-        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
-                          "wd": 1e-4})
-    trainer.init_params()
-    rng = np.random.RandomState(0)
-    hostb = {"data": rng.rand(*shapes["data"]).astype(np.float32),
-             "softmax_label": rng.randint(0, 1000, (batch,)
-                                          ).astype(np.float32)}
+    trainer, hostb, devb = _make_trainer_and_batches(
+        sym, shapes, 1000, "bfloat16",
+        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
     # device-resident batch: the compute-bound number
-    devb = {k: jax.device_put(v, trainer._data_sh[k])
-            for k, v in hostb.items()}
     dt = _timed_steps(trainer, devb, steps)
     ips = batch * steps / dt
 
@@ -125,44 +134,26 @@ def bench_resnet50(batch, steps=20):
 def bench_inception_bn(batch=128, steps=15):
     """Inception-BN ImageNet-shape (the reference's BIG published
     table — INCEPTION_BN_TITANX_BASELINE img/s/GPU)."""
-    import jax
-    from mxnet_tpu import parallel as par
     from mxnet_tpu.models import get_inception_bn
 
     sym = get_inception_bn(num_classes=1000)
     shapes = {"data": (batch, 3, 224, 224), "softmax_label": (batch,)}
-    trainer = par.ParallelTrainer(
-        sym, shapes, optimizer="sgd", mesh=par.data_parallel_mesh(1),
-        compute_dtype="bfloat16",
-        optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
-    trainer.init_params()
-    rng = np.random.RandomState(0)
-    hostb = {"data": rng.rand(*shapes["data"]).astype(np.float32),
-             "softmax_label": rng.randint(0, 1000, (batch,)
-                                          ).astype(np.float32)}
-    devb = {k: jax.device_put(v, trainer._data_sh[k])
-            for k, v in hostb.items()}
+    trainer, _, devb = _make_trainer_and_batches(
+        sym, shapes, 1000, "bfloat16",
+        {"learning_rate": 0.1, "momentum": 0.9})
     dt = _timed_steps(trainer, devb, steps)
     return batch * steps / dt
 
 
-def bench_cifar(steps=30):
-    from mxnet_tpu import parallel as par
+def bench_cifar(batch=128, steps=30):
     from mxnet_tpu.models import get_inception_bn_small
 
-    batch = 128
     sym = get_inception_bn_small(num_classes=10)
     shapes = {"data": (batch, 3, 28, 28), "softmax_label": (batch,)}
-    trainer = par.ParallelTrainer(
-        sym, shapes, optimizer="sgd", mesh=par.data_parallel_mesh(1),
-        optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
-                          "wd": 1e-4})
-    trainer.init_params()
-    rng = np.random.RandomState(0)
-    batch_dict = {
-        "data": rng.randn(*shapes["data"]).astype(np.float32),
-        "softmax_label": rng.randint(0, 10, (batch,)).astype(np.float32)}
-    dt = _timed_steps(trainer, batch_dict, steps)
+    trainer, _, devb = _make_trainer_and_batches(
+        sym, shapes, 10, None,
+        {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4})
+    dt = _timed_steps(trainer, devb, steps)
     return batch * steps / dt
 
 

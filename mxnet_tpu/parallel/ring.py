@@ -12,6 +12,15 @@ latency hides behind the MXU matmuls.
 
 All math accumulates in float32 regardless of input dtype (bf16 in,
 f32 softmax state) — the standard TPU recipe.
+
+Causal load balance: in a contiguous-layout causal ring, early-position
+devices fully mask most arriving blocks. We deliberately do NOT "skip"
+those blocks (per-device lax.cond) or stripe the layout: every ring hop
+is a lockstep collective, so per-iteration wall time is set by the
+slowest device either way, and the dense per-block einsum cannot skip
+intra-block triangles. Real savings need striped layouts WITH
+half-block kernels (striped attention); until the Pallas ring kernel
+lands, the honest contiguous ring is what ships.
 """
 from __future__ import annotations
 

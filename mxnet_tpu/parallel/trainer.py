@@ -26,6 +26,7 @@ import time
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..base import MXNetError
 from .. import ndarray as nd
@@ -61,11 +62,18 @@ class ParallelTrainer:
         FeedForward.fit (reference model.py:456-465).
     mesh : jax.sharding.Mesh, default: 1-axis dp mesh over all devices.
     rules : ShardingRules, default: dp-shard data, replicate params.
+    zero1 : bool
+        Shard optimizer state over ``dp`` (ZeRO-1); same update math
+        (equal to reduction-reassociation), state memory 1/dp per chip.
+    grad_accum : int
+        Split each step's batch into this many sequentially-scanned
+        microbatches with one update on the summed gradients
+        (activation memory of one microbatch).
     """
 
     def __init__(self, symbol, input_shapes, optimizer="sgd", mesh=None,
                  rules=None, initializer=None, seed=None, optimizer_params=None,
-                 compute_dtype=None, remat=None):
+                 compute_dtype=None, remat=None, zero1=False, grad_accum=1):
         self.symbol = symbol
         # Mixed precision: forward/backward in compute_dtype (bfloat16 —
         # native MXU input width, halves HBM traffic for activations),
@@ -105,6 +113,18 @@ class ParallelTrainer:
         # optimizer ------------------------------------------------------
         batch_size = next(iter(self.input_shapes.values()))[0]
         self.global_batch = batch_size
+        # gradient accumulation: the step's batch is split into
+        # grad_accum microbatches scanned sequentially inside the SAME
+        # compiled program (activation memory = one microbatch), with
+        # ONE optimizer update on the summed gradients. Exactly equals
+        # the full-batch step for per-example losses; BatchNorm models
+        # see MICROBATCH statistics (the standard accumulation caveat).
+        # The reference has no analogue; on TPU this is how memory-bound
+        # models reach large effective batches.
+        self.grad_accum = int(grad_accum)
+        if self.grad_accum < 1 or batch_size % self.grad_accum:
+            raise MXNetError("grad_accum=%d must divide batch %d"
+                             % (grad_accum, batch_size))
         if isinstance(optimizer, str):
             opt_kwargs = dict(optimizer_params or {})
             opt_kwargs.setdefault("rescale_grad", 1.0 / batch_size)
@@ -118,6 +138,38 @@ class ParallelTrainer:
         self._data_sh = {n: self.rules.data_sharding(n, s)
                          for n, s in self.input_shapes.items()}
         self._repl = self.rules.replicated()
+        # ZeRO-1: shard OPTIMIZER STATE over dp. Params stay replicated
+        # (their sharding is unchanged), but momentum/Adam moments — the
+        # 1-2x param-sized buffers — live 1/dp per chip. Expressed purely
+        # as out_shardings: GSPMD derives the reduce-scatter of grads
+        # into the state shards and the all-gather of updated params,
+        # the ZeRO-1 dataflow, from the sharding constraints alone.
+        # Numerics match the replicated trainer to float reassociation
+        # (the reduce-scatter reorders the gradient sum) — same-math,
+        # not bitwise.
+        self.zero1 = bool(zero1)
+        self._opt_sh = None
+        if self.zero1:
+            if "dp" not in self.mesh.shape:
+                raise MXNetError("zero1=True needs a 'dp' mesh axis")
+            from jax.sharding import NamedSharding
+
+            def leaf_sh(name):
+                shape = self.arg_shapes[name]
+                dp = self.mesh.shape["dp"]
+                if shape and shape[0] % dp == 0:
+                    spec = P("dp", *([None] * (len(shape) - 1)))
+                else:
+                    spec = P()  # tiny/odd params: replicate their state
+                return NamedSharding(self.mesh, spec)
+
+            self._opt_sh = {}
+            for n in self.param_names:
+                template = jax.eval_shape(
+                    self._opt_init,
+                    jax.ShapeDtypeStruct(self.arg_shapes[n], jnp.float32))
+                self._opt_sh[n] = jax.tree_util.tree_map(
+                    lambda _leaf, _n=n: leaf_sh(_n), template)
 
         # state ----------------------------------------------------------
         # default Pallas fusion only on a single-device mesh: under
@@ -182,7 +234,7 @@ class ParallelTrainer:
         with self.mesh:
             opt_state = jax.jit(
                 lambda p: {k: self._opt_init(v) for k, v in p.items()},
-                out_shardings=None)(params)
+                out_shardings=self._opt_sh)(params)
         self.params = params
         self.aux = aux
         self.opt_state = opt_state
@@ -196,10 +248,9 @@ class ParallelTrainer:
             return v.astype(self.compute_dtype)
         return v
 
-    def _step_impl(self, params, opt_state, aux, batch, lr, t, rng_base):
-        # fold the step counter into the key INSIDE the compiled program —
-        # doing it eagerly in step() costs a host dispatch per step
-        rng = jax.random.fold_in(rng_base, t)
+    def _grads_of(self, params, aux, batch, rng):
+        """(grads, new_aux, outs) for one (micro)batch — the fused
+        forward+backward with the loss-head cotangent convention."""
         cast = self._cast_compute
 
         def fwd(p):
@@ -219,6 +270,39 @@ class ParallelTrainer:
                             for a, o in zip(new_aux, aux))
         head_grads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
         (grads,) = vjp_fn(head_grads)
+        return grads, new_aux, outs
+
+    def _step_impl(self, params, opt_state, aux, batch, lr, t, rng_base):
+        # fold the step counter into the key INSIDE the compiled program —
+        # doing it eagerly in step() costs a host dispatch per step
+        rng = jax.random.fold_in(rng_base, t)
+        A = self.grad_accum
+        if A == 1:
+            grads, new_aux, outs = self._grads_of(params, aux, batch, rng)
+        else:
+            # scan microbatches: grads SUM (loss grads are batch-sums, so
+            # summing microbatch grads equals the full-batch gradient);
+            # aux (BN moving stats) chain through the scan sequentially
+            micro = {k: v.reshape((A, v.shape[0] // A) + v.shape[1:])
+                     for k, v in batch.items()}
+            g0 = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), dict(params))
+
+            def body(carry, mb_in):
+                g_acc, aux_c, i = carry
+                mb_rng = jax.random.fold_in(rng, i)
+                g, new_aux, outs = self._grads_of(params, list(aux_c),
+                                                  mb_in, mb_rng)
+                g_acc = jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(jnp.float32), g_acc,
+                    dict(g))
+                return (g_acc, list(new_aux), i + 1), tuple(outs)
+
+            (grads, new_aux, _), outs_stacked = lax.scan(
+                body, (g0, list(aux), jnp.int32(0)), micro)
+            # [A, mb, ...] -> [batch, ...] per head (batch-major order)
+            outs = [o.reshape((o.shape[0] * o.shape[1],) + o.shape[2:])
+                    for o in outs_stacked]
         new_params, new_state = {}, {}
         for name in self.param_names:
             w, s = self._opt_update(params[name], grads[name],
@@ -228,9 +312,9 @@ class ParallelTrainer:
         return new_params, new_state, list(new_aux), list(outs)
 
     def _build_step(self):
-        in_sh = (self._param_sh, None, None,
+        in_sh = (self._param_sh, self._opt_sh, None,
                  self._data_sh, self._repl, self._repl, self._repl)
-        out_sh = (self._param_sh, None, None, None)
+        out_sh = (self._param_sh, self._opt_sh, None, None)
         return jax.jit(self._step_impl, in_shardings=in_sh,
                        out_shardings=out_sh, donate_argnums=(0, 1, 2))
 
