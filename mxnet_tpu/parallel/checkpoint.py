@@ -54,11 +54,10 @@ def _index_key(index, global_shape):
     return ",".join(parts)
 
 
-def save_sharded(prefix, params, step=0, extra=None):
-    """Write this process's replica-0 shards of every array in ``params``
-    (a flat name->jax.Array dict). Call from ALL processes."""
-    rank = jax.process_index()
-    shard_file = "%s-shards-p%d.npz" % (prefix, rank)
+def _snapshot_shards(params, step, extra):
+    """Synchronously pull this process's replica-0 shards to host numpy
+    (the values may be donated/overwritten by the next train step, so
+    this part cannot be deferred). Returns (blobs, manifest)."""
     blobs = {}
     manifest = {"step": int(step), "nprocs": jax.process_count(),
                 "params": {}, "extra": extra or {}}
@@ -74,26 +73,130 @@ def save_sharded(prefix, params, step=0, extra=None):
                 continue  # store each byte once, not once per replica
             key = "%s|%s" % (name, _index_key(shard.index, arr.shape))
             blobs[key] = np.asarray(shard.data)
+    return blobs, manifest
+
+
+def _write_shards(prefix, blobs, manifest, use_collectives=True):
+    """File IO + cross-process completion protocol.
+
+    ``use_collectives=True`` (the synchronous path, main thread):
+    device-collective barriers order "all shard files exist" before the
+    manifest appears. The ASYNC writer thread must NOT issue device
+    collectives — they would race the training step's collectives for
+    enqueue order across processes and can deadlock the run — so it
+    uses a filesystem marker protocol instead: every process drops a
+    per-save marker file, rank 0 waits for all markers before
+    publishing the manifest, non-zero ranks wait for the manifest
+    recording this save's step. Same prefix+step saved twice
+    concurrently is undefined (markers collide) — don't do that.
+    """
+    import time as _time
+    rank = jax.process_index()
+    nprocs = jax.process_count()
+    shard_file = "%s-shards-p%d.npz" % (prefix, rank)
     # atomic write: tmp + rename, so a preempted writer never leaves a
     # truncated shard file behind a completed-looking checkpoint
     tmp = "%s-shards-p%d.tmp.npz" % (prefix, rank)  # np.savez needs .npz
     np.savez(tmp, **blobs)
     os.replace(tmp, shard_file)
-    if jax.process_count() > 1:
-        # all shard files must exist before the manifest (the
-        # completeness marker) appears
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("save_sharded:" + prefix)
+    token = manifest["step"]
+    if nprocs > 1:
+        if use_collectives:
+            # all shard files must exist before the manifest (the
+            # completeness marker) appears
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("save_sharded:" + prefix)
+        else:
+            with open("%s-done-p%d-%s" % (prefix, rank, token), "w"):
+                pass
+            if rank == 0:
+                deadline = _time.time() + 600
+                while any(not os.path.exists(
+                        "%s-done-p%d-%s" % (prefix, r, token))
+                        for r in range(nprocs)):
+                    if _time.time() > deadline:
+                        raise RuntimeError(
+                            "save_sharded: timed out waiting for peer "
+                            "shard files for %s step %s" % (prefix,
+                                                            token))
+                    _time.sleep(0.1)
     if rank == 0:
         mtmp = "%s-manifest.json.tmp" % prefix
         with open(mtmp, "w") as f:
             json.dump(manifest, f)
         os.replace(mtmp, "%s-manifest.json" % prefix)
-    if jax.process_count() > 1:
-        # and none may RETURN (and e.g. immediately restore) before the
-        # new manifest is in place
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("save_sharded_done:" + prefix)
+    if nprocs > 1:
+        if use_collectives:
+            # and none may RETURN (and e.g. immediately restore) before
+            # the new manifest is in place
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("save_sharded_done:"
+                                                + prefix)
+        else:
+            if rank != 0:
+                deadline = _time.time() + 600
+                mpath = "%s-manifest.json" % prefix
+
+                def _current():
+                    try:
+                        with open(mpath) as f:
+                            return json.load(f).get("step") == token
+                    except (OSError, ValueError):
+                        return False
+                while not _current():
+                    if _time.time() > deadline:
+                        raise RuntimeError(
+                            "save_sharded: timed out waiting for the "
+                            "manifest of %s step %s" % (prefix, token))
+                    _time.sleep(0.1)
+            # best-effort marker cleanup (rank 0 removes after manifest)
+            if rank == 0:
+                for r in range(nprocs):
+                    try:
+                        os.remove("%s-done-p%d-%s" % (prefix, r, token))
+                    except OSError:
+                        pass
+
+
+def save_sharded(prefix, params, step=0, extra=None, async_write=False):
+    """Write this process's replica-0 shards of every array in ``params``
+    (a flat name->jax.Array dict). Call from ALL processes.
+
+    ``async_write=True`` snapshots to host synchronously (device values
+    may be donated by the next step), then runs the file IO and the
+    cross-process completion protocol on a background thread — the
+    epoch-overlap the reference's engine gave its IO ops. Returns a
+    0-arg ``finalize`` callable that joins the writer and re-raises any
+    write error; call it before exiting (or before restoring). Either
+    ALL processes pass async_write or none: the completion barriers
+    must line up."""
+    blobs, manifest = _snapshot_shards(params, step, extra)
+    if not async_write:
+        _write_shards(prefix, blobs, manifest)
+        return lambda: None
+
+    import threading
+    err = []
+
+    def _run():
+        try:
+            # no device collectives off the main thread (they would
+            # race the training step's collectives): marker protocol
+            _write_shards(prefix, blobs, manifest,
+                          use_collectives=False)
+        except BaseException as e:  # re-raised at finalize()
+            err.append(e)
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name="sharded-ckpt-writer")
+    t.start()
+
+    def finalize():
+        t.join()
+        if err:
+            raise err[0]
+
+    return finalize
 
 
 def load_sharded(prefix, mesh, param_specs=None):

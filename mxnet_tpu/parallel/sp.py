@@ -267,13 +267,17 @@ class SequenceParallelTrainer:
                 for n, v in self.params.items()}
 
     # -- sharded (per-process) checkpointing ---------------------------
-    def save_sharded_checkpoint(self, prefix, step=None):
+    def save_sharded_checkpoint(self, prefix, step=None,
+                                async_write=False):
         """Per-process shard files (parallel/checkpoint.py); includes
-        optimizer state and the step counter. Call from ALL processes."""
+        optimizer state and the step counter. Call from ALL processes.
+        ``async_write=True`` overlaps the file IO with training; call
+        the returned finalize() before exiting/restoring."""
         from .checkpoint import save_sharded, flatten_train_state
         flat = flatten_train_state(self.params, self.opt_state)
-        save_sharded(prefix, flat,
-                     step=self._t if step is None else step)
+        return save_sharded(prefix, flat,
+                            step=self._t if step is None else step,
+                            async_write=async_write)
 
     def restore_sharded_checkpoint(self, prefix):
         """Works on a freshly constructed trainer (no init_params

@@ -19,6 +19,7 @@ multi-device loop, bit-for-bit modulo reduction order.
 """
 from __future__ import annotations
 
+import functools
 import logging
 import os
 import time
@@ -429,35 +430,97 @@ class ParallelTrainer:
         with self.mesh:
             return self._jit_eval(self.params, self.aux, batch, self._rng)
 
+    def _device_metric_fns(self):
+        """Cached (update, zero_state) for the device-side accuracy
+        accumulator — compiled once per trainer, not per fit() call."""
+        cached = getattr(self, "_jit_acc", None)
+        if cached is not None:
+            return cached
+        from jax.sharding import NamedSharding
+        repl = NamedSharding(self.mesh, P())
+
+        @functools.partial(jax.jit, out_shardings=repl)
+        def _acc_update(state, out, label):
+            pred = jnp.argmax(out, axis=-1)
+            ok = jnp.sum((pred == label.astype(pred.dtype))
+                         .astype(jnp.float32))
+            return state[0] + ok, state[1] + jnp.float32(label.size)
+
+        def _zero_state():
+            z = jax.device_put(np.float32(0), repl)
+            return (z, z)
+
+        self._jit_acc = (_acc_update, _zero_state)
+        return self._jit_acc
+
     # ------------------------------------------------------------------
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             num_epoch=1, batch_end_callback=None, epoch_end_callback=None,
-            logger=None):
+            logger=None, device_metric=False):
         """Epoch loop over a DataIter, mirroring FeedForward.fit's protocol
-        (metrics, Speedometer-style callbacks) on the fused step."""
+        (metrics, Speedometer-style callbacks) on the fused step.
+
+        ``device_metric=True`` (accuracy only): the per-batch metric
+        update runs as jitted device ops accumulating a (correct, total)
+        pair — NO host synchronization inside the epoch, one scalar
+        fetch at epoch end. On relay/tunnel environments a per-batch
+        host sync costs ~0.9 s (doc/performance.md); this keeps the
+        step stream fully async. Batch-end callbacks still see the
+        metric object but its value only materializes at epoch end.
+        """
         from ..model import BatchEndParam, _run_callbacks
         if logger is None:
             logger = logging
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
+        if device_metric and not isinstance(eval_metric,
+                                            metric_mod.Accuracy):
+            raise MXNetError("device_metric=True supports the accuracy "
+                             "metric only")
+        if device_metric and jax.process_count() > 1:
+            # outs are GLOBAL arrays but each process holds only its
+            # local label slice; feeding it as a replicated operand
+            # would be shape-wrong/inconsistent across controllers
+            raise MXNetError("device_metric=True is single-process "
+                             "only; use the host metric path in "
+                             "multi-process runs")
         data_names = [x[0] for x in train_data.provide_data]
         label_names = [x[0] for x in train_data.provide_label]
+        _acc_update, _zero_state = self._device_metric_fns()
+
+        self.last_train_metric = None
         for epoch in range(num_epoch):
             train_data.reset()
             eval_metric.reset()
+            acc_state = _zero_state()
             tic = time.time()
             for nbatch, dbatch in enumerate(train_data):
                 batch = dict(zip(data_names, dbatch.data))
                 batch.update(zip(label_names, dbatch.label))
                 outs = self.step(batch)
-                out_nds = [nd.array(np.asarray(o)) for o in outs]
-                eval_metric.update(dbatch.label, out_nds)
+                if device_metric:
+                    # pass the label as UNCOMMITTED host numpy so jit
+                    # places it on the mesh with the other operands
+                    lab = dbatch.label[0]
+                    lab = lab.asnumpy() if isinstance(lab, NDArray) \
+                        else np.asarray(lab)
+                    acc_state = _acc_update(acc_state, outs[0], lab)
+                else:
+                    out_nds = [nd.array(np.asarray(o)) for o in outs]
+                    eval_metric.update(dbatch.label, out_nds)
                 if batch_end_callback is not None:
                     _run_callbacks(batch_end_callback, BatchEndParam(
                         epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
                         locals=locals()))
+            if device_metric:
+                correct, total = (float(acc_state[0]),
+                                  float(acc_state[1]))  # ONE host sync
+                name, value = "accuracy", correct / max(total, 1.0)
+            else:
+                name, value = eval_metric.get()
+            self.last_train_metric = (name, value)
             logger.info("Epoch[%d] Train-%s=%f time=%.3f", epoch,
-                        *eval_metric.get(), time.time() - tic)
+                        name, value, time.time() - tic)
             if epoch_end_callback is not None:
                 ap, xp = self.get_params()
                 for cb in (epoch_end_callback
@@ -500,15 +563,20 @@ class ParallelTrainer:
         return self.init_params(arg_params, aux_params)
 
     # -- sharded (per-process) checkpointing ---------------------------
-    def save_sharded_checkpoint(self, prefix, step=None):
+    def save_sharded_checkpoint(self, prefix, step=None,
+                                async_write=False):
         """Write params + optimizer state + aux as per-process shard
         files (parallel/checkpoint.py) — checkpointing for models that
-        only exist sharded across the mesh. Call from ALL processes."""
+        only exist sharded across the mesh. Call from ALL processes.
+        With ``async_write=True`` the device snapshot happens now and
+        the file IO overlaps subsequent steps; returns a finalize()
+        callable to join the writer (no-op when synchronous)."""
         from .checkpoint import save_sharded, flatten_train_state
         flat = flatten_train_state(self.params, self.opt_state,
                                    self.aux_names, self.aux)
-        save_sharded(prefix, flat,
-                     step=self._t if step is None else step)
+        return save_sharded(prefix, flat,
+                            step=self._t if step is None else step,
+                            async_write=async_write)
 
     def restore_sharded_checkpoint(self, prefix):
         """Inverse of :meth:`save_sharded_checkpoint`; restores params,

@@ -948,3 +948,68 @@ def test_grad_accum_matches_full_batch():
         np.testing.assert_allclose(got[n].asnumpy(), want[n].asnumpy(),
                                    rtol=2e-5, atol=2e-6, err_msg=n)
     np.testing.assert_allclose(out4, out1, rtol=2e-5, atol=2e-6)
+
+
+def test_sharded_checkpoint_async_write(tmp_path):
+    """async_write=True snapshots device state synchronously (donated
+    buffers may be overwritten by the next step) and writes on a
+    background thread; the restored checkpoint reflects the state AT
+    SAVE TIME, not at finalize time."""
+    sym = _mlp_symbol()
+    rng = np.random.RandomState(0)
+    data = rng.randn(16, 64).astype(np.float32)
+    label = rng.randint(0, 10, (16,)).astype(np.float32)
+    shapes = {"data": (16, 64), "softmax_label": (16,)}
+    mesh = par.build_mesh({"dp": 8})
+    tr = par.ParallelTrainer(sym, shapes, optimizer="sgd", mesh=mesh,
+                             optimizer_params={"learning_rate": 0.1,
+                                               "momentum": 0.9})
+    tr.init_params()
+    tr.step({"data": data, "softmax_label": label})
+    want, _ = tr.get_params()
+    prefix = str(tmp_path / "ck")
+    fin = tr.save_sharded_checkpoint(prefix, async_write=True)
+    # keep training WHILE the writer runs (donation overwrites buffers)
+    for _ in range(3):
+        tr.step({"data": data, "softmax_label": label})
+    fin()
+    tr2 = par.ParallelTrainer(sym, shapes, optimizer="sgd", mesh=mesh,
+                              optimizer_params={"learning_rate": 0.1,
+                                                "momentum": 0.9})
+    tr2.restore_sharded_checkpoint(prefix)
+    assert tr2._t == 1
+    for n, v in tr2.params.items():
+        np.testing.assert_array_equal(np.asarray(jax.device_get(v)),
+                                      want[n].asnumpy(), err_msg=n)
+
+
+def test_fit_device_metric_matches_host_metric():
+    """device_metric=True accumulates accuracy as device ops (no host
+    sync inside the epoch) and must report the same value as the host
+    metric path."""
+    rng = np.random.RandomState(42)
+    n = 256
+    x = rng.randn(n, 16).astype(np.float32)
+    w_true = rng.randn(16, 3).astype(np.float32)
+    y = np.argmax(x @ w_true, axis=1).astype(np.float32)
+    data = mx.symbol.Variable("data")
+    fc = mx.symbol.FullyConnected(data=data, name="fc", num_hidden=3)
+    sym = mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+
+    def run(device_metric):
+        it = mx.io.NDArrayIter(x, y, batch_size=64, shuffle=False)
+        tr = par.ParallelTrainer(
+            sym, {"data": (64, 16), "softmax_label": (64,)},
+            optimizer="sgd", mesh=par.data_parallel_mesh(),
+            optimizer_params={"learning_rate": 0.5})
+        prng = np.random.RandomState(5)
+        tr.init_params({"fc_weight": mx.nd.array(
+            prng.uniform(-0.1, 0.1, (3, 16)).astype("f")),
+            "fc_bias": mx.nd.zeros((3,))})
+        tr.fit(it, num_epoch=3, device_metric=device_metric)
+        return tr.last_train_metric
+
+    name_d, val_d = run(True)
+    name_h, val_h = run(False)
+    assert name_d == name_h == "accuracy"
+    assert abs(val_d - val_h) < 1e-6, (val_d, val_h)

