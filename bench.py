@@ -22,6 +22,7 @@ import json
 import os
 import time
 
+import jax
 import numpy as np
 
 NORTH_STAR_IMG_PER_SEC = 2000.0   # ResNet-50 target, img/s/chip

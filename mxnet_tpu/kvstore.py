@@ -226,9 +226,34 @@ class KVStore:
                 self._ps.set_optimizer(pickle.dumps(updater))
             self.barrier()
             return
+        if self._is_dist and _num_processes() > 1:
+            self._sync_rng()
         self._updater = updater
 
     set_updater = _set_updater
+
+    def _sync_rng(self):
+        """dist_sync applies the updater independently on every process's
+        replica of the store, so an updater that draws from the global
+        ``mx.random`` stream (e.g. SGLD's noise) must draw IDENTICAL
+        values everywhere or the replicas silently diverge, breaking the
+        BSP identical-params invariant. Broadcast a seed drawn from RANK
+        0's OWN mx.random stream: with the same starting key and the
+        same (key, order) push sequence under BSP, every process's
+        updater-visible stream stays in lockstep — the same fix as the
+        sp trainer's replicated fwd rng. Deriving from rank 0's stream
+        (not numpy's global RNG) keeps user-requested determinism: after
+        ``mx.random.seed(42)`` on every process, the broadcast value —
+        and so the whole run — is reproducible, and no process's numpy
+        state is touched."""
+        import jax
+        from . import random as mx_random
+        seed = np.zeros((1,), np.int64)
+        if self.rank == 0:
+            seed[0] = int(jax.random.randint(
+                mx_random._next_key(), (), 0, 2 ** 31 - 1))
+        shared = _allreduce_dcn(seed, shard_big=False)
+        mx_random.seed(int(np.asarray(shared)[0]))
 
     def set_optimizer(self, optimizer):
         """Use an optimizer as the updater. In dist mode the reference
@@ -323,7 +348,15 @@ def _allreduce_dcn(val, shard_big=True):
     nlocal = len(jax.local_devices())
     x = np.asarray(val)
     big = shard_big and x.size >= _bigarray_bound()
-    rows = np.broadcast_to(x[None] / nlocal, (nlocal,) + x.shape)
+    # Contribute the full value on local row 0 and zeros on the other
+    # local rows: the global sum is then exactly the cross-process sum in
+    # the INPUT dtype — no x/nlocal pre-division, which would silently
+    # promote integer stores to float and round low-precision floats.
+    if nlocal == 1:
+        rows = x[None]
+    else:
+        rows = np.zeros((nlocal,) + x.shape, dtype=x.dtype)
+        rows[0] = x
     in_sh = NamedSharding(mesh, P("dcn", *([None] * x.ndim)))
     stacked = jax.make_array_from_process_local_data(in_sh, rows)
 
@@ -335,7 +368,9 @@ def _allreduce_dcn(val, shard_big=True):
             out_sh = NamedSharding(mesh, P("dcn", *([None] * (x.ndim - 1))))
 
             def reduce_fn(a):
-                s = a.sum(axis=0)
+                # dtype= pins the accumulator: x64 numpy promotion rules
+                # would return int64 for int32 inputs
+                s = a.sum(axis=0, dtype=a.dtype)
                 if pad_to != s.shape[0]:
                     s = jax.numpy.pad(
                         s, [(0, pad_to - s.shape[0])] +
@@ -345,7 +380,7 @@ def _allreduce_dcn(val, shard_big=True):
             out_sh = NamedSharding(mesh, P())
 
             def reduce_fn(a):
-                return a.sum(axis=0)
+                return a.sum(axis=0, dtype=a.dtype)
         _dcn_state[key] = jax.jit(reduce_fn, out_shardings=out_sh)
     out = _dcn_state[key](stacked)
     if big:

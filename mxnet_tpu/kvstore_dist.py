@@ -45,9 +45,25 @@ __all__ = ["PSBackend"]
 
 _LEN = struct.Struct("!Q")
 
+# SECURITY: the wire format is pickle, and ``pickle.loads`` on attacker
+# bytes is remote code execution. Like ps-lite's ZMQ, this transport
+# assumes a TRUSTED private cluster network. Set
+# ``MXNET_KVSTORE_SECRET`` (any shared string, exported to every
+# process — tools/launch.py forwards env) to require an HMAC-SHA256 tag
+# on every message, rejecting frames from anything that doesn't hold
+# the secret. Do NOT expose the server port beyond the cluster.
+
+
+def _secret():
+    return os.environ.get("MXNET_KVSTORE_SECRET", "").encode()
+
 
 def _send_msg(sock, obj):
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sec = _secret()
+    if sec:
+        import hmac
+        payload += hmac.new(sec, payload, "sha256").digest()
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
@@ -63,7 +79,21 @@ def _recv_exact(sock, n):
 
 def _recv_msg(sock):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, n))
+    payload = _recv_exact(sock, n)
+    sec = _secret()
+    if sec:
+        import hmac
+        if len(payload) < 32:
+            raise MXNetError("kvstore dist_async: short frame under "
+                             "MXNET_KVSTORE_SECRET")
+        payload, tag = payload[:-32], payload[-32:]
+        want = hmac.new(sec, payload, "sha256").digest()
+        if not hmac.compare_digest(tag, want):
+            raise MXNetError(
+                "kvstore dist_async: HMAC verification failed — peer "
+                "does not hold MXNET_KVSTORE_SECRET (refusing to "
+                "unpickle untrusted bytes)")
+    return pickle.loads(payload)
 
 
 def _port_base():
@@ -81,15 +111,22 @@ class _Server(threading.Thread):
     """One server thread: owns a slice of the key space; applies pushes
     immediately (async semantics). Daemon — dies with the process."""
 
-    def __init__(self, rank):
+    def __init__(self, rank, port):
         super().__init__(daemon=True, name="mxnet-ps-server-%d" % rank)
         self.rank = rank
         self.store = {}        # (key, part) -> np.ndarray
         self.updater = None
         self.lock = threading.Lock()
+        self.conns = []        # accepted sockets — see close()
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.sock.bind(("0.0.0.0", _port_base() + rank))
+        try:
+            self.sock.bind(("0.0.0.0", port))
+        except OSError as e:
+            raise MXNetError(
+                "dist_async: cannot bind parameter-server port %d (%s). "
+                "Another job on this host owns it — set "
+                "MXNET_KVSTORE_PORT_BASE to a free range." % (port, e))
         self.sock.listen(64)
 
     def run(self):
@@ -98,8 +135,27 @@ class _Server(threading.Thread):
                 conn, _ = self.sock.accept()
             except OSError:
                 return  # socket closed at shutdown
+            with self.lock:
+                self.conns.append(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
+
+    def close(self):
+        """Close the listener AND every accepted connection: on Linux an
+        ESTABLISHED accepted socket still counts as bound to the port,
+        so a successor server could not re-bind until they are gone
+        (SO_REUSEADDR only covers TIME_WAIT)."""
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        with self.lock:
+            conns, self.conns = self.conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def _serve(self, conn):
         try:
@@ -157,15 +213,45 @@ class _Server(threading.Thread):
                     _send_msg(conn, ("err", "bad op %r" % (op,)))
         except (ConnectionError, EOFError):
             pass
+        except BaseException:
+            # a dying server thread must not be silent: the peer only
+            # sees a connection reset with no cause
+            import traceback
+            logging.error("parameter server %d: handler crashed:\n%s",
+                          self.rank, traceback.format_exc())
         finally:
             conn.close()
 
 
 class PSBackend:
-    """Worker-side client + this process's colocated server."""
+    """Worker-side client + this process's colocated server.
+
+    One live backend per process (like one ps-lite van per process):
+    creating a new dist_async store closes the previous backend's
+    sockets first — GC cannot be relied on to run ``close()`` before
+    the new server binds the same port, because the server THREAD
+    object stays registered in ``threading`` while its accept loop
+    runs. Sequential store lifetimes only; two concurrently-used
+    dist_async stores in one process are not supported (they weren't
+    in the reference either — one ps-lite customer id per role).
+    """
+
+    _live = None
+    _generation = 0
 
     def __init__(self):
         import jax
+        if PSBackend._live is not None:
+            PSBackend._live.close()
+            PSBackend._live = None
+        # each store generation gets a fresh port block: even after
+        # close(), peer-held FIN_WAIT sockets keep the OLD ports bound
+        # on Linux, so re-binding them is not reliable. Store creation
+        # is collective (every process creates stores in the same
+        # order), so the generation — and thus the port map — agrees
+        # across processes without communication.
+        PSBackend._generation += 1
+        self.generation = PSBackend._generation
         self.rank = jax.process_index()
         self.nserv = jax.process_count()
         hosts = os.environ.get("MXNET_KVSTORE_SERVER_HOSTS")
@@ -177,7 +263,7 @@ class PSBackend:
                     "processes" % (len(self.hosts), self.nserv))
         else:
             self.hosts = ["127.0.0.1"] * self.nserv
-        self.server = _Server(self.rank)
+        self.server = _Server(self.rank, self._port(self.rank))
         self.server.start()
         self._conns = {}
         self._lock = threading.Lock()
@@ -185,23 +271,53 @@ class PSBackend:
         # make sure every server is listening before anyone pushes
         from . import distributed
         distributed.barrier("ps_backend_up")
+        PSBackend._live = self
         logging.info("dist_async parameter server up: rank %d/%d",
                      self.rank, self.nserv)
+
+    def _port(self, server):
+        return _port_base() + (self.generation - 1) * self.nserv + server
 
     # -- transport ----------------------------------------------------
     def _conn_locked(self, server):
         c = self._conns.get(server)
         if c is None:
+            # generous timeout: on oversubscribed test hosts a peer can
+            # legitimately stall for minutes inside an XLA compile; a
+            # DEAD peer is detected by TCP reset, not by idleness
+            # (ps-lite likewise waits on its van). Override with
+            # MXNET_KVSTORE_TIMEOUT (seconds).
             c = socket.create_connection(
-                (self.hosts[server], _port_base() + server), timeout=120)
+                (self.hosts[server], self._port(server)),
+                timeout=float(os.environ.get("MXNET_KVSTORE_TIMEOUT",
+                                             "600")))
             self._conns[server] = c
         return c
 
     def _request(self, server, msg):
-        with self._lock:  # one in-flight request per worker (like the
-            c = self._conn_locked(server)  # engine var serializing pushes)
-            _send_msg(c, msg)
-            reply = _recv_msg(c)
+        try:
+            with self._lock:  # one in-flight request per worker (like
+                c = self._conn_locked(server)  # the engine var
+                _send_msg(c, msg)              # serializing pushes)
+                reply = _recv_msg(c)
+        except (ConnectionError, socket.timeout, OSError) as e:
+            # a dead/unreachable server is a cluster failure, not a bug
+            # in the caller: name the peer so the operator can act (the
+            # reference's ps-lite likewise aborts the run when a server
+            # van connection drops)
+            with self._lock:
+                stale = self._conns.pop(server, None)
+            if stale is not None:
+                try:
+                    stale.close()
+                except OSError:
+                    pass
+            raise MXNetError(
+                "dist_async: parameter server %d (%s:%d) is unreachable "
+                "or died mid-request (%s: %s). The key range it owned "
+                "is lost; restart the job from the last checkpoint."
+                % (server, self.hosts[server], self._port(server),
+                   type(e).__name__, e))
         if reply[0] != "ok":
             raise MXNetError("parameter server: %s" % (reply[1],))
         return reply[1] if len(reply) > 1 else None
@@ -259,7 +375,6 @@ class PSBackend:
                 except OSError:
                     pass
             self._conns.clear()
-        try:
-            self.server.sock.close()
-        except OSError:
-            pass
+        self.server.close()
+        if PSBackend._live is self:
+            PSBackend._live = None

@@ -122,6 +122,35 @@ class RMSE(EvalMetric):
             self.num_inst += 1
 
 
+class TopKAccuracy(EvalMetric):
+    """Top-k classification accuracy: correct if the true label is among
+    the k highest-scoring classes (k=1 degenerates to Accuracy)."""
+
+    def __init__(self, top_k=5):
+        if top_k < 1:
+            raise ValueError("top_k must be >= 1")
+        self.top_k = int(top_k)
+        super().__init__("top_k_accuracy_%d" % self.top_k)
+
+    def update(self, labels, preds):
+        if len(labels) != len(preds):
+            raise MXNetError("labels and preds length mismatch")
+        for label, pred in zip(labels, preds):
+            pred = _as_numpy(pred)
+            label = _as_numpy(label).ravel().astype(numpy.int64)
+            if pred.shape[1] <= self.top_k:
+                raise MXNetError(
+                    "top_k_accuracy_%d is meaningless for %d classes "
+                    "(every label is trivially in the top %d) — use a "
+                    "smaller top_k" % (self.top_k, pred.shape[1],
+                                       self.top_k))
+            k = self.top_k
+            topk = numpy.argpartition(pred, -k, axis=1)[:, -k:]
+            self.sum_metric += int((topk == label[:, None]).any(axis=1)
+                                   .sum())
+            self.num_inst += label.shape[0]
+
+
 class CrossEntropy(EvalMetric):
     def __init__(self):
         super().__init__("cross-entropy")
@@ -170,6 +199,7 @@ def create(metric):
     metrics = {"acc": Accuracy, "accuracy": Accuracy, "f1": F1, "mae": MAE,
                "mse": MSE, "rmse": RMSE, "ce": CrossEntropy,
                "cross-entropy": CrossEntropy,
+               "top_k_accuracy": TopKAccuracy, "top_k_acc": TopKAccuracy,
                "torch": lambda: Torch()}
     try:
         return metrics[metric.lower()]()

@@ -6,6 +6,13 @@ executors firing per-node output statistics every `interval` batches
 graph_executor.cc:803-817). Here the executor's monitor path evaluates the
 graph node-by-node (the NaiveEngine-style debug path) so every internal
 output can be observed.
+
+COST: on a monitored batch the executor runs ONE extra compiled program
+that returns every matching internal output (executor.py) — roughly 2x
+the normal step time plus the d2h transfer of all monitored tensors.
+That is the same order as the reference's per-node callback (which
+serialized the engine), but don't leave a Monitor installed while
+profiling or benchmarking.
 """
 from __future__ import annotations
 

@@ -30,7 +30,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "fused_linear"]
+__all__ = ["flash_attention", "fused_linear", "striped_pair_attention"]
 
 
 def _use_interpret():
@@ -313,6 +313,281 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=128,
                       tq, tk)
     out = out[:, :tq]
     return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# striped pair attention — the half-block kernel for striped ring
+# attention (parallel/ring.py striped_ring_attention).
+#
+# Under the STRIPED sequence layout, ring device ``my`` holds tokens at
+# global positions {a*n + my}; at each hop it attends its queries against
+# the K/V block of ring position ``src`` (tokens {b*n + src}). The causal
+# mask is then a*n + q_off >= b*n + k_off — a near-triangle for EVERY
+# (my, src) pair, so per-hop FLOPs are balanced across the ring (striped
+# attention), unlike the contiguous layout where device 0 masks almost
+# everything and device n-1 almost nothing. These kernels skip key
+# blocks entirely above the position diagonal (the dynamic fori bound),
+# so each hop really costs ~half a block, and emit/consume the per-row
+# logsumexp so partial results merge exactly via streaming softmax.
+# (q_off, k_off) arrive as an SMEM scalar operand — they are traced ring
+# indices, different on every device and hop.
+
+
+def _spair_fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      block_q, block_k, seq_k, n_stride, scale):
+    qi = pl.program_id(1)
+    q_off = offs_ref[0]
+    k_off = offs_ref[1]
+    q = q_ref[0].astype(jnp.float32)  # [bq, D]
+    bq, d = q.shape
+    ns = jnp.int32(n_stride)
+    nkb_static = int(pl.cdiv(seq_k, block_k))
+    # last key block with any valid pair: max qpos >= min kpos
+    numer = ((qi + 1) * jnp.int32(block_q) - 1) * ns + q_off - k_off
+    nkb = jnp.minimum(jnp.int32(nkb_static),
+                      lax.div(numer, jnp.int32(block_k) * ns) + 1)
+    nkb = jnp.maximum(nkb, jnp.int32(0))
+    neg_big = jnp.float32(-1e30)
+
+    def body(j, carry):
+        o, l, m = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        qrow = qi * block_q + lax.broadcasted_iota(jnp.int32,
+                                                   (bq, block_k), 0)
+        kcol = j * block_k + lax.broadcasted_iota(jnp.int32,
+                                                  (bq, block_k), 1)
+        mask = (kcol < seq_k) & (qrow * ns + q_off >= kcol * ns + k_off)
+        s = jnp.where(mask, s, neg_big)
+        new_m = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - new_m), 0.0)
+        corr = jnp.exp(m - new_m)
+        new_l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        new_o = o * corr + jnp.dot(p, v,
+                                   preferred_element_type=jnp.float32)
+        return new_o, new_l, new_m
+
+    o0 = jnp.zeros((bq, d), jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    m0 = jnp.full((bq, 1), neg_big, jnp.float32)
+    o, l, m = lax.fori_loop(jnp.int32(0), nkb, body, (o0, l0, m0))
+    # rows with no valid keys (l == 0): o = 0, lse = -big so the merge
+    # weights them to zero
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), neg_big)
+    o_ref[0] = (o / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0] = lse
+
+
+def _spair_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                     dcap_ref, dq_ref, *, block_q, block_k, seq_k,
+                     n_stride, scale):
+    qi = pl.program_id(1)
+    q_off = offs_ref[0]
+    k_off = offs_ref[1]
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    dcap = dcap_ref[0]
+    bq, d = q.shape
+    ns = jnp.int32(n_stride)
+    nkb_static = int(pl.cdiv(seq_k, block_k))
+    numer = ((qi + 1) * jnp.int32(block_q) - 1) * ns + q_off - k_off
+    nkb = jnp.minimum(jnp.int32(nkb_static),
+                      lax.div(numer, jnp.int32(block_k) * ns) + 1)
+    nkb = jnp.maximum(nkb, jnp.int32(0))
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        qrow = qi * block_q + lax.broadcasted_iota(jnp.int32,
+                                                   (bq, block_k), 0)
+        kcol = j * block_k + lax.broadcasted_iota(jnp.int32,
+                                                  (bq, block_k), 1)
+        mask = (kcol < seq_k) & (qrow * ns + q_off >= kcol * ns + k_off)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dcap) * scale
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((bq, d), jnp.float32)
+    dq = lax.fori_loop(jnp.int32(0), nkb, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _spair_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      dcap_ref, dk_ref, dv_ref, *, block_q, block_k,
+                      seq_q, seq_k, n_stride, scale):
+    ki = pl.program_id(1)
+    q_off = offs_ref[0]
+    k_off = offs_ref[1]
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+    ns = jnp.int32(n_stride)
+    nqb = jnp.int32(int(pl.cdiv(seq_q, block_q)))
+    # first query block with any valid pair: max kpos <= max qpos in blk
+    # a valid iff a*ns + q_off >= ki*block_k*ns + k_off
+    amin = ki * jnp.int32(block_k) + \
+        jnp.where(k_off > q_off, jnp.int32(1), jnp.int32(0))
+    lo = jnp.maximum(lax.div(amin, jnp.int32(block_q)), jnp.int32(0))
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]
+        dcap = dcap_ref[0, pl.ds(i * block_q, block_q), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        qrow = i * block_q + lax.broadcasted_iota(jnp.int32,
+                                                  (block_q, bk), 0)
+        kcol = ki * block_k + lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, bk), 1)
+        mask = (kcol < seq_k) & (qrow < seq_q) & \
+            (qrow * ns + q_off >= kcol * ns + k_off)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dcap) * scale
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = lax.fori_loop(lo, nqb, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _spair_specs(tq, tk, block_q, d):
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, np.int32(0)))
+    kfull = pl.BlockSpec((1, tk, d), lambda b, i: (b, np.int32(0),
+                                                   np.int32(0)))
+    rowq = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, np.int32(0)))
+    return smem, qspec, kfull, rowq
+
+
+def _spair_fwd(q, k, v, offs, n_stride, scale, block_q, block_k,
+               interpret, true_tk):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    smem, qspec, kfull, rowq = _spair_specs(tq, tk, block_q, d)
+    return pl.pallas_call(
+        functools.partial(_spair_fwd_kernel, block_q=block_q,
+                          block_k=block_k, seq_k=true_tk,
+                          n_stride=n_stride, scale=scale),
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32)],
+        grid=(bh, tq // block_q),
+        in_specs=[smem, qspec, kfull, kfull],
+        out_specs=[qspec, rowq],
+        interpret=interpret,
+    )(offs, q, k, v)
+
+
+def _spair_bwd_impl(q, k, v, o, lse, offs, g_o, g_lse, n_stride, scale,
+                    block_q, block_k, interpret, true_tq, true_tk):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    # softmax-jacobian row term, with the lse cotangent folded in:
+    # ds = p*(dp - D) + g_lse*p  ==  p*(dp - (D - g_lse))
+    dcap = jnp.sum(g_o.astype(jnp.float32) * o.astype(jnp.float32),
+                   axis=-1, keepdims=True) - g_lse.astype(jnp.float32)
+    smem, qspec, kfull, rowq = _spair_specs(tq, tk, block_q, d)
+    qfull = pl.BlockSpec((1, tq, d), lambda b, i: (b, np.int32(0),
+                                                   np.int32(0)))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, np.int32(0)))
+    rowfull = pl.BlockSpec((1, tq, 1), lambda b, i: (b, np.int32(0),
+                                                     np.int32(0)))
+    kw = dict(block_q=block_q, block_k=block_k, n_stride=n_stride,
+              scale=scale)
+    dq = pl.pallas_call(
+        functools.partial(_spair_dq_kernel, seq_k=true_tk, **kw),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(bh, tq // block_q),
+        in_specs=[smem, qspec, kfull, kfull, qspec, rowq, rowq],
+        out_specs=qspec,
+        interpret=interpret,
+    )(offs, q, k, v, g_o, lse, dcap)
+    dk, dv = pl.pallas_call(
+        functools.partial(_spair_dkv_kernel, seq_q=true_tq,
+                          seq_k=true_tk, **kw),
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        grid=(bh, tk // block_k),
+        in_specs=[smem, qfull, kspec, kspec, qfull, rowfull, rowfull],
+        out_specs=[kspec, kspec],
+        interpret=interpret,
+    )(offs, q, k, v, g_o, lse, dcap)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _spair_core(q, k, v, offs, n_stride, scale, block_q, block_k,
+                interpret, true_tk):
+    return _spair_fwd(q, k, v, offs, n_stride, scale, block_q, block_k,
+                      interpret, true_tk)
+
+
+def _spair_core_fwd(q, k, v, offs, n_stride, scale, block_q, block_k,
+                    interpret, true_tk):
+    o, lse = _spair_fwd(q, k, v, offs, n_stride, scale, block_q, block_k,
+                        interpret, true_tk)
+    return (o, lse), (q, k, v, o, lse, offs)
+
+
+def _spair_core_bwd(n_stride, scale, block_q, block_k, interpret, true_tk,
+                    res, gs):
+    q, k, v, o, lse, offs = res
+    g_o, g_lse = gs
+    tq = q.shape[1]
+    dq, dk, dv = _spair_bwd_impl(q, k, v, o, lse, offs, g_o, g_lse,
+                                 n_stride, scale, block_q, block_k,
+                                 interpret, tq, true_tk)
+    d_offs = np.zeros(offs.shape, jax.dtypes.float0)
+    return dq, dk, dv, d_offs
+
+
+_spair_core.defvjp(_spair_core_fwd, _spair_core_bwd)
+
+
+def striped_pair_attention(q, k, v, q_off, k_off, *, n_stride, scale=None,
+                           block_q=128, block_k=128, interpret=None):
+    """One striped ring hop: flash attention of the local query block
+    against one arriving K/V block under the striped causal mask
+    ``(a*n + q_off) >= (b*n + k_off)``.
+
+    q, k, v: [BH, C, D] (C = T/n local length; C must divide into the
+    block sizes after internal clamping). ``q_off``/``k_off``: traced
+    int32 ring positions. Returns ``(o, lse)`` — o normalized over the
+    VALID keys of this block, lse the per-row logsumexp (-1e30 where no
+    key is valid) — merge partials with ``jnp.logaddexp`` streaming
+    softmax. Differentiable (custom_vjp; the lse cotangent folds into
+    the flash backward's dcap term).
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    block_q = min(block_q, _round_up(tq, 8))
+    block_k = min(block_k, _round_up(tk, 8))
+
+    def padt(x, t, blk):
+        tp = _round_up(t, blk)
+        if tp != t:
+            x = jnp.pad(x, ((0, 0), (0, tp - t), (0, 0)))
+        return x
+
+    qp = padt(q, tq, block_q)
+    kp, vp = padt(k, tk, block_k), padt(v, tk, block_k)
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+    o, lse = _spair_core(qp, kp, vp, offs, int(n_stride), float(scale),
+                         block_q, block_k, interpret, tk)
+    return o[:, :tq], lse[:, :tq]
 
 
 # ---------------------------------------------------------------------------

@@ -26,6 +26,6 @@ from .sp import SequenceParallelTrainer  # noqa: F401
 from .checkpoint import save_sharded, load_sharded  # noqa: F401
 from . import collectives  # noqa: F401
 from .ring import (ring_attention, blockwise_attention,  # noqa: F401
-                   ring_self_attention)
+                   ring_self_attention, striped_ring_attention)
 from .pipeline import (pipeline_spmd, partition_stages,  # noqa: F401
                        PipelineTrainer)

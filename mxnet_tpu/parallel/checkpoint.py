@@ -99,7 +99,11 @@ def _write_shards(prefix, blobs, manifest, use_collectives=True):
     tmp = "%s-shards-p%d.tmp.npz" % (prefix, rank)  # np.savez needs .npz
     np.savez(tmp, **blobs)
     os.replace(tmp, shard_file)
-    token = manifest["step"]
+    # per-save unique token (async saves; sync saves fall back to step):
+    # marker files and the manifest-ready check match on it, so a stale
+    # manifest from an EARLIER save of the same prefix+step can never
+    # satisfy a waiter, and two concurrent saves don't share markers
+    token = manifest.get("save_token", manifest["step"])
     if nprocs > 1:
         if use_collectives:
             # all shard files must exist before the manifest (the
@@ -140,7 +144,8 @@ def _write_shards(prefix, blobs, manifest, use_collectives=True):
                 def _current():
                     try:
                         with open(mpath) as f:
-                            return json.load(f).get("step") == token
+                            m = json.load(f)
+                        return m.get("save_token", m.get("step")) == token
                     except (OSError, ValueError):
                         return False
                 while not _current():
@@ -169,11 +174,23 @@ def save_sharded(prefix, params, step=0, extra=None, async_write=False):
     0-arg ``finalize`` callable that joins the writer and re-raises any
     write error; call it before exiting (or before restoring). Either
     ALL processes pass async_write or none: the completion barriers
-    must line up."""
+    must line up. Async saves REQUIRE all processes to share one
+    filesystem at ``prefix`` (NFS/GCS-fuse — the reference's dist
+    checkpoints assume the same): the completion protocol is
+    marker-files, not collectives."""
     blobs, manifest = _snapshot_shards(params, step, extra)
     if not async_write:
         _write_shards(prefix, blobs, manifest)
         return lambda: None
+
+    # Per-save unique token, agreed on the MAIN thread where device
+    # collectives are still legal, then matched by the writer thread's
+    # filesystem protocol (see _write_shards).
+    tok = np.array([np.random.randint(0, 2 ** 31 - 1)], np.int32)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        tok = multihost_utils.broadcast_one_to_all(tok)
+    manifest["save_token"] = "%d-%08x" % (step, int(tok[0]) & 0xffffffff)
 
     import threading
     err = []

@@ -24,12 +24,26 @@ attribute:
   ``ppermute`` is the reverse rotation), so gradients drain the pipe in
   reverse order — the same wave 1F1B exploits, scheduled by XLA.
 
-Honest trade-off: parameters are passed replicated and each device
-reads only its own stage's (the non-taken switch branches contribute
-zero gradients, and the cross-stage psum reassembles full gradients).
-That costs parameter HBM compared to per-stage placement, in exchange
-for a single SPMD program; the reference's ``ctx_group`` executor holds
-per-device sub-graphs but runs them serially with host-driven copies.
+Parameter placement (``param_placement``):
+
+* ``"stage"`` (default) — PER-STAGE placement, the memory-scalable
+  form matching the reference's per-device parameter residency
+  (``graph_executor.cc:341-458`` binds each sub-graph's arrays on its
+  own device): every stage's parameters are flattened into one row of
+  a ``[S, P_max]`` f32 buffer sharded over ``pp``, so each device
+  physically holds ONLY its own stage's parameters and optimizer
+  state (plus padding to the largest stage). Inside the compiled step
+  each switch branch statically unflattens its stage's row — no
+  gather, no replication; gradients arrive per-row from the vjp
+  (psum over ``dp`` only). All shipped optimizers are elementwise
+  over (weight, grad, state), so flat-row updates are bit-equivalent
+  to per-name updates. Per-device parameter+optimizer HBM is
+  ``P_max`` ≈ total/S for balanced cuts, instead of the total.
+* ``"replicated"`` — every device holds all parameters (the round-2
+  form, kept for A/B): one SPMD program, non-taken switch branches
+  contribute zero gradients, cross-stage psum reassembles them. Costs
+  parameter HBM; useful when stages are tiny and the psum is cheaper
+  than padding to ``P_max``.
 """
 from __future__ import annotations
 
@@ -206,9 +220,14 @@ class PipelineTrainer:
 
     def __init__(self, symbol, input_shapes, mesh, num_microbatches=None,
                  optimizer="sgd", optimizer_params=None, initializer=None,
-                 seed=0, label_name="softmax_label"):
+                 seed=0, label_name="softmax_label",
+                 param_placement="stage"):
         if "pp" not in mesh.shape:
             raise MXNetError("PipelineTrainer: mesh needs a 'pp' axis")
+        if param_placement not in ("stage", "replicated"):
+            raise MXNetError("param_placement must be 'stage' or "
+                             "'replicated', got %r" % (param_placement,))
+        self.param_placement = param_placement
         if symbol.list_auxiliary_states():
             raise MXNetError("PipelineTrainer: aux states unsupported "
                              "under the SPMD schedule")
@@ -269,6 +288,21 @@ class PipelineTrainer:
                 raise MXNetError("pipeline: input %r consumed by stage "
                                  "%d, must be stage 0" % (n.name, s))
 
+        # per-stage flat layout: stage s's params (topo order) packed
+        # into one padded row of a [S, P_max] buffer sharded over pp
+        self._flat_meta = [[] for _ in range(self.S)]
+        sizes = [0] * self.S
+        for n in symbol._topo():
+            if not n.is_var or n.name not in self.param_names:
+                continue
+            s = self.stage_of[id(n)]
+            shape = self.arg_shapes[n.name]
+            size = int(np.prod(shape)) if shape else 1
+            self._flat_meta[s].append((n.name, shape, sizes[s], size))
+            sizes[s] = sizes[s] + size
+        self._stage_sizes = sizes
+        self._pmax = max(sizes + [1])
+
         if isinstance(optimizer, str):
             okw = dict(optimizer_params or {})
             okw.setdefault("rescale_grad", 1.0 / batch)
@@ -312,18 +346,34 @@ class PipelineTrainer:
         self._boundary_shape = shapes.pop()
 
     # ------------------------------------------------------------------
+    def _init_value(self, name, arg_params):
+        if arg_params and name in arg_params:
+            return np.asarray(_as_jnp(arg_params[name]))
+        arr = nd.zeros(self.arg_shapes[name])
+        self._initializer(name, arr)
+        return np.asarray(arr._val)
+
     def init_params(self, arg_params=None):
+        if self.param_placement == "stage":
+            rows = np.zeros((self.S, self._pmax), np.float32)
+            for s, meta in enumerate(self._flat_meta):
+                for name, shape, off, size in meta:
+                    rows[s, off:off + size] = \
+                        self._init_value(name, arg_params).ravel()
+            row_sh = NamedSharding(self.mesh, P("pp"))
+            self.params = jax.device_put(rows, row_sh)
+            struct = jax.eval_shape(self._opt_init, self.params)
+            out_sh = jax.tree.map(lambda _: row_sh, struct)
+            with self.mesh:
+                self.opt_state = jax.jit(
+                    self._opt_init, out_shardings=out_sh)(self.params)
+            self._t = 0
+            return self
         params = {}
         for name in self.param_names:
-            shape = self.arg_shapes[name]
-            if arg_params and name in arg_params:
-                val = _as_jnp(arg_params[name])
-            else:
-                arr = nd.zeros(shape)
-                self._initializer(name, arr)
-                val = arr._val
+            val = self._init_value(name, arg_params)
             params[name] = jax.device_put(
-                np.asarray(val), NamedSharding(self.mesh, P()))
+                val, NamedSharding(self.mesh, P()))
         with self.mesh:
             self.opt_state = jax.jit(lambda p: {
                 k: self._opt_init(v) for k, v in p.items()})(params)
@@ -389,7 +439,15 @@ class PipelineTrainer:
 
         return branch
 
+    def _stage_param_dict(self, s, row):
+        """Unflatten stage ``s``'s params from its flat row (static
+        slices — resolved at trace time inside the switch branch)."""
+        return {name: row[off:off + size].reshape(shape)
+                for name, shape, off, size in self._flat_meta[s]}
+
     def _build_step(self):
+        if self.param_placement == "stage":
+            return self._build_step_staged()
         S, M = self.S, self.M
         perm = [(i, (i + 1) % S) for i in range(S)]
         param_specs = {n: P() for n in self.param_names}
@@ -479,6 +537,93 @@ class PipelineTrainer:
 
         return jax.jit(step, donate_argnums=(0, 1))
 
+    def _build_step_staged(self):
+        """Per-stage placement: params/opt state are [S, P_max] rows
+        sharded over ``pp``; each device computes with — and updates —
+        only its own row. Gradients need no cross-stage psum (each row's
+        cotangent IS its stage's gradient); with dp, replicas' rows sum
+        over ``dp`` only."""
+        S, M = self.S, self.M
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        data_names = [k for k in self.input_shapes
+                      if k != self.label_name]
+        has_dp = "dp" in self.mesh.shape
+        batch_spec = P(None, "dp") if has_dp else P()
+        row_spec = P("pp")
+        opt_struct = jax.eval_shape(
+            self._opt_init,
+            jax.ShapeDtypeStruct((S, self._pmax), jnp.float32))
+        opt_specs = jax.tree.map(lambda _: row_spec, opt_struct)
+
+        def local_step(params, opt_state, data_mb, label_mb, lr, t_opt,
+                       rng):
+            idx = lax.axis_index("pp")
+            # decorrelate stochastic optimizers (SGLD noise) across
+            # stages — each device owns DIFFERENT params — but keep dp
+            # replicas of the same stage identical (no dp fold)
+            opt_rng = jax.random.fold_in(rng, idx)
+            if has_dp:
+                rng = jax.random.fold_in(rng, lax.axis_index("dp"))
+            row = params[0]  # local view of the pp-sharded [S, Pmax]
+
+            def fwd(r):
+                branches = [self._make_branch(
+                    s, data_mb, label_mb, self._stage_param_dict(s, r),
+                    rng, True) for s in range(S)]
+                state0 = jnp.zeros(self._boundary_shape,
+                                   self._boundary_dtype)
+                out0 = tuple(jnp.zeros((M,) + os_, jnp.float32)
+                             for os_ in self.out_shapes)
+
+                def body(carry, t):
+                    state, outs = carry
+                    y, out_vals = lax.switch(idx, branches, state, t)
+                    w = t - (S - 1)
+                    valid = (idx == S - 1) & (w >= 0) & (w < M)
+                    wc = jnp.clip(w, 0, M - 1)
+                    outs = tuple(
+                        jnp.where(valid,
+                                  lax.dynamic_update_index_in_dim(
+                                      o, v, wc, 0), o)
+                        for o, v in zip(outs, out_vals))
+                    state = lax.ppermute(y, "pp", perm)
+                    return (state, outs), None
+
+                (_, outs), _ = lax.scan(body, (state0, out0),
+                                        jnp.arange(M + S - 1))
+                return tuple(lax.psum(o, "pp") for o in outs)
+
+            out, vjp_fn = jax.vjp(fwd, row)
+            (g,) = vjp_fn(tuple(jnp.ones_like(o) for o in out))
+            if has_dp:
+                g = lax.psum(g, "dp")
+            local_opt = jax.tree.map(lambda a: a[0], opt_state)
+            new_row, new_opt = self._opt_update(row, g, local_opt, lr,
+                                                t_opt, opt_rng)
+            return (new_row[None],
+                    jax.tree.map(lambda a: a[None], new_opt), out)
+
+        mapped = shard_map(
+            local_step, mesh=self.mesh,
+            in_specs=(row_spec, opt_specs,
+                      {k: batch_spec for k in data_names}, batch_spec,
+                      P(), P(), P()),
+            out_specs=(row_spec, opt_specs,
+                       tuple(batch_spec for _ in self.out_shapes)),
+            check_vma=False)
+
+        def step(params, opt_state, data_dict, label, lr, t):
+            t = t + 1
+            rng = jax.random.fold_in(self._rng, t)
+            row = self.dp * self.mb
+            data_mb = {k: v.reshape((self.M, row) + v.shape[1:])
+                       for k, v in data_dict.items()}
+            label_mb = label.reshape((self.M, row) + label.shape[1:])
+            return mapped(params, opt_state, data_mb, label_mb, lr, t,
+                          rng)
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
     # ------------------------------------------------------------------
     def step(self, batch):
         """One pipelined train step on a GLOBAL batch dict. Returns the
@@ -505,5 +650,19 @@ class PipelineTrainer:
         return outs[0] if len(outs) == 1 else outs
 
     def get_params(self):
+        if self.param_placement == "stage":
+            rows = self.params
+            if jax.process_count() > 1:
+                with self.mesh:
+                    rows = jax.jit(lambda x: x,
+                                   out_shardings=NamedSharding(
+                                       self.mesh, P()))(rows)
+            rows = np.asarray(jax.device_get(rows))
+            out = {}
+            for s, meta in enumerate(self._flat_meta):
+                for name, shape, off, size in meta:
+                    out[name] = nd.array(
+                        rows[s, off:off + size].reshape(shape))
+            return out
         return {n: nd.array(np.asarray(jax.device_get(v)))
                 for n, v in self.params.items()}

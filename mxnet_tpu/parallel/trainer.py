@@ -430,28 +430,54 @@ class ParallelTrainer:
         with self.mesh:
             return self._jit_eval(self.params, self.aux, batch, self._rng)
 
-    def _device_metric_fns(self):
-        """Cached (update, zero_state) for the device-side accuracy
-        accumulator — compiled once per trainer, not per fit() call."""
-        cached = getattr(self, "_jit_acc", None)
-        if cached is not None:
-            return cached
+    def _device_metric_fns(self, kind="acc", top_k=1):
+        """Cached (update, zero_state) for a device-side metric
+        accumulator — compiled once per (kind, k), not per fit() call.
+
+        ``kind``: "acc" (argmax match), "topk" (label within top-k
+        scores), or "ce" (summed -log p[label]; assumes the monitored
+        output is a probability distribution, as the reference's
+        CrossEntropy metric does). State is a replicated (sum, count)
+        pair; value = sum / count for all three."""
+        cache = getattr(self, "_jit_metric", None)
+        if cache is None:
+            cache = self._jit_metric = {}
+        if (kind, top_k) in cache:
+            return cache[(kind, top_k)]
         from jax.sharding import NamedSharding
         repl = NamedSharding(self.mesh, P())
 
         @functools.partial(jax.jit, out_shardings=repl)
-        def _acc_update(state, out, label):
-            pred = jnp.argmax(out, axis=-1)
-            ok = jnp.sum((pred == label.astype(pred.dtype))
-                         .astype(jnp.float32))
+        def _update(state, out, label):
+            lab = label.astype(jnp.int32)
+            if kind == "acc":
+                ok = jnp.sum((jnp.argmax(out, axis=-1) == lab)
+                             .astype(jnp.float32))
+            elif kind == "topk":
+                if out.shape[-1] <= int(top_k):
+                    raise MXNetError(
+                        "top-k accuracy with k=%d over %d classes is "
+                        "constant 1.0 — use a smaller top_k"
+                        % (int(top_k), out.shape[-1]))
+                _, idx = jax.lax.top_k(out, int(top_k))
+                ok = jnp.sum(jnp.any(idx == lab[..., None], axis=-1)
+                             .astype(jnp.float32))
+            elif kind == "ce":
+                prob = jnp.take_along_axis(
+                    out, lab.reshape(out.shape[:-1] + (1,)),
+                    axis=-1)[..., 0]
+                ok = jnp.sum(-jnp.log(jnp.maximum(
+                    prob.astype(jnp.float32), 1e-30)))
+            else:  # pragma: no cover
+                raise MXNetError("unknown device metric %r" % (kind,))
             return state[0] + ok, state[1] + jnp.float32(label.size)
 
         def _zero_state():
             z = jax.device_put(np.float32(0), repl)
             return (z, z)
 
-        self._jit_acc = (_acc_update, _zero_state)
-        return self._jit_acc
+        cache[(kind, top_k)] = (_update, _zero_state)
+        return cache[(kind, top_k)]
 
     # ------------------------------------------------------------------
     def fit(self, train_data, eval_data=None, eval_metric="acc",
@@ -473,38 +499,49 @@ class ParallelTrainer:
             logger = logging
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
-        if device_metric and not isinstance(eval_metric,
-                                            metric_mod.Accuracy):
-            raise MXNetError("device_metric=True supports the accuracy "
-                             "metric only")
-        if device_metric and jax.process_count() > 1:
-            # outs are GLOBAL arrays but each process holds only its
-            # local label slice; feeding it as a replicated operand
-            # would be shape-wrong/inconsistent across controllers
-            raise MXNetError("device_metric=True is single-process "
-                             "only; use the host metric path in "
-                             "multi-process runs")
+        if device_metric:
+            if isinstance(eval_metric, metric_mod.TopKAccuracy):
+                dm_kind, dm_k = "topk", eval_metric.top_k
+            elif isinstance(eval_metric, metric_mod.Accuracy):
+                dm_kind, dm_k = "acc", 1
+            elif isinstance(eval_metric, metric_mod.CrossEntropy):
+                dm_kind, dm_k = "ce", 1
+            else:
+                raise MXNetError(
+                    "device_metric=True supports accuracy, top-k "
+                    "accuracy and cross-entropy; got %r"
+                    % (eval_metric.name,))
         data_names = [x[0] for x in train_data.provide_data]
         label_names = [x[0] for x in train_data.provide_label]
-        _acc_update, _zero_state = self._device_metric_fns()
+        if device_metric:
+            _acc_update, _zero_state = self._device_metric_fns(
+                dm_kind, dm_k)
 
         self.last_train_metric = None
         for epoch in range(num_epoch):
             train_data.reset()
             eval_metric.reset()
-            acc_state = _zero_state()
+            acc_state = _zero_state() if device_metric else None
             tic = time.time()
             for nbatch, dbatch in enumerate(train_data):
                 batch = dict(zip(data_names, dbatch.data))
                 batch.update(zip(label_names, dbatch.label))
                 outs = self.step(batch)
                 if device_metric:
-                    # pass the label as UNCOMMITTED host numpy so jit
-                    # places it on the mesh with the other operands
+                    # single-process: uncommitted host numpy, jit places
+                    # it with the other operands. Multi-process: each
+                    # process holds only its local label slice, so build
+                    # the GLOBAL sharded array the same way step() does
+                    # for data (_shard_batch assembles across processes)
                     lab = dbatch.label[0]
-                    lab = lab.asnumpy() if isinstance(lab, NDArray) \
-                        else np.asarray(lab)
-                    acc_state = _acc_update(acc_state, outs[0], lab)
+                    if isinstance(lab, NDArray):
+                        lab = lab._val
+                    lab = np.asarray(lab)
+                    if jax.process_count() > 1:
+                        lab = jax.make_array_from_process_local_data(
+                            self._data_sh[label_names[0]], lab)
+                    with self.mesh:
+                        acc_state = _acc_update(acc_state, outs[0], lab)
                 else:
                     out_nds = [nd.array(np.asarray(o)) for o in outs]
                     eval_metric.update(dbatch.label, out_nds)
@@ -513,9 +550,9 @@ class ParallelTrainer:
                         epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
                         locals=locals()))
             if device_metric:
-                correct, total = (float(acc_state[0]),
-                                  float(acc_state[1]))  # ONE host sync
-                name, value = "accuracy", correct / max(total, 1.0)
+                msum, total = (float(acc_state[0]),
+                               float(acc_state[1]))  # ONE host sync
+                name, value = eval_metric.name, msum / max(total, 1.0)
             else:
                 name, value = eval_metric.get()
             self.last_train_metric = (name, value)

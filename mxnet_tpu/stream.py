@@ -35,9 +35,12 @@ def is_uri(path):
 class _S3Stream(io.BytesIO):
     """Memory-buffered S3 object stream: read pulls the object once,
     write uploads on SUCCESSFUL close (matching dmlc's buffered S3
-    writer). A close during exception unwind (``with`` + raise) ABORTS
-    the upload — publishing a truncated object that "looks complete" is
-    exactly the corruption the local tmp+rename path prevents."""
+    writer). Exception safety: the ``with`` form ABORTS the upload when
+    the body raises, and a stream dropped to GC aborts too — publishing
+    a truncated object that "looks complete" is exactly the corruption
+    the local tmp+rename path prevents. A bare ``close()`` call always
+    publishes (an explicit call is taken as intent); non-``with`` users
+    must call ``abort()`` on their exception paths."""
 
     def __init__(self, uri, mode):
         try:
@@ -67,6 +70,22 @@ class _S3Stream(io.BytesIO):
             self._abort = True
         self.close()
         return False
+
+    def __del__(self):
+        # GC finalization is NOT a successful close: a stream dropped
+        # during exception unwind (no ``with`` block) must never publish
+        # its partial buffer.
+        self._abort = True
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def abort(self):
+        """Discard the buffer: a following close() will NOT upload.
+        Non-``with`` users should call this from their exception path —
+        only the context-manager form aborts automatically."""
+        self._abort = True
 
     def close(self):
         if self._writing and not self.closed and not self._abort:
@@ -101,6 +120,17 @@ class _HdfsWriteStream(io.BytesIO):
             self._abort = True
         self.close()
         return False
+
+    def __del__(self):
+        self._abort = True  # see _S3Stream.__del__
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def abort(self):
+        """See _S3Stream.abort."""
+        self._abort = True
 
     def close(self):
         if not self.closed and not self._abort:

@@ -15,6 +15,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 # small bound so the (1200,)-element arrays exercise the big-array paths
 # (sync: in-program reduce-scatter sharding; async: range partitioning)
 os.environ.setdefault("MXNET_KVSTORE_BIGARRAY_BOUND", "500")
+# authenticate ALL parameter-server traffic in this suite: every frame
+# carries an HMAC-SHA256 tag (kvstore_dist.py transport)
+os.environ.setdefault("MXNET_KVSTORE_SECRET", "disttest-secret")
 
 import numpy as np
 
@@ -67,6 +70,43 @@ def check_kvstore():
 def _acc_updater(key, recv, stored):
     """Module-level so it pickles to the server threads."""
     stored += recv
+
+
+def _noisy_updater(key, recv, stored):
+    """An RNG-drawing updater (SGLD-style): correct only if every
+    process's mx.random stream is in lockstep."""
+    noise = mx.random.normal(0, 1, stored.shape)
+    stored += recv + noise
+
+
+def check_int_dtype():
+    """Integer pushes keep their dtype through the DCN all-reduce (no
+    silent float promotion) and sum exactly."""
+    from mxnet_tpu.kvstore import _allreduce_dcn
+    v = np.arange(6, dtype=np.int32).reshape(2, 3)
+    out = np.asarray(_allreduce_dcn(v * (rank + 1), shard_big=False))
+    assert out.dtype == np.int32, out.dtype
+    np.testing.assert_array_equal(out, v * (n * (n + 1) // 2))
+    print("OK intdtype rank=%d" % rank, flush=True)
+
+
+def check_rng_updater():
+    """dist_sync applies the updater on every process's replica; an
+    updater drawing from the global mx.random stream must NOT diverge
+    the replicas. set_updater broadcasts rank 0's seed (_sync_rng), so
+    even with deliberately divergent per-process seeds beforehand the
+    final values must be identical across ranks (parent asserts on the
+    printed checksum)."""
+    kv = mx.kv.create("dist_sync")
+    kv.init(55, mx.nd.zeros((4, 3)))
+    mx.random.seed(1234 + rank)  # deliberately divergent
+    kv._set_updater(_noisy_updater)
+    for _ in range(3):
+        kv.push(55, mx.nd.ones((4, 3)) * (rank + 1))
+    out = mx.nd.zeros((4, 3))
+    kv.pull(55, out)
+    rsum = float(np.abs(out.asnumpy()).sum())
+    print("OK rngupd rank=%d rngsum=%.6f" % (rank, rsum), flush=True)
 
 
 def check_async():
@@ -161,9 +201,113 @@ def check_fit_dist():
           flush=True)
 
 
-check_kvstore()
-check_async()
-check_trainer()
-check_fit_dist()
+def check_fit_async():
+    """FeedForward.fit over the async parameter server, with fc1_weight
+    (32x16 = 512 elements > MXNET_KVSTORE_BIGARRAY_BOUND) RANGE-
+    PARTITIONED across servers: update-per-push on a big key still
+    converges (reference dist_async mode; kvstore_dist_server.h)."""
+    rs = np.random.RandomState(21)
+    n_samples, d, k = 400, 16, 4
+    X = rs.randn(n_samples, d).astype(np.float32)
+    w = rs.randn(d, k)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    Xs, ys = X[rank::n], y[rank::n]
+
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=32)
+    a1 = mx.symbol.Activation(data=fc1, act_type="relu", name="r1")
+    fc2 = mx.symbol.FullyConnected(data=a1, name="fc2", num_hidden=k)
+    sym = mx.symbol.SoftmaxOutput(data=fc2, name="softmax")
+
+    kv = mx.kv.create("dist_async")
+    model = mx.model.FeedForward(sym, ctx=mx.cpu(), num_epoch=25,
+                                 learning_rate=0.1, momentum=0.9,
+                                 numpy_batch_size=50)
+    model.fit(Xs, ys, kvstore=kv)
+    kv.barrier()
+    acc = model.score(mx.io.NDArrayIter(X, y, batch_size=100))
+    assert acc > 0.85, "async fit failed to converge: %f" % acc
+    print("OK afit rank=%d aacc=%.3f" % (rank, acc), flush=True)
+
+
+def check_sharded_io():
+    """End-to-end sharded input pipeline (the reference's dist_lenet +
+    imagenet_full.md recipe): rank 0 packs a RecordIO file; every
+    process feeds its ``num_parts/part_index`` shard through the NATIVE
+    ImageRecordIter into the fused ParallelTrainer fit path (with the
+    device-side metric accumulating across processes) and the model
+    converges on the global data."""
+    import tempfile
+    try:
+        import cv2  # noqa: F401
+    except ImportError:
+        print("OK shardio rank=%d ioacc=skip" % rank, flush=True)
+        return
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image_io import ImageRecordIter
+
+    hw, nimg, k = 12, 64, 4
+    tag = os.environ.get("MXNET_TPU_COORDINATOR", "x").replace(":", "_")
+    path = os.path.join(tempfile.gettempdir(),
+                        "dist_shardio_%s.rec" % tag)
+    if rank == 0:
+        rs = np.random.RandomState(0)
+        w = recordio.MXRecordIO(path, "w")
+        quad = [(0, 0), (0, 6), (6, 0), (6, 6)]
+        for i in range(nimg):
+            lab = i % k
+            img = np.clip(rs.randn(hw, hw, 3) * 2 + 20, 0, 255)
+            r, c = quad[lab]
+            img[r:r + 6, c:c + 6] += 120  # label = bright quadrant
+            w.write(recordio.pack_img(
+                recordio.IRHeader(0, float(lab), i, 0),
+                np.clip(img, 0, 255).astype(np.uint8),
+                quality=9, img_fmt=".png"))
+        w.close()
+    distributed.barrier("shardio_written")
+
+    gbatch = 16
+    it = ImageRecordIter(path, (3, hw, hw), batch_size=gbatch // n,
+                         shuffle=True, seed=7, num_parts=n,
+                         part_index=rank, preprocess_threads=1)
+    data = mx.symbol.Variable("data")
+    fl = mx.symbol.Flatten(data=data)
+    fc = mx.symbol.FullyConnected(data=fl, name="fc", num_hidden=k)
+    sym = mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+    mesh = par.build_mesh({"dp": len(jax.devices())})
+    tr = par.ParallelTrainer(
+        sym, {"data": (gbatch, 3, hw, hw), "softmax_label": (gbatch,)},
+        optimizer="sgd", mesh=mesh,
+        optimizer_params={"learning_rate": 1e-5, "momentum": 0.9})
+    prng = np.random.RandomState(5)
+    tr.init_params({  # raw-pixel-scale features: small explicit init
+        "fc_weight": mx.nd.array(
+            (prng.uniform(-1, 1, (k, 3 * hw * hw)) * 1e-4).astype("f")),
+        "fc_bias": mx.nd.zeros((k,))})
+    tr.fit(it, num_epoch=25, device_metric=True)
+    name, acc = tr.last_train_metric
+    assert acc > 0.9, "sharded-IO fit failed to converge: %s=%f" \
+        % (name, acc)
+    if rank == 0:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    print("OK shardio rank=%d ioacc=%.3f" % (rank, acc), flush=True)
+
+
+def _run_checks():
+    import time as _time
+    for fn in (check_kvstore, check_int_dtype, check_async,
+               check_rng_updater, check_trainer, check_sharded_io,
+               check_fit_dist, check_fit_async):
+        tic = _time.time()
+        fn()
+        print("TIMING %s rank=%d %.1fs" % (fn.__name__, rank,
+                                           _time.time() - tic),
+              flush=True)
+
+
+_run_checks()
 distributed.barrier("done")
 print("OK all rank=%d" % rank, flush=True)

@@ -16,17 +16,35 @@ def _launch(n, local_devices):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # worker sets its own platform config
     env.pop("XLA_FLAGS", None)
-    proc = subprocess.run(
-        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
-         "-n", str(n), "--local-devices", str(local_devices), "--",
-         sys.executable, os.path.join(ROOT, "tests", "dist_worker.py")],
-        capture_output=True, text=True, timeout=600, env=env)
-    out = proc.stdout + proc.stderr
+    for attempt in range(2):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+             "-n", str(n), "--local-devices", str(local_devices), "--",
+             sys.executable, os.path.join(ROOT, "tests",
+                                          "dist_worker.py")],
+            capture_output=True, text=True, timeout=600, env=env)
+        out = proc.stdout + proc.stderr
+        # on heavily oversubscribed CI hosts (this image has ONE core
+        # for up to 4 jax processes) the coordination-service barrier
+        # can time out before a starved peer arrives — an infra flake,
+        # not a product failure; retry once for that signature only
+        if proc.returncode != 0 and attempt == 0 \
+                and "timed out task names" in out:
+            continue
+        break
     assert proc.returncode == 0, out[-4000:]
     assert out.count("OK kvstore") == n, out[-4000:]
+    assert out.count("OK intdtype") == n, out[-4000:]
     assert out.count("OK async") == n, out[-4000:]
+    assert out.count("OK rngupd") == n, out[-4000:]
+    assert out.count("OK shardio") == n, out[-4000:]
     assert out.count("OK fit") == n, out[-4000:]
+    assert out.count("OK afit") == n, out[-4000:]
     assert out.count("OK all") == n, out[-4000:]
+    # RNG-drawing dist_sync updaters stay in lockstep across ranks
+    # (kvstore._sync_rng broadcasts rank 0's seed at set_updater time)
+    rsums = [float(m) for m in re.findall(r"rngsum=([0-9.]+)", out)]
+    assert len(rsums) == n and max(rsums) - min(rsums) < 1e-5, rsums
     return out
 
 

@@ -132,3 +132,81 @@ def test_flash_attention_backward_memory_subquadratic():
     m1, m2 = temp_bytes(1024), temp_bytes(4096)
     # 4x T: dense-backward temp grows ~16x, blockwise ~4x. Allow slack.
     assert m2 <= m1 * 8, (m1, m2)
+
+
+@pytest.mark.parametrize("offs", [(0, 0), (1, 3), (3, 1), (2, 2)])
+def test_striped_pair_attention(offs):
+    """One striped ring hop vs a dense masked softmax with the same
+    position mask (qpos = a*n + q_off, kpos = b*n + k_off), values and
+    the (o, lse) pair needed for streaming merge."""
+    n = 4
+    q_off, k_off = offs
+    rng = np.random.RandomState(0)
+    bh, c, d = 3, 16, 8
+    q = rng.randn(bh, c, d).astype(np.float32)
+    k = rng.randn(bh, c, d).astype(np.float32)
+    v = rng.randn(bh, c, d).astype(np.float32)
+    o, lse = jax.jit(
+        lambda a, b, cc: pk.striped_pair_attention(
+            a, b, cc, q_off, k_off, n_stride=n, block_q=8, block_k=8)
+    )(q, k, v)
+
+    # dense oracle
+    a_idx, b_idx = np.arange(c), np.arange(c)
+    mask = (a_idx[:, None] * n + q_off) >= (b_idx[None, :] * n + k_off)
+    s = np.einsum("zad,zbd->zab", q, k) / np.sqrt(d)
+    s = np.where(mask[None], s, -np.inf)
+    with np.errstate(over="ignore"):
+        lse_ref = np.log(np.exp(s).sum(-1))  # -inf rows ok
+    p = np.exp(s - np.where(np.isfinite(lse_ref), lse_ref, 0.0)[..., None])
+    p = np.where(mask[None], p, 0.0)
+    o_ref = np.einsum("zab,zbd->zad", p, v)
+    rowsum = p.sum(-1)
+    o_ref = np.where(rowsum[..., None] > 0,
+                     o_ref / np.maximum(rowsum[..., None], 1e-30), 0.0)
+    np.testing.assert_allclose(np.asarray(o), o_ref, rtol=1e-4,
+                               atol=1e-5)
+    got_lse = np.asarray(lse)[..., 0]
+    valid = np.isfinite(lse_ref)
+    np.testing.assert_allclose(got_lse[valid], lse_ref[valid],
+                               rtol=1e-4, atol=1e-4)
+    assert (got_lse[~valid] < -1e29).all()
+
+
+def test_striped_pair_attention_grads():
+    """custom_vjp of the pair kernel (including the lse cotangent path
+    used by the streaming merge) vs jax autodiff of the dense form."""
+    n, q_off, k_off = 4, 1, 2
+    rng = np.random.RandomState(1)
+    bh, c, d = 2, 16, 8
+    q = rng.randn(bh, c, d).astype(np.float32)
+    k = rng.randn(bh, c, d).astype(np.float32)
+    v = rng.randn(bh, c, d).astype(np.float32)
+    wo = rng.randn(bh, c, d).astype(np.float32)
+    wl = rng.randn(bh, c, 1).astype(np.float32)
+
+    def loss_kernel(a, b, cc):
+        o, lse = pk.striped_pair_attention(a, b, cc, q_off, k_off,
+                                           n_stride=n, block_q=8,
+                                           block_k=8)
+        return jnp.sum(o * wo) + jnp.sum(jnp.where(lse > -1e29, lse, 0.0)
+                                         * wl)
+
+    def loss_dense(a, b, cc):
+        i, j = jnp.arange(c), jnp.arange(c)
+        mask = (i[:, None] * n + q_off) >= (j[None, :] * n + k_off)
+        s = jnp.einsum("zad,zbd->zab", a, b) / np.float32(np.sqrt(d))
+        s = jnp.where(mask[None], s, -jnp.inf)
+        lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
+        p = jnp.where(mask[None], jnp.exp(s - jnp.where(
+            jnp.isfinite(lse), lse, 0.0)), 0.0)
+        o = jnp.einsum("zab,zbd->zad", p, cc)
+        return jnp.sum(o * wo) + jnp.sum(jnp.where(
+            jnp.isfinite(lse), lse, 0.0) * wl)
+
+    gk = jax.jit(jax.grad(loss_kernel, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v)
+    for name, x, y in zip("qkv", gk, gd):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg="d%s" % name)

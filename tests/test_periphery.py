@@ -210,3 +210,24 @@ def test_cosine_and_poly_schedulers():
     opt = mx.optimizer.create("sgd", learning_rate=0.1,
                               lr_scheduler=CosineScheduler(max_update=50))
     assert opt.lr_scheduler is not None
+
+
+def test_topk_accuracy_metric():
+    """TopKAccuracy: label within the k best scores counts as correct;
+    k=1 equals plain accuracy."""
+    import numpy as np
+    pred = mx.nd.array(np.array([[0.1, 0.5, 0.4],
+                                 [0.6, 0.3, 0.1],
+                                 [0.3, 0.2, 0.6]], np.float32))
+    label = mx.nd.array(np.array([2, 1, 0], np.float32))
+    m = mx.metric.TopKAccuracy(top_k=2)
+    m.update([label], [pred])
+    # row0: top2 = {1,2} contains 2; row1: {0,1} contains 1; row2: {0,2}
+    # contains 0 -> 3/3
+    assert m.get()[1] == 1.0
+    m1 = mx.metric.TopKAccuracy(top_k=1)
+    m1.update([label], [pred])
+    acc = mx.metric.Accuracy()
+    acc.update([label], [pred])
+    assert m1.get()[1] == acc.get()[1]
+    assert mx.metric.create("top_k_accuracy").top_k == 5

@@ -61,3 +61,66 @@ def test_is_uri():
     assert is_uri("s3://b/k") and is_uri("hdfs://h/p") \
         and is_uri("file:///tmp/x")
     assert not is_uri("/tmp/x") and not is_uri("relative/path")
+
+
+class _FakeHdfs:
+    """Records whether anything was published."""
+
+    def __init__(self):
+        self.published = []
+
+    def open_output_stream(self, path):
+        import io
+        fake = self
+
+        class _Out(io.BytesIO):
+            def __exit__(self, *a):
+                fake.published.append((path, self.getvalue()))
+                return False
+        return _Out()
+
+
+def test_remote_write_never_publishes_on_exception():
+    """The never-publish-truncated contract holds in ALL failure shapes:
+    with-block raise, finally-close during unwind (no with), and GC of
+    an abandoned stream. Only a clean close publishes."""
+    from mxnet_tpu.stream import _HdfsWriteStream
+
+    # clean close -> published
+    h = _FakeHdfs()
+    s = _HdfsWriteStream(h, "/x")
+    s.write(b"complete")
+    s.close()
+    assert h.published == [("/x", b"complete")]
+
+    # with-block + raise -> aborted
+    h = _FakeHdfs()
+    with pytest.raises(RuntimeError):
+        with _HdfsWriteStream(h, "/x") as s:
+            s.write(b"partial")
+            raise RuntimeError("boom")
+    assert h.published == []
+
+    # no with-block: the exception path calls abort() -> not published
+    # (a bare close() is an explicit publish request by contract)
+    h = _FakeHdfs()
+    s = _HdfsWriteStream(h, "/x")
+    with pytest.raises(RuntimeError):
+        try:
+            s.write(b"partial")
+            raise RuntimeError("boom")
+        except RuntimeError:
+            s.abort()
+            raise
+        finally:
+            s.close()
+    assert h.published == []
+
+    # abandoned stream collected by GC -> aborted
+    h = _FakeHdfs()
+    s = _HdfsWriteStream(h, "/x")
+    s.write(b"partial")
+    del s
+    import gc
+    gc.collect()
+    assert h.published == []

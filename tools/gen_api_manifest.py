@@ -1,0 +1,153 @@
+"""Generate a machine-readable API manifest from the live registries.
+
+The reference autogenerates every language binding by introspecting its C
+registry at import time (``python/mxnet/ndarray.py:669`` —
+``MXListFunctions`` + per-function signatures — and symbol creators via
+``MXSymbolListAtomicSymbolCreators``; the Scala/R packages walk the same C
+surface). This tool is that introspection surface made durable: one JSON
+document listing
+
+* every operator (``OpSpec``): params with type/default/required,
+  argument names, output names, aux state names;
+* every NDArray registry function (``MXTListFunctions``): arity triple
+  (n_used, n_scalars, n_mutate) + doc — enough to synthesize the
+  reference's ``BinaryFunction``/``UnaryFunction`` wrappers;
+* every C ABI entry point exported by ``cpp/c_api_graph.h`` and
+  ``cpp/c_predict_api.h`` (name + raw C prototype).
+
+A future Scala/R/... binding generates its wrappers from this file alone,
+with no Python at build time — the same contract the reference's
+``MXSymbolGetAtomicSymbolInfo`` gives its JNI layer.
+
+Usage: python tools/gen_api_manifest.py [-o doc/api_manifest.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def op_entries():
+    from mxnet_tpu.ops.registry import REGISTRY, REQUIRED
+
+    ops = {}
+    for name, spec in sorted(REGISTRY.items()):
+        if name != spec.name:
+            continue  # alias row; listed under the canonical name
+        defaults = {}
+        params = {}
+        for pname, p in spec.params.items():
+            params[pname] = {
+                "type": p.ptype,
+                "required": p.default is REQUIRED,
+                "default": None if p.default is REQUIRED else p.default,
+                "desc": p.desc or "",
+            }
+            if p.default is not REQUIRED:
+                defaults[pname] = p.default
+        try:
+            pdict = spec.parse_params({})
+        except Exception:
+            # ops with required params: fill them with placeholders so
+            # arguments()/outputs() (which rarely depend on values) work
+            pdict = dict(defaults)
+            for pname, p in spec.params.items():
+                if p.default is REQUIRED:
+                    pdict[pname] = {"int": 1, "float": 1.0,
+                                    "bool": False, "str": "",
+                                    "shape": (1,)}.get(p.ptype, 1)
+        try:
+            args = list(spec.arguments(pdict))
+        except Exception:
+            args = ["data"]
+        try:
+            outs = list(spec.outputs(pdict))
+        except Exception:
+            outs = ["output"]
+        try:
+            aux = list(spec.aux_states(pdict))
+        except Exception:
+            aux = []
+        ops[name] = {
+            "aliases": [a for a in getattr(spec, "aliases", ())],
+            "params": params,
+            "arguments": args,
+            "outputs": outs,
+            "aux_states": aux,
+            "doc": (spec.__doc__ or "").strip().split("\n")[0],
+        }
+    return ops
+
+
+def nd_function_entries():
+    from mxnet_tpu import c_api_impl
+
+    funcs = {}
+    registry = c_api_impl._func_registry()
+    for name in sorted(c_api_impl.list_functions()):
+        fn = registry[name]
+        funcs[name] = {"n_used": fn.n_used, "n_scalars": fn.n_scalars,
+                       "n_mutate": fn.n_mutate,
+                       "doc": (getattr(fn, "doc", "") or ""
+                               ).strip().split("\n")[0]}
+    return funcs
+
+
+_C_PROTO = re.compile(
+    r"^\s*(?:MXT_DLL\s+)?(?:int|const\s+char\s*\*|void)\s+"
+    r"(MXT\w+|MXPred\w+|MXNDListGet\w*|MXNDListCreate|MXNDListFree)\s*\(",
+    re.M)
+
+
+def c_abi_entries():
+    abi = {}
+    for header in ("cpp/c_api_graph.h", "cpp/c_predict_api.h"):
+        path = os.path.join(ROOT, header)
+        if not os.path.exists(path):
+            continue
+        text = open(path).read()
+        # join continued prototypes for a readable one-line signature
+        for m in _C_PROTO.finditer(text):
+            name = m.group(1)
+            start = m.start()
+            end = text.index(";", start)
+            sig = " ".join(text[start:end].split())
+            abi[name] = {"header": header, "signature": sig}
+    return abi
+
+
+def build_manifest():
+    import mxnet_tpu
+
+    return {
+        "framework": "mxnet_tpu",
+        "version": getattr(mxnet_tpu, "__version__", "0"),
+        "schema": 1,
+        "operators": op_entries(),
+        "ndarray_functions": nd_function_entries(),
+        "c_abi": c_abi_entries(),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("-o", "--output",
+                    default=os.path.join(ROOT, "doc", "api_manifest.json"))
+    args = ap.parse_args(argv)
+    manifest = build_manifest()
+    with open(args.output, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True, default=str)
+        f.write("\n")
+    print("wrote %s: %d ops, %d nd functions, %d C ABI entries"
+          % (args.output, len(manifest["operators"]),
+             len(manifest["ndarray_functions"]), len(manifest["c_abi"])))
+
+
+if __name__ == "__main__":
+    main()
