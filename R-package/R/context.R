@@ -1,0 +1,20 @@
+# Device contexts (reference R-package/R/context.R; device codes from
+# mxnet_tpu/context.py: cpu=1, gpu=2, tpu=4).
+
+mx.ctx <- function(type_id, dev_id = 0L) {
+  structure(list(device_typeid = as.integer(type_id),
+                 device_id = as.integer(dev_id)),
+            class = "MXContext")
+}
+
+#' CPU context
+#' @export
+mx.cpu <- function(dev.id = 0L) mx.ctx(1L, dev.id)
+
+#' GPU context
+#' @export
+mx.gpu <- function(dev.id = 0L) mx.ctx(2L, dev.id)
+
+#' TPU context (the framework's first-class accelerator)
+#' @export
+mx.tpu <- function(dev.id = 0L) mx.ctx(4L, dev.id)

@@ -1,0 +1,54 @@
+# Executors (reference R-package/R/executor.R): bind a symbol with
+# argument arrays, then forward/backward against the XLA program.
+
+#' Bind a symbol with automatically-allocated arrays
+#' @export
+mx.simple.bind <- function(symbol, ctx = mx.cpu(), grad.req = "write",
+                           ...) {
+  shapes <- mx.symbol.infer.shape(symbol, ...)
+  if (is.null(shapes)) stop("mx.simple.bind: shape inference incomplete")
+  arg.names <- arguments(symbol)
+  args <- lapply(shapes$arg.shapes, function(s) mx.nd.zeros(s, ctx))
+  names(args) <- arg.names
+  req.code <- c("null" = 0L, "write" = 1L, "add" = 3L)[[grad.req]]
+  grads <- lapply(seq_along(args), function(i) {
+    if (req.code == 0L) NULL
+    else mx.nd.zeros(shapes$arg.shapes[[i]], ctx)
+  })
+  aux <- lapply(shapes$aux.shapes, function(s) mx.nd.zeros(s, ctx))
+  handle <- .Call(MXR_ExecutorBind, symbol$handle, ctx$device_typeid,
+                  ctx$device_id,
+                  lapply(args, function(a) a$handle),
+                  lapply(grads, function(g)
+                    if (is.null(g)) NULL else g$handle),
+                  rep(req.code, length(args)),
+                  lapply(aux, function(a) a$handle))
+  structure(list(handle = handle, symbol = symbol, arg.arrays = args,
+                 grad.arrays = grads, aux.arrays = aux),
+            class = "MXExecutor")
+}
+
+#' Run the forward pass
+#' @export
+mx.exec.forward <- function(exec, is.train = TRUE) {
+  .Call(MXR_ExecutorForward, exec$handle,
+        as.integer(is.train))
+  invisible(exec)
+}
+
+#' Run the backward pass (loss-headed symbols need no head gradients)
+#' @export
+mx.exec.backward <- function(exec, head.grads = list()) {
+  .Call(MXR_ExecutorBackward, exec$handle,
+        lapply(head.grads, function(g) g$handle))
+  invisible(exec)
+}
+
+#' Output arrays of the last forward
+#' @export
+mx.exec.outputs <- function(exec) {
+  handles <- .Call(MXR_ExecutorOutputs, exec$handle)
+  outs <- lapply(handles, new.ndarray)
+  names(outs) <- outputs(exec$symbol)
+  outs
+}

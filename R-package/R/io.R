@@ -1,0 +1,39 @@
+# Data iterators (reference R-package/R/io.R): creators resolved by
+# name through MXTListDataIters.
+
+mx.io.internal.create <- function(name, params) {
+  vals <- vapply(params, function(v) {
+    if (length(v) > 1)
+      paste0("(", paste(as.integer(v), collapse = ","), ")")
+    else as.character(v)
+  }, character(1))
+  structure(list(handle = .Call(MXR_DataIterCreate, name,
+                                names(params), vals)),
+            class = "MXDataIter")
+}
+
+#' CSV iterator
+#' @export
+mx.io.CSVIter <- function(...) mx.io.internal.create("CSVIter", list(...))
+
+#' MNIST idx-ubyte iterator
+#' @export
+mx.io.MNISTIter <- function(...)
+  mx.io.internal.create("MNISTIter", list(...))
+
+#' Packed-RecordIO image iterator (native threaded decode)
+#' @export
+mx.io.ImageRecordIter <- function(...)
+  mx.io.internal.create("ImageRecordIter", list(...))
+
+mx.io.reset <- function(iter) {
+  .Call(MXR_DataIterReset, iter$handle)
+  invisible(iter)
+}
+
+mx.io.next <- function(iter) {
+  if (.Call(MXR_DataIterNext, iter$handle) == 0L) return(NULL)
+  list(data = new.ndarray(.Call(MXR_DataIterGetData, iter$handle)),
+       label = new.ndarray(.Call(MXR_DataIterGetLabel, iter$handle)),
+       pad = .Call(MXR_DataIterGetPad, iter$handle))
+}

@@ -1,0 +1,27 @@
+# Key-value store (reference R-package/R/kvstore.R): init/push/pull for
+# data-parallel aggregation. The updater stays on the framework side
+# (set an optimizer in the training loop); R-side custom updaters would
+# need an R-callback trampoline, which the reference also did not
+# expose.
+
+#' Create a KVStore ("local", "device", "dist_sync", "dist_async", ...)
+#' @export
+mx.kv.create <- function(type = "local") {
+  structure(list(handle = .Call(MXR_KVStoreCreate, type)),
+            class = "MXKVStore")
+}
+
+mx.kv.init <- function(kv, key, value) {
+  .Call(MXR_KVStoreInit, kv$handle, as.integer(key), value$handle)
+  invisible(kv)
+}
+
+mx.kv.push <- function(kv, key, value) {
+  .Call(MXR_KVStorePush, kv$handle, as.integer(key), value$handle)
+  invisible(kv)
+}
+
+mx.kv.pull <- function(kv, key, out) {
+  .Call(MXR_KVStorePull, kv$handle, as.integer(key), out$handle)
+  out
+}

@@ -1,0 +1,31 @@
+# Evaluation metrics (reference R-package/R/metric.R).
+
+mx.metric.custom <- function(name, feval) {
+  structure(list(name = name, feval = feval,
+                 sum = 0, n = 0), class = "MXMetric")
+}
+
+#' Classification accuracy
+#' @export
+mx.metric.accuracy <- mx.metric.custom("accuracy", function(label, pred) {
+  # pred: n x k matrix (R layout), label: n-vector of class ids
+  yhat <- max.col(pred) - 1
+  mean(yhat == as.vector(label))
+})
+
+metric.update <- function(metric, label, pred) {
+  metric$sum <- metric$sum + metric$feval(label, pred)
+  metric$n <- metric$n + 1
+  metric
+}
+
+metric.get <- function(metric) {
+  list(name = metric$name,
+       value = if (metric$n == 0) NaN else metric$sum / metric$n)
+}
+
+metric.reset <- function(metric) {
+  metric$sum <- 0
+  metric$n <- 0
+  metric
+}

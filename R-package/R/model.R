@@ -1,0 +1,157 @@
+# Training harness (reference R-package/R/model.R, compacted): init by
+# name pattern, epoch loop of forward/backward/update, predict().
+
+mx.model.init.params <- function(symbol, input.shapes, initializer.scale,
+                                 ctx) {
+  shapes <- do.call(mx.symbol.infer.shape, c(list(symbol), input.shapes))
+  if (is.null(shapes)) stop("shape inference incomplete")
+  arg.names <- arguments(symbol)
+  arg.params <- list()
+  for (i in seq_along(arg.names)) {
+    name <- arg.names[[i]]
+    if (name %in% names(input.shapes)) next
+    shape <- shapes$arg.shapes[[i]]
+    if (grepl("bias$|beta$|moving_mean$", name)) {
+      arg.params[[name]] <- mx.nd.zeros(shape, ctx)
+    } else if (grepl("gamma$|moving_var$", name)) {
+      arg.params[[name]] <- mx.nd.ones(shape, ctx)
+    } else {
+      v <- array(stats::runif(prod(shape), -initializer.scale,
+                              initializer.scale), dim = shape)
+      arg.params[[name]] <- mx.nd.array(v, ctx)
+    }
+  }
+  list(arg.params = arg.params, shapes = shapes)
+}
+
+#' SGD optimizer description for the fit loop
+#' @export
+mx.opt.sgd <- function(learning.rate = 0.01, momentum = 0,
+                       rescale.grad = 1) {
+  list(type = "sgd", lr = learning.rate, momentum = momentum,
+       rescale = rescale.grad, state = new.env())
+}
+
+mx.opt.update <- function(opt, index, weight, grad) {
+  g <- grad * opt$rescale
+  if (opt$momentum == 0) {
+    weight + (g * (-opt$lr))
+  } else {
+    key <- as.character(index)
+    mom <- opt$state[[key]]
+    if (is.null(mom)) {
+      mom <- g * (-opt$lr)
+    } else {
+      mom <- (mom * opt$momentum) + (g * (-opt$lr))
+    }
+    opt$state[[key]] <- mom
+    weight + mom
+  }
+}
+
+#' Train a model from in-memory data (reference
+#' mx.model.FeedForward.create)
+#' @export
+mx.model.FeedForward.create <- function(symbol, X, y, ctx = mx.cpu(),
+                                        num.round = 10,
+                                        array.batch.size = 128,
+                                        learning.rate = 0.01,
+                                        momentum = 0,
+                                        initializer.scale = 0.07,
+                                        verbose = TRUE) {
+  n <- nrow(X)
+  batch <- min(array.batch.size, n)
+  input.shapes <- list(data = c(batch, ncol(X)),
+                       softmax_label = c(batch))
+  init <- mx.model.init.params(symbol, input.shapes, initializer.scale,
+                               ctx)
+  arg.names <- arguments(symbol)
+  exec.args <- list()
+  grads <- list()
+  req <- integer(length(arg.names))
+  for (i in seq_along(arg.names)) {
+    name <- arg.names[[i]]
+    shape <- init$shapes$arg.shapes[[i]]
+    exec.args[[name]] <-
+      if (name %in% names(init$arg.params)) init$arg.params[[name]]
+      else mx.nd.zeros(shape, ctx)
+    is.param <- name %in% names(init$arg.params)
+    grads[[i]] <- if (is.param) mx.nd.zeros(shape, ctx) else NULL
+    req[[i]] <- if (is.param) 1L else 0L
+  }
+  aux <- lapply(init$shapes$aux.shapes, function(s) mx.nd.zeros(s, ctx))
+  handle <- .Call(MXR_ExecutorBind, symbol$handle, ctx$device_typeid,
+                  ctx$device_id,
+                  lapply(exec.args, function(a) a$handle),
+                  lapply(grads, function(g)
+                    if (is.null(g)) NULL else g$handle),
+                  req, lapply(aux, function(a) a$handle))
+  exec <- structure(list(handle = handle, symbol = symbol),
+                    class = "MXExecutor")
+
+  opt <- mx.opt.sgd(learning.rate, momentum, 1 / batch)
+  nbatches <- floor(n / batch)
+  metric <- mx.metric.accuracy
+  for (round in seq_len(num.round)) {
+    metric <- metric.reset(metric)
+    for (b in seq_len(nbatches)) {
+      idx <- ((b - 1) * batch + 1):(b * batch)
+      xb <- mx.nd.array(X[idx, , drop = FALSE], ctx)
+      yb <- mx.nd.array(as.numeric(y[idx]), ctx)
+      .Call(MXR_FuncInvoke, "_copyto", list(xb$handle), numeric(0),
+            list(exec.args$data$handle))
+      .Call(MXR_FuncInvoke, "_copyto", list(yb$handle), numeric(0),
+            list(exec.args$softmax_label$handle))
+      mx.exec.forward(exec, is.train = TRUE)
+      mx.exec.backward(exec)
+      for (i in seq_along(arg.names)) {
+        name <- arg.names[[i]]
+        if (!(name %in% names(init$arg.params))) next
+        newW <- mx.opt.update(opt, i, exec.args[[name]],
+                              new.ndarray(grads[[i]]$handle))
+        .Call(MXR_FuncInvoke, "_copyto", list(newW$handle), numeric(0),
+              list(exec.args[[name]]$handle))
+      }
+      out <- mx.exec.outputs(exec)[[1]]
+      metric <- metric.update(metric, as.array(yb), as.array(out))
+    }
+    if (verbose) {
+      m <- metric.get(metric)
+      message(sprintf("Round [%d] Train-%s=%f", round, m$name, m$value))
+    }
+  }
+  structure(list(symbol = symbol, arg.params = init$arg.params,
+                 ctx = ctx, batch = batch),
+            class = "MXFeedForwardModel")
+}
+
+#' Predict class probabilities
+#' @export
+predict.MXFeedForwardModel <- function(object, X, ...) {
+  n <- nrow(X)
+  batch <- min(object$batch, n)
+  exec <- mx.simple.bind(object$symbol, object$ctx, grad.req = "null",
+                         data = c(batch, ncol(X)),
+                         softmax_label = c(batch))
+  for (name in names(object$arg.params)) {
+    .Call(MXR_FuncInvoke, "_copyto",
+          list(object$arg.params[[name]]$handle), numeric(0),
+          list(exec$arg.arrays[[name]]$handle))
+  }
+  out <- NULL
+  for (b in seq_len(ceiling(n / batch))) {
+    lo <- (b - 1) * batch + 1
+    hi <- min(b * batch, n)
+    xb <- X[lo:hi, , drop = FALSE]
+    if (nrow(xb) < batch) {  # pad the tail batch
+      xb <- rbind(xb, xb[rep(1, batch - nrow(xb)), , drop = FALSE])
+    }
+    nd <- mx.nd.array(xb, object$ctx)
+    .Call(MXR_FuncInvoke, "_copyto", list(nd$handle), numeric(0),
+          list(exec$arg.arrays$data$handle))
+    mx.exec.forward(exec, is.train = FALSE)
+    p <- as.array(mx.exec.outputs(exec)[[1]])
+    out <- rbind(out, p[seq_len(hi - lo + 1), , drop = FALSE])
+  }
+  out
+}

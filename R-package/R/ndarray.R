@@ -1,0 +1,118 @@
+# Device arrays (reference R-package/R/ndarray.R). R stores arrays
+# column-major while the framework is row-major; like the reference, the
+# binding transposes at the boundary so R users see R-native semantics.
+
+new.ndarray <- function(handle, shape = NULL) {
+  structure(list(handle = handle), class = "MXNDArray")
+}
+
+#' Create an NDArray from an R array/vector/matrix
+#' @export
+mx.nd.array <- function(src.array, ctx = mx.cpu()) {
+  if (is.null(dim(src.array))) dim(src.array) <- length(src.array)
+  rshape <- dim(src.array)
+  # row-major framework shape is the reverse of R's column-major dims
+  shape <- rev(rshape)
+  handle <- .Call(MXR_NDArrayCreate, as.integer(shape),
+                  ctx$device_typeid, ctx$device_id)
+  # aperm to row-major order before the flat copy
+  values <- as.numeric(aperm(src.array, rev(seq_along(rshape))))
+  .Call(MXR_NDArraySyncCopyFrom, handle, values)
+  new.ndarray(handle)
+}
+
+#' Zeros
+#' @export
+mx.nd.zeros <- function(shape, ctx = mx.cpu()) {
+  handle <- .Call(MXR_NDArrayCreate, as.integer(shape),
+                  ctx$device_typeid, ctx$device_id)
+  .Call(MXR_NDArraySyncCopyFrom, handle,
+        numeric(prod(shape)))
+  new.ndarray(handle)
+}
+
+#' Ones
+#' @export
+mx.nd.ones <- function(shape, ctx = mx.cpu()) {
+  handle <- .Call(MXR_NDArrayCreate, as.integer(shape),
+                  ctx$device_typeid, ctx$device_id)
+  .Call(MXR_NDArraySyncCopyFrom, handle,
+        rep(1, prod(shape)))
+  new.ndarray(handle)
+}
+
+mx.nd.internal.shape <- function(nd) {
+  .Call(MXR_NDArrayGetShape, nd$handle)
+}
+
+#' Copy an NDArray back to an R array (blocking read)
+#' @export
+as.array.MXNDArray <- function(x, ...) {
+  shape <- mx.nd.internal.shape(x)
+  values <- .Call(MXR_NDArraySyncCopyTo, x$handle, prod(shape))
+  if (length(shape) <= 1) return(values)
+  # row-major flat -> R column-major array
+  a <- array(values, dim = rev(shape))
+  aperm(a, rev(seq_along(shape)))
+}
+
+#' @export
+print.MXNDArray <- function(x, ...) {
+  print(as.array(x))
+  invisible(x)
+}
+
+mx.nd.internal.binary <- function(fname, lhs, rhs) {
+  shape <- mx.nd.internal.shape(lhs)
+  out <- mx.nd.zeros(rev(shape))  # raw framework-shape buffer
+  .Call(MXR_FuncInvoke, fname, list(lhs$handle, rhs$handle),
+        numeric(0), list(out$handle))
+  out
+}
+
+mx.nd.internal.scalar <- function(fname, lhs, s) {
+  shape <- mx.nd.internal.shape(lhs)
+  out <- mx.nd.zeros(rev(shape))
+  .Call(MXR_FuncInvoke, fname, list(lhs$handle), as.numeric(s),
+        list(out$handle))
+  out
+}
+
+#' @export
+"+.MXNDArray" <- function(e1, e2) {
+  if (inherits(e2, "MXNDArray")) mx.nd.internal.binary("_plus", e1, e2)
+  else mx.nd.internal.scalar("_plus_scalar", e1, e2)
+}
+
+#' @export
+"-.MXNDArray" <- function(e1, e2) {
+  if (inherits(e2, "MXNDArray")) mx.nd.internal.binary("_minus", e1, e2)
+  else mx.nd.internal.scalar("_minus_scalar", e1, e2)
+}
+
+#' @export
+"*.MXNDArray" <- function(e1, e2) {
+  if (inherits(e2, "MXNDArray")) mx.nd.internal.binary("_mul", e1, e2)
+  else mx.nd.internal.scalar("_mul_scalar", e1, e2)
+}
+
+#' @export
+"/.MXNDArray" <- function(e1, e2) {
+  if (inherits(e2, "MXNDArray")) mx.nd.internal.binary("_div", e1, e2)
+  else mx.nd.internal.scalar("_div_scalar", e1, e2)
+}
+
+#' Save named NDArrays (bit-compatible with mx.nd.save everywhere else)
+#' @export
+mx.nd.save <- function(ndarray, filename) {
+  .Call(MXR_NDArraySave, filename,
+        lapply(ndarray, function(x) x$handle), names(ndarray))
+  invisible(NULL)
+}
+
+#' Load named NDArrays
+#' @export
+mx.nd.load <- function(filename) {
+  handles <- .Call(MXR_NDArrayLoad, filename)
+  lapply(handles, new.ndarray)
+}

@@ -1,0 +1,79 @@
+# Symbolic graphs (reference R-package/R/symbol.R). Operator
+# constructors (mx.symbol.FullyConnected, ...) are GENERATED into
+# ops_generated.R from the API manifest; this file holds the primitives
+# they call.
+
+new.symbol <- function(handle) {
+  structure(list(handle = handle), class = "MXSymbol")
+}
+
+#' Create a placeholder variable
+#' @export
+mx.symbol.Variable <- function(name) {
+  new.symbol(.Call(MXR_SymbolCreateVariable, name))
+}
+
+#' Group symbols into one multi-output symbol
+#' @export
+mx.symbol.Group <- function(...) {
+  syms <- list(...)
+  new.symbol(.Call(MXR_SymbolGroup,
+                   lapply(syms, function(s) s$handle)))
+}
+
+#' Load a symbol from its JSON serialization
+#' @export
+mx.symbol.load.json <- function(json) {
+  new.symbol(.Call(MXR_SymbolFromJSON, json))
+}
+
+mx.symbol.to.json <- function(symbol) {
+  .Call(MXR_SymbolToJSON, symbol$handle)
+}
+
+arguments <- function(symbol) {
+  .Call(MXR_SymbolListArguments, symbol$handle)
+}
+
+outputs <- function(symbol) {
+  .Call(MXR_SymbolListOutputs, symbol$handle)
+}
+
+auxiliary.states <- function(symbol) {
+  .Call(MXR_SymbolListAuxiliaryStates, symbol$handle)
+}
+
+#' Infer shapes from named argument shapes. Shapes are given in R
+#' (column-major) order and translated at the boundary; returns a list
+#' with arg.shapes / out.shapes / aux.shapes, or NULL when incomplete.
+#' @export
+mx.symbol.infer.shape <- function(symbol, ...) {
+  kwargs <- list(...)
+  shapes <- lapply(kwargs, function(s) as.integer(rev(s)))
+  res <- .Call(MXR_SymbolInferShape, symbol$handle, names(kwargs),
+               shapes)
+  if (is.null(res)) return(NULL)
+  back <- function(lst) lapply(lst, rev)
+  list(arg.shapes = back(res[[1]]), out.shapes = back(res[[2]]),
+       aux.shapes = back(res[[3]]))
+}
+
+# primitive used by the generated constructors: create the atomic
+# symbol with stringified params, then compose named Symbol inputs
+mx.symbol.internal.create <- function(op, name, kwargs) {
+  is.sym <- vapply(kwargs, inherits, logical(1), what = "MXSymbol")
+  params <- kwargs[!is.sym]
+  inputs <- kwargs[is.sym]
+  keys <- names(params)
+  vals <- vapply(params, function(v) {
+    if (is.logical(v)) (if (v) "True" else "False")
+    else if (length(v) > 1)
+      paste0("(", paste(as.integer(v), collapse = ","), ")")
+    else as.character(v)
+  }, character(1))
+  handle <- .Call(MXR_SymbolCreateAtomic, op, as.character(keys),
+                  as.character(vals))
+  .Call(MXR_SymbolCompose, handle, name, names(inputs),
+        lapply(inputs, function(s) s$handle))
+  new.symbol(handle)
+}

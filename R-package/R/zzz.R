@@ -1,0 +1,9 @@
+# Package load hook (reference R-package/R/zzz.R): the shared object is
+# registered via useDynLib in NAMESPACE; nothing else to do at load.
+.onLoad <- function(libname, pkgname) {
+  invisible()
+}
+
+.onUnload <- function(libpath) {
+  library.dynam.unload("mxnet_r", libpath)
+}

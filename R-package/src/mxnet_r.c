@@ -1,0 +1,520 @@
+/*
+ * Plain-C .Call shim binding R to the mxnet_tpu C ABI
+ * (cpp/c_api_graph.h). Reference analogue: R-package/src (Rcpp
+ * modules) — this shim does the same marshalling with no Rcpp
+ * dependency: SEXP in, one MXT* call, SEXP out; failures raise R
+ * conditions carrying MXTApiGetLastError(); handles are external
+ * pointers with GC finalizers.
+ *
+ * Build: R CMD INSTALL (src/Makevars links -lmxnet_tpu).
+ */
+#include <R.h>
+#include <Rinternals.h>
+#include <R_ext/Rdynload.h>
+#include <stdint.h>
+#include <string.h>
+
+#include "../../cpp/c_api_graph.h"
+
+#define CHECK_CALL(expr)                                            \
+  do {                                                              \
+    if ((expr) != 0) Rf_error("mxnet_tpu: %s", MXTApiGetLastError()); \
+  } while (0)
+
+/* ---- handle helpers -------------------------------------------------- */
+
+static void ndarray_finalizer(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    MXTNDArrayFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static void symbol_finalizer(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    MXTSymbolFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static void executor_finalizer(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    MXTExecutorFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static void kvstore_finalizer(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    MXTKVStoreFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static void iter_finalizer(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    MXTDataIterFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static SEXP wrap_handle(void *h, void (*fin)(SEXP)) {
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, fin, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+static void *unwrap(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h == NULL) Rf_error("mxnet_tpu: handle already freed");
+  return h;
+}
+
+/* ---- NDArray --------------------------------------------------------- */
+
+SEXP MXR_NDArrayCreate(SEXP r_shape, SEXP r_dev_type, SEXP r_dev_id) {
+  int ndim = Rf_length(r_shape);
+  mx_uint shape[32];
+  if (ndim > 32) Rf_error("mxnet_tpu: ndim > 32");
+  for (int i = 0; i < ndim; ++i)
+    shape[i] = (mx_uint)INTEGER(r_shape)[i];
+  NDArrayHandle out;
+  CHECK_CALL(MXTNDArrayCreateEx(shape, (mx_uint)ndim,
+                                Rf_asInteger(r_dev_type),
+                                Rf_asInteger(r_dev_id), 0, 0, &out));
+  return wrap_handle(out, ndarray_finalizer);
+}
+
+SEXP MXR_NDArrayGetShape(SEXP r_handle) {
+  mx_uint ndim;
+  const mx_uint *pdata;
+  CHECK_CALL(MXTNDArrayGetShape(unwrap(r_handle), &ndim, &pdata));
+  SEXP out = PROTECT(Rf_allocVector(INTSXP, ndim));
+  for (mx_uint i = 0; i < ndim; ++i) INTEGER(out)[i] = (int)pdata[i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP MXR_NDArraySyncCopyFrom(SEXP r_handle, SEXP r_values) {
+  R_xlen_t n = Rf_xlength(r_values);
+  float *buf = (float *)R_alloc((size_t)n, sizeof(float));
+  double *src = REAL(r_values);
+  for (R_xlen_t i = 0; i < n; ++i) buf[i] = (float)src[i];
+  CHECK_CALL(MXTNDArraySyncCopyFromCPU(unwrap(r_handle), buf,
+                                       (size_t)n));
+  return R_NilValue;
+}
+
+SEXP MXR_NDArraySyncCopyTo(SEXP r_handle, SEXP r_size) {
+  size_t n = (size_t)Rf_asReal(r_size);
+  float *buf = (float *)R_alloc(n, sizeof(float));
+  CHECK_CALL(MXTNDArraySyncCopyToCPU(unwrap(r_handle), buf, n));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)n));
+  for (size_t i = 0; i < n; ++i) REAL(out)[i] = (double)buf[i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP MXR_NDArraySave(SEXP r_fname, SEXP r_handles, SEXP r_names) {
+  int n = Rf_length(r_handles);
+  NDArrayHandle *handles =
+      (NDArrayHandle *)R_alloc((size_t)n, sizeof(NDArrayHandle));
+  const char **names =
+      (const char **)R_alloc((size_t)n, sizeof(char *));
+  for (int i = 0; i < n; ++i) {
+    handles[i] = unwrap(VECTOR_ELT(r_handles, i));
+    names[i] = CHAR(STRING_ELT(r_names, i));
+  }
+  CHECK_CALL(MXTNDArraySave(CHAR(Rf_asChar(r_fname)), (mx_uint)n,
+                            handles, names));
+  return R_NilValue;
+}
+
+SEXP MXR_NDArrayLoad(SEXP r_fname) {
+  mx_uint out_size, name_size;
+  NDArrayHandle *arr;
+  const char **names;
+  CHECK_CALL(MXTNDArrayLoad(CHAR(Rf_asChar(r_fname)), &out_size, &arr,
+                            &name_size, &names));
+  SEXP handles = PROTECT(Rf_allocVector(VECSXP, out_size));
+  SEXP rnames = PROTECT(Rf_allocVector(STRSXP, name_size));
+  for (mx_uint i = 0; i < out_size; ++i)
+    SET_VECTOR_ELT(handles, i, wrap_handle(arr[i], ndarray_finalizer));
+  for (mx_uint i = 0; i < name_size; ++i)
+    SET_STRING_ELT(rnames, i, Rf_mkChar(names[i]));
+  if (name_size == out_size) Rf_setAttrib(handles, R_NamesSymbol, rnames);
+  UNPROTECT(2);
+  return handles;
+}
+
+SEXP MXR_FuncInvoke(SEXP r_name, SEXP r_used, SEXP r_scalars,
+                    SEXP r_mutate) {
+  FunctionHandle fn;
+  CHECK_CALL(MXTGetFunction(CHAR(Rf_asChar(r_name)), &fn));
+  int nu = Rf_length(r_used), ns = Rf_length(r_scalars),
+      nm = Rf_length(r_mutate);
+  NDArrayHandle *used =
+      (NDArrayHandle *)R_alloc((size_t)(nu ? nu : 1),
+                               sizeof(NDArrayHandle));
+  mx_float *scalars =
+      (mx_float *)R_alloc((size_t)(ns ? ns : 1), sizeof(mx_float));
+  NDArrayHandle *mutate =
+      (NDArrayHandle *)R_alloc((size_t)(nm ? nm : 1),
+                               sizeof(NDArrayHandle));
+  for (int i = 0; i < nu; ++i) used[i] = unwrap(VECTOR_ELT(r_used, i));
+  for (int i = 0; i < ns; ++i)
+    scalars[i] = (mx_float)REAL(r_scalars)[i];
+  for (int i = 0; i < nm; ++i)
+    mutate[i] = unwrap(VECTOR_ELT(r_mutate, i));
+  CHECK_CALL(MXTFuncInvoke(fn, used, scalars, mutate));
+  return R_NilValue;
+}
+
+/* ---- Symbol ---------------------------------------------------------- */
+
+SEXP MXR_SymbolCreateVariable(SEXP r_name) {
+  SymbolHandle out;
+  CHECK_CALL(MXTSymbolCreateVariable(CHAR(Rf_asChar(r_name)), &out));
+  return wrap_handle(out, symbol_finalizer);
+}
+
+SEXP MXR_SymbolCreateAtomic(SEXP r_op, SEXP r_keys, SEXP r_vals) {
+  mx_uint size;
+  AtomicSymbolCreator *creators;
+  CHECK_CALL(MXTSymbolListAtomicSymbolCreators(&size, &creators));
+  AtomicSymbolCreator creator = NULL;
+  const char *want = CHAR(Rf_asChar(r_op));
+  for (mx_uint i = 0; i < size; ++i) {
+    const char *name;
+    CHECK_CALL(MXTSymbolGetAtomicSymbolName(creators[i], &name));
+    if (strcmp(name, want) == 0) {
+      creator = creators[i];
+      break;
+    }
+  }
+  if (creator == NULL) Rf_error("mxnet_tpu: unknown operator %s", want);
+  int n = Rf_length(r_keys);
+  const char **keys =
+      (const char **)R_alloc((size_t)(n ? n : 1), sizeof(char *));
+  const char **vals =
+      (const char **)R_alloc((size_t)(n ? n : 1), sizeof(char *));
+  for (int i = 0; i < n; ++i) {
+    keys[i] = CHAR(STRING_ELT(r_keys, i));
+    vals[i] = CHAR(STRING_ELT(r_vals, i));
+  }
+  SymbolHandle out;
+  CHECK_CALL(MXTSymbolCreateAtomicSymbol(creator, (mx_uint)n, keys,
+                                         vals, &out));
+  return wrap_handle(out, symbol_finalizer);
+}
+
+SEXP MXR_SymbolCompose(SEXP r_sym, SEXP r_name, SEXP r_keys,
+                       SEXP r_args) {
+  int n = Rf_length(r_args);
+  const char **keys =
+      (const char **)R_alloc((size_t)(n ? n : 1), sizeof(char *));
+  SymbolHandle *args =
+      (SymbolHandle *)R_alloc((size_t)(n ? n : 1), sizeof(SymbolHandle));
+  for (int i = 0; i < n; ++i) {
+    keys[i] = CHAR(STRING_ELT(r_keys, i));
+    args[i] = unwrap(VECTOR_ELT(r_args, i));
+  }
+  CHECK_CALL(MXTSymbolCompose(unwrap(r_sym), CHAR(Rf_asChar(r_name)),
+                              (mx_uint)n, keys, args));
+  return R_NilValue;
+}
+
+SEXP MXR_SymbolGroup(SEXP r_syms) {
+  int n = Rf_length(r_syms);
+  SymbolHandle *syms =
+      (SymbolHandle *)R_alloc((size_t)n, sizeof(SymbolHandle));
+  for (int i = 0; i < n; ++i) syms[i] = unwrap(VECTOR_ELT(r_syms, i));
+  SymbolHandle out;
+  CHECK_CALL(MXTSymbolCreateGroup((mx_uint)n, syms, &out));
+  return wrap_handle(out, symbol_finalizer);
+}
+
+SEXP MXR_SymbolFromJSON(SEXP r_json) {
+  SymbolHandle out;
+  CHECK_CALL(MXTSymbolCreateFromJSON(CHAR(Rf_asChar(r_json)), &out));
+  return wrap_handle(out, symbol_finalizer);
+}
+
+SEXP MXR_SymbolToJSON(SEXP r_sym) {
+  const char *json;
+  CHECK_CALL(MXTSymbolSaveToJSON(unwrap(r_sym), &json));
+  return Rf_mkString(json);
+}
+
+static SEXP str_list(void *h,
+                     int (*f)(SymbolHandle, mx_uint *, const char ***)) {
+  mx_uint size;
+  const char **arr;
+  CHECK_CALL(f(h, &size, &arr));
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, size));
+  for (mx_uint i = 0; i < size; ++i)
+    SET_STRING_ELT(out, i, Rf_mkChar(arr[i]));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP MXR_SymbolListArguments(SEXP r_sym) {
+  return str_list(unwrap(r_sym), MXTSymbolListArguments);
+}
+
+SEXP MXR_SymbolListOutputs(SEXP r_sym) {
+  return str_list(unwrap(r_sym), MXTSymbolListOutputs);
+}
+
+SEXP MXR_SymbolListAuxiliaryStates(SEXP r_sym) {
+  return str_list(unwrap(r_sym), MXTSymbolListAuxiliaryStates);
+}
+
+SEXP MXR_SymbolInferShape(SEXP r_sym, SEXP r_keys, SEXP r_shapes) {
+  int n = Rf_length(r_keys);
+  const char **keys =
+      (const char **)R_alloc((size_t)(n ? n : 1), sizeof(char *));
+  mx_uint *ind_ptr =
+      (mx_uint *)R_alloc((size_t)n + 1, sizeof(mx_uint));
+  int total = 0;
+  for (int i = 0; i < n; ++i) total += Rf_length(VECTOR_ELT(r_shapes, i));
+  mx_uint *shape_data =
+      (mx_uint *)R_alloc((size_t)(total ? total : 1), sizeof(mx_uint));
+  ind_ptr[0] = 0;
+  int off = 0;
+  for (int i = 0; i < n; ++i) {
+    keys[i] = CHAR(STRING_ELT(r_keys, i));
+    SEXP s = VECTOR_ELT(r_shapes, i);
+    for (int j = 0; j < Rf_length(s); ++j)
+      shape_data[off++] = (mx_uint)INTEGER(s)[j];
+    ind_ptr[i + 1] = (mx_uint)off;
+  }
+  mx_uint in_n, out_n, aux_n;
+  const mx_uint *in_nd, *out_nd, *aux_nd;
+  const mx_uint **in_d, **out_d, **aux_d;
+  int complete;
+  CHECK_CALL(MXTSymbolInferShape(unwrap(r_sym), (mx_uint)n, keys,
+                                 ind_ptr, shape_data, &in_n, &in_nd,
+                                 &in_d, &out_n, &out_nd, &out_d, &aux_n,
+                                 &aux_nd, &aux_d, &complete));
+  if (!complete) return R_NilValue;
+  SEXP result = PROTECT(Rf_allocVector(VECSXP, 3));
+  mx_uint counts[3] = {in_n, out_n, aux_n};
+  const mx_uint *nds[3] = {in_nd, out_nd, aux_nd};
+  const mx_uint **ds[3] = {in_d, out_d, aux_d};
+  for (int g = 0; g < 3; ++g) {
+    SEXP lst = PROTECT(Rf_allocVector(VECSXP, counts[g]));
+    for (mx_uint i = 0; i < counts[g]; ++i) {
+      SEXP shp = PROTECT(Rf_allocVector(INTSXP, nds[g][i]));
+      for (mx_uint j = 0; j < nds[g][i]; ++j)
+        INTEGER(shp)[j] = (int)ds[g][i][j];
+      SET_VECTOR_ELT(lst, i, shp);
+      UNPROTECT(1);
+    }
+    SET_VECTOR_ELT(result, g, lst);
+    UNPROTECT(1);
+  }
+  UNPROTECT(1);
+  return result;
+}
+
+/* ---- Executor -------------------------------------------------------- */
+
+SEXP MXR_ExecutorBind(SEXP r_sym, SEXP r_dev_type, SEXP r_dev_id,
+                      SEXP r_args, SEXP r_grads, SEXP r_req,
+                      SEXP r_aux) {
+  int n = Rf_length(r_args), na = Rf_length(r_aux);
+  NDArrayHandle *args =
+      (NDArrayHandle *)R_alloc((size_t)(n ? n : 1),
+                               sizeof(NDArrayHandle));
+  NDArrayHandle *grads =
+      (NDArrayHandle *)R_alloc((size_t)(n ? n : 1),
+                               sizeof(NDArrayHandle));
+  mx_uint *req = (mx_uint *)R_alloc((size_t)(n ? n : 1),
+                                    sizeof(mx_uint));
+  NDArrayHandle *aux =
+      (NDArrayHandle *)R_alloc((size_t)(na ? na : 1),
+                               sizeof(NDArrayHandle));
+  for (int i = 0; i < n; ++i) {
+    args[i] = unwrap(VECTOR_ELT(r_args, i));
+    SEXP g = VECTOR_ELT(r_grads, i);
+    grads[i] = Rf_isNull(g) ? NULL : unwrap(g);
+    req[i] = (mx_uint)INTEGER(r_req)[i];
+  }
+  for (int i = 0; i < na; ++i) aux[i] = unwrap(VECTOR_ELT(r_aux, i));
+  ExecutorHandle out;
+  CHECK_CALL(MXTExecutorBind(unwrap(r_sym), Rf_asInteger(r_dev_type),
+                             Rf_asInteger(r_dev_id), (mx_uint)n, args,
+                             grads, req, (mx_uint)na, aux, &out));
+  return wrap_handle(out, executor_finalizer);
+}
+
+SEXP MXR_ExecutorForward(SEXP r_exec, SEXP r_is_train) {
+  CHECK_CALL(MXTExecutorForward(unwrap(r_exec),
+                                Rf_asInteger(r_is_train)));
+  return R_NilValue;
+}
+
+SEXP MXR_ExecutorBackward(SEXP r_exec, SEXP r_head_grads) {
+  int n = Rf_length(r_head_grads);
+  NDArrayHandle *grads =
+      (NDArrayHandle *)R_alloc((size_t)(n ? n : 1),
+                               sizeof(NDArrayHandle));
+  for (int i = 0; i < n; ++i)
+    grads[i] = unwrap(VECTOR_ELT(r_head_grads, i));
+  CHECK_CALL(MXTExecutorBackward(unwrap(r_exec), (mx_uint)n, grads));
+  return R_NilValue;
+}
+
+SEXP MXR_ExecutorOutputs(SEXP r_exec) {
+  mx_uint size;
+  NDArrayHandle *arr;
+  CHECK_CALL(MXTExecutorOutputs(unwrap(r_exec), &size, &arr));
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, size));
+  for (mx_uint i = 0; i < size; ++i)
+    SET_VECTOR_ELT(out, i, wrap_handle(arr[i], ndarray_finalizer));
+  UNPROTECT(1);
+  return out;
+}
+
+/* ---- KVStore --------------------------------------------------------- */
+
+SEXP MXR_KVStoreCreate(SEXP r_type) {
+  KVStoreHandle out;
+  CHECK_CALL(MXTKVStoreCreate(CHAR(Rf_asChar(r_type)), &out));
+  return wrap_handle(out, kvstore_finalizer);
+}
+
+SEXP MXR_KVStoreInit(SEXP r_kv, SEXP r_key, SEXP r_val) {
+  int key = Rf_asInteger(r_key);
+  NDArrayHandle val = unwrap(r_val);
+  CHECK_CALL(MXTKVStoreInit(unwrap(r_kv), 1, &key, &val));
+  return R_NilValue;
+}
+
+SEXP MXR_KVStorePush(SEXP r_kv, SEXP r_key, SEXP r_val) {
+  int key = Rf_asInteger(r_key);
+  NDArrayHandle val = unwrap(r_val);
+  CHECK_CALL(MXTKVStorePush(unwrap(r_kv), 1, &key, &val, 0));
+  return R_NilValue;
+}
+
+SEXP MXR_KVStorePull(SEXP r_kv, SEXP r_key, SEXP r_val) {
+  int key = Rf_asInteger(r_key);
+  NDArrayHandle val = unwrap(r_val);
+  CHECK_CALL(MXTKVStorePull(unwrap(r_kv), 1, &key, &val, 0));
+  return R_NilValue;
+}
+
+/* ---- DataIter -------------------------------------------------------- */
+
+SEXP MXR_DataIterCreate(SEXP r_name, SEXP r_keys, SEXP r_vals) {
+  mx_uint size;
+  DataIterCreator *creators;
+  CHECK_CALL(MXTListDataIters(&size, &creators));
+  DataIterCreator creator = NULL;
+  const char *want = CHAR(Rf_asChar(r_name));
+  for (mx_uint i = 0; i < size; ++i) {
+    const char *name, *desc;
+    mx_uint num_args;
+    const char **an, **at, **ad;
+    CHECK_CALL(MXTDataIterGetIterInfo(creators[i], &name, &desc,
+                                      &num_args, &an, &at, &ad));
+    if (strcmp(name, want) == 0) {
+      creator = creators[i];
+      break;
+    }
+  }
+  if (creator == NULL) Rf_error("mxnet_tpu: unknown iterator %s", want);
+  int n = Rf_length(r_keys);
+  const char **keys =
+      (const char **)R_alloc((size_t)(n ? n : 1), sizeof(char *));
+  const char **vals =
+      (const char **)R_alloc((size_t)(n ? n : 1), sizeof(char *));
+  for (int i = 0; i < n; ++i) {
+    keys[i] = CHAR(STRING_ELT(r_keys, i));
+    vals[i] = CHAR(STRING_ELT(r_vals, i));
+  }
+  DataIterHandle out;
+  CHECK_CALL(MXTDataIterCreateIter(creator, (mx_uint)n, keys, vals,
+                                   &out));
+  return wrap_handle(out, iter_finalizer);
+}
+
+SEXP MXR_DataIterNext(SEXP r_iter) {
+  int out;
+  CHECK_CALL(MXTDataIterNext(unwrap(r_iter), &out));
+  return Rf_ScalarInteger(out);
+}
+
+SEXP MXR_DataIterReset(SEXP r_iter) {
+  CHECK_CALL(MXTDataIterBeforeFirst(unwrap(r_iter)));
+  return R_NilValue;
+}
+
+SEXP MXR_DataIterGetData(SEXP r_iter) {
+  NDArrayHandle out;
+  CHECK_CALL(MXTDataIterGetData(unwrap(r_iter), &out));
+  return wrap_handle(out, ndarray_finalizer);
+}
+
+SEXP MXR_DataIterGetLabel(SEXP r_iter) {
+  NDArrayHandle out;
+  CHECK_CALL(MXTDataIterGetLabel(unwrap(r_iter), &out));
+  return wrap_handle(out, ndarray_finalizer);
+}
+
+SEXP MXR_DataIterGetPad(SEXP r_iter) {
+  int pad;
+  CHECK_CALL(MXTDataIterGetPadNum(unwrap(r_iter), &pad));
+  return Rf_ScalarInteger(pad);
+}
+
+/* ---- registration ----------------------------------------------------- */
+
+#define ENTRY(name, nargs) {#name, (DL_FUNC)&name, nargs}
+
+static const R_CallMethodDef call_methods[] = {
+    ENTRY(MXR_NDArrayCreate, 3),
+    ENTRY(MXR_NDArrayGetShape, 1),
+    ENTRY(MXR_NDArraySyncCopyFrom, 2),
+    ENTRY(MXR_NDArraySyncCopyTo, 2),
+    ENTRY(MXR_NDArraySave, 3),
+    ENTRY(MXR_NDArrayLoad, 1),
+    ENTRY(MXR_FuncInvoke, 4),
+    ENTRY(MXR_SymbolCreateVariable, 1),
+    ENTRY(MXR_SymbolCreateAtomic, 3),
+    ENTRY(MXR_SymbolCompose, 4),
+    ENTRY(MXR_SymbolGroup, 1),
+    ENTRY(MXR_SymbolFromJSON, 1),
+    ENTRY(MXR_SymbolToJSON, 1),
+    ENTRY(MXR_SymbolListArguments, 1),
+    ENTRY(MXR_SymbolListOutputs, 1),
+    ENTRY(MXR_SymbolListAuxiliaryStates, 1),
+    ENTRY(MXR_SymbolInferShape, 3),
+    ENTRY(MXR_ExecutorBind, 7),
+    ENTRY(MXR_ExecutorForward, 2),
+    ENTRY(MXR_ExecutorBackward, 2),
+    ENTRY(MXR_ExecutorOutputs, 1),
+    ENTRY(MXR_KVStoreCreate, 1),
+    ENTRY(MXR_KVStoreInit, 3),
+    ENTRY(MXR_KVStorePush, 3),
+    ENTRY(MXR_KVStorePull, 3),
+    ENTRY(MXR_DataIterCreate, 3),
+    ENTRY(MXR_DataIterNext, 1),
+    ENTRY(MXR_DataIterReset, 1),
+    ENTRY(MXR_DataIterGetData, 1),
+    ENTRY(MXR_DataIterGetLabel, 1),
+    ENTRY(MXR_DataIterGetPad, 1),
+    {NULL, NULL, 0}};
+
+void R_init_mxnet_r(DllInfo *dll) {
+  R_registerRoutines(dll, NULL, call_methods, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
+}

@@ -1,0 +1,60 @@
+/*
+ * MINIMAL R API stub — CI SYNTAX CHECKING ONLY (the repository's image
+ * carries no R installation). Declares just the names src/mxnet_r.c
+ * uses, with the real R API's signatures, so `gcc -fsyntax-only`
+ * catches shim typos; never link against this. Real builds use the
+ * actual R headers via `R CMD INSTALL`.
+ */
+#ifndef MXNET_TPU_R_STUB_R_H_
+#define MXNET_TPU_R_STUB_R_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+typedef struct SEXPREC *SEXP;
+typedef ptrdiff_t R_xlen_t;
+
+typedef enum {
+  NILSXP = 0, INTSXP = 13, REALSXP = 14, STRSXP = 16, VECSXP = 19
+} SEXPTYPE_stub;
+#define SEXPTYPE unsigned int
+
+extern SEXP R_NilValue;
+extern SEXP R_NamesSymbol;
+
+void Rf_error(const char *fmt, ...);
+int Rf_length(SEXP x);
+R_xlen_t Rf_xlength(SEXP x);
+int Rf_asInteger(SEXP x);
+double Rf_asReal(SEXP x);
+SEXP Rf_asChar(SEXP x);
+int Rf_isNull(SEXP x);
+SEXP Rf_allocVector(SEXPTYPE type, R_xlen_t n);
+SEXP Rf_mkChar(const char *s);
+SEXP Rf_mkString(const char *s);
+SEXP Rf_ScalarInteger(int x);
+SEXP Rf_setAttrib(SEXP obj, SEXP name, SEXP val);
+const char *R_CHAR(SEXP x);
+#define CHAR(x) R_CHAR(x)
+double *REAL(SEXP x);
+int *INTEGER(SEXP x);
+SEXP STRING_ELT(SEXP x, R_xlen_t i);
+void SET_STRING_ELT(SEXP x, R_xlen_t i, SEXP v);
+SEXP VECTOR_ELT(SEXP x, R_xlen_t i);
+SEXP SET_VECTOR_ELT(SEXP x, R_xlen_t i, SEXP v);
+SEXP Rf_protect(SEXP x);
+void Rf_unprotect(int n);
+#define PROTECT(x) Rf_protect(x)
+#define UNPROTECT(n) Rf_unprotect(n)
+char *R_alloc(size_t n, int size);
+
+/* external pointers */
+SEXP R_MakeExternalPtr(void *p, SEXP tag, SEXP prot);
+void *R_ExternalPtrAddr(SEXP s);
+void R_ClearExternalPtr(SEXP s);
+typedef void (*R_CFinalizer_t)(SEXP);
+void R_RegisterCFinalizerEx(SEXP s, R_CFinalizer_t fun, int onexit);
+#define TRUE 1
+#define FALSE 0
+
+#endif /* MXNET_TPU_R_STUB_R_H_ */

@@ -1,0 +1,27 @@
+/* stub — see ../R.h; registration declarations only */
+#ifndef MXNET_TPU_R_STUB_RDYNLOAD_H_
+#define MXNET_TPU_R_STUB_RDYNLOAD_H_
+
+typedef void *(*DL_FUNC)(void);
+typedef struct _DllInfo DllInfo;
+
+typedef struct {
+  const char *name;
+  DL_FUNC fun;
+  int numArgs;
+} R_CallMethodDef;
+
+typedef struct {
+  const char *name;
+  DL_FUNC fun;
+  int numArgs;
+  void *types;
+} R_CMethodDef;
+
+int R_registerRoutines(DllInfo *info, const R_CMethodDef *croutines,
+                       const R_CallMethodDef *callRoutines,
+                       const void *fortranRoutines,
+                       const void *externalRoutines);
+int R_useDynamicSymbols(DllInfo *info, int value);
+
+#endif

@@ -1,0 +1,5 @@
+/* stub — see R.h; Rinternals contents are folded into R.h here */
+#ifndef MXNET_TPU_R_STUB_RINTERNALS_H_
+#define MXNET_TPU_R_STUB_RINTERNALS_H_
+#include "R.h"
+#endif

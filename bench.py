@@ -71,9 +71,14 @@ def _timed_steps(trainer, batch, steps):
         return time.perf_counter() - tic
 
     chain(3)  # warmup/compile
-    t1 = chain(steps)
-    t2 = chain(2 * steps)
-    return max(t2 - t1, 1e-9)
+    for _ in range(3):
+        t1 = chain(steps)
+        t2 = chain(2 * steps)
+        if t2 - t1 > 0.02 * t1:  # sane difference, not relay jitter
+            return t2 - t1
+    # relay glitch persisted: fall back to the conservative whole-chain
+    # time (includes the fixed flush cost -> underestimates throughput)
+    return t2 / 2.0
 
 
 def _make_trainer_and_batches(sym, shapes, n_classes, compute_dtype,
@@ -124,9 +129,15 @@ def bench_resnet50(batch, steps=20):
         return time.perf_counter() - tic
 
     chain_h2d(2)
-    t1 = chain_h2d(steps // 2)
-    t2 = chain_h2d(steps)
-    ips_h2d = batch * (steps - steps // 2) / max(t2 - t1, 1e-9)
+    ips_h2d = None
+    for _ in range(3):
+        t1 = chain_h2d(steps // 2)
+        t2 = chain_h2d(steps)
+        if t2 - t1 > 0.02 * t1:
+            ips_h2d = batch * (steps - steps // 2) / (t2 - t1)
+            break
+    if ips_h2d is None:  # relay glitch: conservative whole-chain rate
+        ips_h2d = batch * steps / t2
 
     mfu = ips * _RESNET50_TRAIN_FLOPS_PER_IMG / _peak_flops(jax.devices()[0])
     return ips, ips_h2d, mfu

@@ -1,0 +1,123 @@
+classdef model < handle
+%MODEL mxnet_tpu inference model (reference matlab/+mxnet/model.m).
+%   Wraps the native predict ABI (cpp/c_predict_api.h) via
+%   loadlibrary/calllib -- no MEX compilation required.
+%
+%   model = mxnet_tpu.model;
+%   model.load('model/resnet-50', 9);
+%   pred = model.forward(single(img));   % img: H x W x C x N
+
+properties (Access = private)
+  predictor = libpointer('voidPtr', 0);
+  symbol_json = '';
+  param_bytes = [];
+  prev_shape = [];
+  dev_type = 1;   % 1 = cpu, 2+ = accelerator (advisory; XLA places)
+  dev_id = 0;
+end
+
+methods
+  function obj = model()
+    mxnet_tpu.private.ensure_lib();
+  end
+
+  function load(obj, prefix, epoch)
+  %LOAD read prefix-symbol.json and prefix-%04d.params (the
+  %   checkpoint format every binding shares).
+    jsonf = sprintf('%s-symbol.json', prefix);
+    paramf = sprintf('%s-%04d.params', prefix, epoch);
+    fid = fopen(jsonf, 'r');
+    assert(fid >= 0, 'cannot open %s', jsonf);
+    obj.symbol_json = fread(fid, inf, '*char')';
+    fclose(fid);
+    fid = fopen(paramf, 'rb');
+    assert(fid >= 0, 'cannot open %s', paramf);
+    obj.param_bytes = fread(fid, inf, '*uint8');
+    fclose(fid);
+    obj.free_predictor();
+  end
+
+  function out = forward(obj, img, varargin)
+  %FORWARD run inference. img: single [H W C N] (or [H W C]).
+  %   Name-value: 'device', {'cpu'|'tpu'}, 'id', n.
+    assert(~isempty(obj.symbol_json), 'call load() first');
+    i = 1;
+    while i <= numel(varargin)
+      switch lower(varargin{i})
+        case {'cpu'}
+          obj.dev_type = 1; i = i + 1;
+        case {'tpu', 'gpu'}
+          obj.dev_type = 2; i = i + 1;
+          if i <= numel(varargin) && isnumeric(varargin{i})
+            obj.dev_id = varargin{i}; i = i + 1;
+          end
+        otherwise
+          error('unknown option %s', varargin{i});
+      end
+    end
+    if ndims(img) == 3
+      img = reshape(img, [size(img) 1]);
+    end
+    % MATLAB [H W C N] col-major == framework [N C W H] row-major;
+    % permute to [W H C N] so the framework sees [N C H W]
+    img = permute(single(img), [2 1 3 4]);
+    sz = size(img);
+    shape = uint32([sz(4) sz(3) sz(2) sz(1)]);  % framework N C H W
+    if isempty(obj.prev_shape) || ~isequal(obj.prev_shape, shape) ...
+        || isNull(obj.predictor)
+      obj.make_predictor(shape);
+      obj.prev_shape = shape;
+    end
+    obj.check(calllib('libmxnet_tpu_predict', 'MXTPredSetInput', ...
+        obj.predictor, 'data', single(img(:)), uint32(numel(img))));
+    obj.check(calllib('libmxnet_tpu_predict', 'MXTPredForward', ...
+        obj.predictor));
+    % output 0 shape
+    ndimPtr = libpointer('uint32Ptr', 0);
+    shapePtr = libpointer('uint32PtrPtr');
+    obj.check(calllib('libmxnet_tpu_predict', ...
+        'MXTPredGetOutputShape', obj.predictor, uint32(0), ...
+        shapePtr, ndimPtr));
+    nd = double(ndimPtr.Value);
+    setdatatype(shapePtr.Value, 'uint32Ptr', nd);
+    oshape = double(shapePtr.Value);
+    n = prod(oshape);
+    buf = libpointer('singlePtr', zeros(n, 1, 'single'));
+    obj.check(calllib('libmxnet_tpu_predict', 'MXTPredGetOutput', ...
+        obj.predictor, uint32(0), buf, uint32(n)));
+    % framework [N K] row-major == MATLAB [K N] col-major: done
+    out = reshape(buf.Value, fliplr(oshape));
+  end
+
+  function delete(obj)
+    obj.free_predictor();
+  end
+end
+
+methods (Access = private)
+  function make_predictor(obj, shape)
+    obj.free_predictor();
+    p = libpointer('voidPtrPtr');
+    csr = uint32([0 numel(shape)]);
+    obj.check(calllib('libmxnet_tpu_predict', 'MXTPredCreate', ...
+        obj.symbol_json, obj.param_bytes, ...
+        int32(numel(obj.param_bytes)), int32(obj.dev_type), ...
+        int32(obj.dev_id), uint32(1), {'data'}, csr, shape, p));
+    obj.predictor = p.Value;
+  end
+
+  function free_predictor(obj)
+    if ~isNull(obj.predictor)
+      calllib('libmxnet_tpu_predict', 'MXTPredFree', obj.predictor);
+      obj.predictor = libpointer('voidPtr', 0);
+    end
+  end
+
+  function check(~, ret)
+    if ret ~= 0
+      err = calllib('libmxnet_tpu_predict', 'MXTPredGetLastError');
+      error('mxnet_tpu: %s', err);
+    end
+  end
+end
+end

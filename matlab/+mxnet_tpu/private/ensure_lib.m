@@ -1,0 +1,11 @@
+function ensure_lib()
+%ENSURE_LIB load libmxnet_tpu_predict once (reference
+%   matlab/+mxnet/private/parse_json.m-era loadlibrary pattern).
+if ~libisloaded('libmxnet_tpu_predict')
+  here = fileparts(fileparts(fileparts(mfilename('fullpath'))));
+  root = fileparts(here);
+  libdir = fullfile(root, 'mxnet_tpu', 'lib');
+  header = fullfile(root, 'cpp', 'c_predict_api.h');
+  loadlibrary(fullfile(libdir, 'libmxnet_tpu_predict.so'), header);
+end
+end

@@ -1,0 +1,14 @@
+%% mxnet_tpu MATLAB demo (reference matlab/demo.m).
+% Train and checkpoint a model with the Python package first, e.g.
+%   model.save_checkpoint('model/mlp', 10)
+% then run inference from MATLAB:
+
+model = mxnet_tpu.model;
+model.load('model/mlp', 10);
+
+% fake batch: 28x28 grayscale, batch of 2
+img = single(rand(28, 28, 1, 2));
+pred = model.forward(img);
+fprintf('output: %d classes x %d images\n', size(pred, 1), size(pred, 2));
+[~, cls] = max(pred, [], 1);
+disp(cls - 1);  % zero-based class ids

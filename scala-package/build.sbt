@@ -1,0 +1,16 @@
+// mxnet_tpu Scala binding (see README.md). Requires a JDK + sbt.
+ThisBuild / organization := "ml.dmlc"
+ThisBuild / version := "0.1.0-SNAPSHOT"
+ThisBuild / scalaVersion := "2.13.12"
+
+lazy val core = (project in file("core"))
+  .settings(
+    name := "mxnet-tpu-core",
+    libraryDependencies ++= Seq(
+      "net.java.dev.jna" % "jna" % "5.13.0",
+      "org.scalatest" %% "scalatest" % "3.2.17" % Test
+    ),
+    // libmxnet_tpu.so from `make -C ../cpp`
+    Test / fork := true,
+    Test / javaOptions += s"-Djna.library.path=${baseDirectory.value / ".." / ".." / "mxnet_tpu" / "lib"}"
+  )

@@ -1,0 +1,179 @@
+/*
+ * C ABI surface (cpp/c_api_graph.h) declared once for JNA direct
+ * mapping. Reference analogue: scala-package/native/src/main/native/
+ * ml_dmlc_mxnet_native_c_api.cc (hand-written JNI marshalling) +
+ * LibInfo.scala — here the declaration IS the binding.
+ *
+ * Conventions carried over from the C ABI: every native function
+ * returns 0 on success and -1 on failure with the message available
+ * from MXTApiGetLastError() (thread-local); output pointer arrays are
+ * thread-local scratch valid until the next ABI call on the calling
+ * thread, so wrappers copy them out before returning.
+ */
+package ml.dmlc.mxnet_tpu
+
+import com.sun.jna.{Callback, Library, Memory, Native, Pointer}
+import com.sun.jna.ptr.{IntByReference, LongByReference, PointerByReference}
+
+private[mxnet_tpu] trait LibCApi extends Library {
+  def MXTApiGetLastError(): String
+  def MXTRandomSeed(seed: Int): Int
+  def MXTNotifyShutdown(): Int
+
+  // NDArray
+  def MXTNDArrayCreateNone(out: PointerByReference): Int
+  def MXTNDArrayCreateEx(shape: Array[Int], ndim: Int, devType: Int,
+                         devId: Int, delayAlloc: Int, dtype: Int,
+                         out: PointerByReference): Int
+  def MXTNDArrayFree(handle: Pointer): Int
+  def MXTNDArrayGetShape(handle: Pointer, outDim: IntByReference,
+                         outData: PointerByReference): Int
+  def MXTNDArrayGetDType(handle: Pointer, outDtype: IntByReference): Int
+  def MXTNDArrayGetContext(handle: Pointer, outDevType: IntByReference,
+                           outDevId: IntByReference): Int
+  def MXTNDArraySyncCopyFromCPU(handle: Pointer, data: Pointer,
+                                size: Long): Int
+  def MXTNDArraySyncCopyToCPU(handle: Pointer, data: Pointer,
+                              size: Long): Int
+  def MXTNDArrayWaitToRead(handle: Pointer): Int
+  def MXTNDArrayWaitAll(): Int
+  def MXTNDArraySlice(handle: Pointer, begin: Int, end: Int,
+                      out: PointerByReference): Int
+  def MXTNDArrayReshape(handle: Pointer, ndim: Int, dims: Array[Int],
+                        out: PointerByReference): Int
+  def MXTNDArraySave(fname: String, numArgs: Int, args: Array[Pointer],
+                     keys: Array[String]): Int
+  def MXTNDArrayLoad(fname: String, outSize: IntByReference,
+                     outArr: PointerByReference,
+                     outNameSize: IntByReference,
+                     outNames: PointerByReference): Int
+
+  // NDArray function registry
+  def MXTListFunctions(outSize: IntByReference,
+                       outArray: PointerByReference): Int
+  def MXTGetFunction(name: String, out: PointerByReference): Int
+  def MXTFuncGetInfo(fun: Pointer, name: PointerByReference,
+                     description: PointerByReference): Int
+  def MXTFuncDescribe(fun: Pointer, numUsedVars: IntByReference,
+                      numScalars: IntByReference,
+                      numMutateVars: IntByReference,
+                      typeMask: IntByReference): Int
+  def MXTFuncInvoke(fun: Pointer, usedVars: Array[Pointer],
+                    scalarArgs: Array[Float],
+                    mutateVars: Array[Pointer]): Int
+
+  // Symbol
+  def MXTSymbolListAtomicSymbolCreators(outSize: IntByReference,
+                                        outArray: PointerByReference): Int
+  def MXTSymbolGetAtomicSymbolName(creator: Pointer,
+                                   name: PointerByReference): Int
+  def MXTSymbolCreateAtomicSymbol(creator: Pointer, numParam: Int,
+                                  keys: Array[String], vals: Array[String],
+                                  out: PointerByReference): Int
+  def MXTSymbolCreateVariable(name: String, out: PointerByReference): Int
+  def MXTSymbolCreateGroup(numSymbols: Int, symbols: Array[Pointer],
+                           out: PointerByReference): Int
+  def MXTSymbolCreateFromJSON(json: String, out: PointerByReference): Int
+  def MXTSymbolSaveToJSON(symbol: Pointer, outJson: PointerByReference): Int
+  def MXTSymbolFree(symbol: Pointer): Int
+  def MXTSymbolCopy(symbol: Pointer, out: PointerByReference): Int
+  def MXTSymbolPrint(symbol: Pointer, outStr: PointerByReference): Int
+  def MXTSymbolListArguments(symbol: Pointer, outSize: IntByReference,
+                             outStrArray: PointerByReference): Int
+  def MXTSymbolListOutputs(symbol: Pointer, outSize: IntByReference,
+                           outStrArray: PointerByReference): Int
+  def MXTSymbolListAuxiliaryStates(symbol: Pointer,
+                                   outSize: IntByReference,
+                                   outStrArray: PointerByReference): Int
+  def MXTSymbolCompose(sym: Pointer, name: String, numArgs: Int,
+                       keys: Array[String], args: Array[Pointer]): Int
+  def MXTSymbolInferShape(sym: Pointer, numArgs: Int,
+                          keys: Array[String], argIndPtr: Array[Int],
+                          argShapeData: Array[Int],
+                          inShapeSize: IntByReference,
+                          inShapeNdim: PointerByReference,
+                          inShapeData: PointerByReference,
+                          outShapeSize: IntByReference,
+                          outShapeNdim: PointerByReference,
+                          outShapeData: PointerByReference,
+                          auxShapeSize: IntByReference,
+                          auxShapeNdim: PointerByReference,
+                          auxShapeData: PointerByReference,
+                          complete: IntByReference): Int
+
+  // Executor
+  def MXTExecutorFree(handle: Pointer): Int
+  def MXTExecutorPrint(handle: Pointer, outStr: PointerByReference): Int
+  def MXTExecutorForward(handle: Pointer, isTrain: Int): Int
+  def MXTExecutorBackward(handle: Pointer, len: Int,
+                          headGrads: Array[Pointer]): Int
+  def MXTExecutorOutputs(handle: Pointer, outSize: IntByReference,
+                         out: PointerByReference): Int
+  def MXTExecutorBind(symbolHandle: Pointer, devType: Int, devId: Int,
+                      len: Int, inArgs: Array[Pointer],
+                      argGradStore: Array[Pointer],
+                      gradReqType: Array[Int], auxStatesLen: Int,
+                      auxStates: Array[Pointer],
+                      out: PointerByReference): Int
+
+  // DataIter
+  def MXTListDataIters(outSize: IntByReference,
+                       outArray: PointerByReference): Int
+  def MXTDataIterGetIterInfo(creator: Pointer, name: PointerByReference,
+                             description: PointerByReference,
+                             numArgs: IntByReference,
+                             argNames: PointerByReference,
+                             argTypeInfos: PointerByReference,
+                             argDescriptions: PointerByReference): Int
+  def MXTDataIterCreateIter(creator: Pointer, numParam: Int,
+                            keys: Array[String], vals: Array[String],
+                            out: PointerByReference): Int
+  def MXTDataIterFree(handle: Pointer): Int
+  def MXTDataIterNext(handle: Pointer, out: IntByReference): Int
+  def MXTDataIterBeforeFirst(handle: Pointer): Int
+  def MXTDataIterGetData(handle: Pointer, out: PointerByReference): Int
+  def MXTDataIterGetLabel(handle: Pointer, out: PointerByReference): Int
+  def MXTDataIterGetPadNum(handle: Pointer, pad: IntByReference): Int
+
+  // KVStore
+  def MXTKVStoreCreate(`type`: String, out: PointerByReference): Int
+  def MXTKVStoreFree(handle: Pointer): Int
+  def MXTKVStoreInit(handle: Pointer, num: Int, keys: Array[Int],
+                     vals: Array[Pointer]): Int
+  def MXTKVStorePush(handle: Pointer, num: Int, keys: Array[Int],
+                     vals: Array[Pointer], priority: Int): Int
+  def MXTKVStorePull(handle: Pointer, num: Int, keys: Array[Int],
+                     vals: Array[Pointer], priority: Int): Int
+  def MXTKVStoreSetUpdater(handle: Pointer, updater: Base.MXKVStoreUpdater,
+                           updaterHandle: Pointer): Int
+  def MXTKVStoreGetType(handle: Pointer, `type`: PointerByReference): Int
+  def MXTKVStoreGetRank(handle: Pointer, rank: IntByReference): Int
+  def MXTKVStoreGetGroupSize(handle: Pointer, size: IntByReference): Int
+  def MXTKVStoreBarrier(handle: Pointer): Int
+}
+
+object Base {
+  /** updater callback (reference c_api.h MXKVStoreUpdater) */
+  trait MXKVStoreUpdater extends Callback {
+    def invoke(key: Int, recv: Pointer, local: Pointer,
+               handle: Pointer): Unit
+  }
+
+  private[mxnet_tpu] val _LIB: LibCApi =
+    Native.load("mxnet_tpu", classOf[LibCApi])
+
+  class MXNetError(message: String) extends RuntimeException(message)
+
+  /** reference Base.scala checkCall: raise with the native message */
+  @inline def checkCall(ret: Int): Unit =
+    if (ret != 0) throw new MXNetError(_LIB.MXTApiGetLastError())
+
+  /** copy a thread-local `const char**` out into Scala strings */
+  private[mxnet_tpu] def stringArray(p: Pointer, n: Int): IndexedSeq[String] =
+    if (n == 0 || p == null) IndexedSeq.empty
+    else p.getPointerArray(0, n).toIndexedSeq.map(_.getString(0))
+
+  /** copy a thread-local handle array */
+  private[mxnet_tpu] def pointerArray(p: Pointer, n: Int): Array[Pointer] =
+    if (n == 0 || p == null) Array.empty else p.getPointerArray(0, n)
+}

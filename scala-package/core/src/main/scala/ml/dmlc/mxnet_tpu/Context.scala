@@ -1,0 +1,22 @@
+/*
+ * Device context (reference scala-package Context.scala; device codes
+ * from mxnet_tpu/context.py: cpu=1, gpu=2, cpu_pinned=3, tpu=4).
+ */
+package ml.dmlc.mxnet_tpu
+
+case class Context(deviceTypeId: Int, deviceId: Int = 0) {
+  def deviceType: String = Context.devtype2str(deviceTypeId)
+  override def toString: String = s"$deviceType($deviceId)"
+}
+
+object Context {
+  private val devtype2str =
+    Map(1 -> "cpu", 2 -> "gpu", 3 -> "cpu_pinned", 4 -> "tpu")
+
+  def cpu(deviceId: Int = 0): Context = Context(1, deviceId)
+  def gpu(deviceId: Int = 0): Context = Context(2, deviceId)
+  def tpu(deviceId: Int = 0): Context = Context(4, deviceId)
+
+  /** the framework's first-class accelerator (SURVEY: kTPU) */
+  val defaultCtx: Context = tpu(0)
+}

@@ -1,0 +1,42 @@
+/*
+ * Evaluation metrics (reference scala-package EvalMetric.scala).
+ */
+package ml.dmlc.mxnet_tpu
+
+abstract class EvalMetric(val name: String) {
+  protected var sumMetric: Double = 0.0
+  protected var numInst: Int = 0
+
+  def update(labels: IndexedSeq[NDArray], preds: IndexedSeq[NDArray]): Unit
+
+  def reset(): Unit = { sumMetric = 0.0; numInst = 0 }
+
+  def get: (String, Double) =
+    (name, if (numInst == 0) Double.NaN else sumMetric / numInst)
+}
+
+class Accuracy extends EvalMetric("accuracy") {
+  override def update(labels: IndexedSeq[NDArray],
+                      preds: IndexedSeq[NDArray]): Unit = {
+    require(labels.length == preds.length,
+            "labels and predictions should have the same length")
+    labels.zip(preds).foreach { case (label, pred) =>
+      val y = label.toArray
+      val p = pred.toArray
+      val k = pred.shape.last
+      var i = 0
+      while (i < y.length) {
+        var best = 0
+        var bestV = p(i * k)
+        var j = 1
+        while (j < k) {
+          if (p(i * k + j) > bestV) { best = j; bestV = p(i * k + j) }
+          j += 1
+        }
+        if (best == y(i).toInt) sumMetric += 1.0
+        numInst += 1
+        i += 1
+      }
+    }
+  }
+}

@@ -1,0 +1,40 @@
+/*
+ * Bound computation (reference scala-package Executor.scala): one
+ * forward/backward pair over the XLA-compiled program behind the ABI.
+ */
+package ml.dmlc.mxnet_tpu
+
+import com.sun.jna.Pointer
+import com.sun.jna.ptr.{IntByReference, PointerByReference}
+
+import Base._
+
+class Executor private[mxnet_tpu] (private[mxnet_tpu] val handle: Pointer,
+                                   val symbol: Symbol)
+    extends AutoCloseable {
+
+  def forward(isTrain: Boolean = false): Unit =
+    checkCall(_LIB.MXTExecutorForward(handle, if (isTrain) 1 else 0))
+
+  /** loss-headed symbols pass no headGrads (the reference convention) */
+  def backward(headGrads: Seq[NDArray] = Seq.empty): Unit =
+    checkCall(_LIB.MXTExecutorBackward(handle, headGrads.length,
+                                       headGrads.map(_.handle).toArray))
+
+  def outputs: IndexedSeq[NDArray] = {
+    val size = new IntByReference
+    val arr = new PointerByReference
+    checkCall(_LIB.MXTExecutorOutputs(handle, size, arr))
+    pointerArray(arr.getValue, size.getValue)
+      .map(new NDArray(_, writable = false)).toIndexedSeq
+  }
+
+  /** the compiled-plan dump (reference Executor.debugStr) */
+  def debugStr: String = {
+    val out = new PointerByReference
+    checkCall(_LIB.MXTExecutorPrint(handle, out))
+    out.getValue.getString(0)
+  }
+
+  override def close(): Unit = checkCall(_LIB.MXTExecutorFree(handle))
+}

@@ -1,0 +1,144 @@
+/*
+ * Training harness (reference scala-package FeedForward.scala /
+ * Model.scala, compacted): init params by name-pattern, epoch loop of
+ * forward/backward/update over a DataIter, optional KVStore routing,
+ * predict/score.
+ */
+package ml.dmlc.mxnet_tpu
+
+import scala.collection.mutable
+
+class FeedForward(val symbol: Symbol,
+                  val ctx: Context = Context.defaultCtx,
+                  val numEpoch: Int = 10,
+                  val optimizer: Optimizer = new SGD(),
+                  val initializer: Initializer = new Uniform(0.07f)) {
+
+  var argParams: Map[String, NDArray] = Map.empty
+  var auxParams: Map[String, NDArray] = Map.empty
+
+  private def initParams(dataShape: Seq[Int],
+                         labelShape: Seq[Int]): Unit = {
+    val argNames = symbol.listArguments()
+    val dataName = "data"
+    val labelName = argNames.find(_.endsWith("label"))
+      .getOrElse("softmax_label")
+    val shapes = symbol
+      .inferShape(Map(dataName -> dataShape, labelName -> labelShape))
+      .getOrElse(throw new Base.MXNetError("shape inference incomplete"))
+    val (argShapes, _, auxShapes) = shapes
+    val params = mutable.Map.empty[String, NDArray]
+    argNames.zip(argShapes).foreach { case (name, shape) =>
+      if (name != dataName && name != labelName) {
+        val arr = NDArray.empty(shape, ctx)
+        initializer(name, arr)
+        params(name) = arr
+      }
+    }
+    argParams = params.toMap
+    auxParams = symbol.listAuxiliaryStates().zip(auxShapes).map {
+      case (name, shape) =>
+        val arr = NDArray.empty(shape, ctx)
+        initializer(name, arr)
+        name -> arr
+    }.toMap
+  }
+
+  /** one-device fit (the reference's multi-device split rides the same
+    * kvstore path; TPU-side dp scaling lives in the Python trainers) */
+  def fit(trainData: DataIter, evalMetric: EvalMetric = new Accuracy,
+          kvStore: Option[KVStore] = None): Unit = {
+    trainData.reset()
+    val first = trainData.next()
+    val dataShape = first.data.shape
+    val labelShape = first.label.shape
+    if (argParams.isEmpty) initParams(dataShape, labelShape)
+
+    val argNames = symbol.listArguments()
+    val labelName = argNames.find(_.endsWith("label"))
+      .getOrElse("softmax_label")
+    val dataArr = NDArray.empty(dataShape, ctx)
+    val labelArr = NDArray.empty(labelShape, ctx)
+    val paramNames = argNames.filter(n => n != "data" && n != labelName)
+
+    val args = argNames.map {
+      case "data" => dataArr
+      case n if n == labelName => labelArr
+      case n => argParams(n)
+    }
+    val grads = argNames.map {
+      case "data" => None
+      case n if n == labelName => None
+      case n => Some(NDArray.zeros(argParams(n).shape, ctx))
+    }
+    val auxArr = symbol.listAuxiliaryStates().map(auxParams(_))
+    val exec = symbol.bind(ctx, args, grads, "write", auxArr)
+
+    // updates ride the kvstore when given (reference _update_params_on_
+    // kvstore), else apply locally
+    kvStore.foreach { kv =>
+      paramNames.zipWithIndex.foreach { case (n, i) =>
+        kv.init(i, argParams(n))
+      }
+      kv.setUpdater(optimizer.getUpdater)
+    }
+
+    for (epoch <- 0 until numEpoch) {
+      trainData.reset()
+      evalMetric.reset()
+      while (trainData.hasNext) {
+        val batch = trainData.next()
+        batch.data.copyTo(dataArr)
+        batch.label.copyTo(labelArr)
+        exec.forward(isTrain = true)
+        exec.backward()
+        paramNames.zipWithIndex.foreach { case (n, i) =>
+          val g = grads(argNames.indexOf(n)).get
+          kvStore match {
+            case Some(kv) =>
+              kv.push(i, g)
+              kv.pull(i, argParams(n))
+            case None => optimizer.update(i, argParams(n), g)
+          }
+        }
+        evalMetric.update(IndexedSeq(batch.label),
+                          IndexedSeq(exec.outputs.head))
+      }
+      val (name, value) = evalMetric.get
+      println(f"Epoch[$epoch] Train-$name=$value%.5f")
+    }
+    exec.close()
+  }
+
+  def score(evalData: DataIter,
+            evalMetric: EvalMetric = new Accuracy): Double = {
+    evalData.reset()
+    val first = evalData.next()
+    val args = symbol.listArguments()
+    val labelName = args.find(_.endsWith("label"))
+      .getOrElse("softmax_label")
+    val dataArr = NDArray.empty(first.data.shape, ctx)
+    val labelArr = NDArray.empty(first.label.shape, ctx)
+    val bound = symbol.bind(
+      ctx,
+      args.map {
+        case "data" => dataArr
+        case n if n == labelName => labelArr
+        case n => argParams(n)
+      },
+      gradReq = "null",
+      auxStates = symbol.listAuxiliaryStates().map(auxParams(_)))
+    evalData.reset()
+    evalMetric.reset()
+    while (evalData.hasNext) {
+      val batch = evalData.next()
+      batch.data.copyTo(dataArr)
+      batch.label.copyTo(labelArr)
+      bound.forward(isTrain = false)
+      evalMetric.update(IndexedSeq(batch.label),
+                        IndexedSeq(bound.outputs.head))
+    }
+    bound.close()
+    evalMetric.get._2
+  }
+}

@@ -1,0 +1,92 @@
+/*
+ * Data iterators (reference scala-package IO.scala): creators come
+ * from MXTListDataIters introspection (MNISTIter, CSVIter,
+ * ImageRecordIter); DataIter walks next/data/label/pad.
+ */
+package ml.dmlc.mxnet_tpu
+
+import com.sun.jna.Pointer
+import com.sun.jna.ptr.{IntByReference, PointerByReference}
+
+import Base._
+
+class DataBatch(val data: NDArray, val label: NDArray, val pad: Int)
+
+class DataIter private[mxnet_tpu] (private[mxnet_tpu] val handle: Pointer)
+    extends AutoCloseable with Iterator[DataBatch] {
+
+  private var nextReady: Option[Boolean] = None
+
+  def reset(): Unit = {
+    checkCall(_LIB.MXTDataIterBeforeFirst(handle))
+    nextReady = None
+  }
+
+  override def hasNext: Boolean = nextReady match {
+    case Some(v) => v
+    case None =>
+      val out = new IntByReference
+      checkCall(_LIB.MXTDataIterNext(handle, out))
+      val v = out.getValue == 1
+      nextReady = Some(v)
+      v
+  }
+
+  override def next(): DataBatch = {
+    if (!hasNext) throw new NoSuchElementException("DataIter exhausted")
+    nextReady = None
+    val d = new PointerByReference
+    val l = new PointerByReference
+    val pad = new IntByReference
+    checkCall(_LIB.MXTDataIterGetData(handle, d))
+    checkCall(_LIB.MXTDataIterGetLabel(handle, l))
+    checkCall(_LIB.MXTDataIterGetPadNum(handle, pad))
+    new DataBatch(new NDArray(d.getValue, writable = false),
+                  new NDArray(l.getValue, writable = false),
+                  pad.getValue)
+  }
+
+  override def close(): Unit = checkCall(_LIB.MXTDataIterFree(handle))
+}
+
+object IO {
+  /** iterator name -> creator, introspected once (reference IO.scala
+    * initIOModule) */
+  private lazy val creators: Map[String, Pointer] = {
+    val size = new IntByReference
+    val arr = new PointerByReference
+    checkCall(_LIB.MXTListDataIters(size, arr))
+    pointerArray(arr.getValue, size.getValue).map { c =>
+      val name = new PointerByReference
+      val desc = new PointerByReference
+      val nArgs = new IntByReference
+      val an = new PointerByReference
+      val at = new PointerByReference
+      val ad = new PointerByReference
+      checkCall(_LIB.MXTDataIterGetIterInfo(c, name, desc, nArgs,
+                                            an, at, ad))
+      name.getValue.getString(0) -> c
+    }.toMap
+  }
+
+  def createIterator(name: String,
+                     params: Map[String, String]): DataIter = {
+    val creator = creators.getOrElse(
+      name, throw new Base.MXNetError(
+        s"unknown iterator $name (have: ${creators.keys.mkString(", ")})"))
+    val (keys, vals) = params.toSeq.unzip
+    val out = new PointerByReference
+    checkCall(_LIB.MXTDataIterCreateIter(creator, keys.length,
+                                         keys.toArray, vals.toArray, out))
+    new DataIter(out.getValue)
+  }
+
+  def MNISTIter(params: Map[String, String]): DataIter =
+    createIterator("MNISTIter", params)
+
+  def CSVIter(params: Map[String, String]): DataIter =
+    createIterator("CSVIter", params)
+
+  def ImageRecordIter(params: Map[String, String]): DataIter =
+    createIterator("ImageRecordIter", params)
+}

@@ -1,0 +1,39 @@
+/*
+ * Weight initializers (reference scala-package Initializer.scala:
+ * name-pattern dispatch — bias/gamma/beta/moving_* get fixed values,
+ * weights get the sampler).
+ */
+package ml.dmlc.mxnet_tpu
+
+import scala.util.Random
+
+abstract class Initializer(seed: Long = 0L) {
+  protected val rng = new Random(seed)
+
+  def apply(name: String, arr: NDArray): Unit = {
+    if (name.endsWith("bias") || name.endsWith("beta")
+        || name.endsWith("moving_mean")) arr.set(0f)
+    else if (name.endsWith("gamma") || name.endsWith("moving_var"))
+      arr.set(1f)
+    else initWeight(name, arr)
+  }
+
+  protected def initWeight(name: String, arr: NDArray): Unit
+}
+
+class Uniform(scale: Float = 0.07f, seed: Long = 0L)
+    extends Initializer(seed) {
+  override protected def initWeight(name: String, arr: NDArray): Unit =
+    arr.set(Array.fill(arr.size)((rng.nextFloat() * 2 - 1) * scale))
+}
+
+class Xavier(magnitude: Float = 3f, seed: Long = 0L)
+    extends Initializer(seed) {
+  override protected def initWeight(name: String, arr: NDArray): Unit = {
+    val shape = arr.shape
+    val fanOut = shape.head.toFloat
+    val fanIn = if (shape.length > 1) shape.tail.product.toFloat else 1f
+    val scale = math.sqrt(magnitude / ((fanIn + fanOut) / 2.0)).toFloat
+    arr.set(Array.fill(arr.size)((rng.nextFloat() * 2 - 1) * scale))
+  }
+}

@@ -1,0 +1,74 @@
+/*
+ * Key-value store (reference scala-package KVStore.scala): init/push/
+ * pull plus a JVM updater callback — JNA turns the Scala closure into
+ * the C function pointer the ABI expects (the reference needed a JNI
+ * trampoline for this).
+ */
+package ml.dmlc.mxnet_tpu
+
+import com.sun.jna.Pointer
+import com.sun.jna.ptr.{IntByReference, PointerByReference}
+
+import Base._
+
+class KVStore private[mxnet_tpu] (private[mxnet_tpu] val handle: Pointer)
+    extends AutoCloseable {
+
+  // hold the callback so the JVM does not collect the trampoline
+  private var updaterRef: Option[MXKVStoreUpdater] = None
+
+  def init(key: Int, value: NDArray): Unit =
+    checkCall(_LIB.MXTKVStoreInit(handle, 1, Array(key),
+                                  Array(value.handle)))
+
+  def push(key: Int, value: NDArray, priority: Int = 0): Unit =
+    checkCall(_LIB.MXTKVStorePush(handle, 1, Array(key),
+                                  Array(value.handle), priority))
+
+  def pull(key: Int, out: NDArray, priority: Int = 0): Unit =
+    checkCall(_LIB.MXTKVStorePull(handle, 1, Array(key),
+                                  Array(out.handle), priority))
+
+  /** updater(key, recv, local): runs where the reference's "update on
+    * kvstore" path runs */
+  def setUpdater(updater: (Int, NDArray, NDArray) => Unit): Unit = {
+    val cb = new MXKVStoreUpdater {
+      override def invoke(key: Int, recv: Pointer, local: Pointer,
+                          h: Pointer): Unit =
+        updater(key, new NDArray(recv, writable = false),
+                new NDArray(local))
+    }
+    updaterRef = Some(cb)
+    checkCall(_LIB.MXTKVStoreSetUpdater(handle, cb, Pointer.NULL))
+  }
+
+  def `type`: String = {
+    val out = new PointerByReference
+    checkCall(_LIB.MXTKVStoreGetType(handle, out))
+    out.getValue.getString(0)
+  }
+
+  def rank: Int = {
+    val out = new IntByReference
+    checkCall(_LIB.MXTKVStoreGetRank(handle, out))
+    out.getValue
+  }
+
+  def numWorkers: Int = {
+    val out = new IntByReference
+    checkCall(_LIB.MXTKVStoreGetGroupSize(handle, out))
+    out.getValue
+  }
+
+  def barrier(): Unit = checkCall(_LIB.MXTKVStoreBarrier(handle))
+
+  override def close(): Unit = checkCall(_LIB.MXTKVStoreFree(handle))
+}
+
+object KVStore {
+  def create(kvType: String = "local"): KVStore = {
+    val out = new PointerByReference
+    checkCall(_LIB.MXTKVStoreCreate(kvType, out))
+    new KVStore(out.getValue)
+  }
+}

@@ -1,0 +1,161 @@
+/*
+ * Device array (reference scala-package NDArray.scala over c_api.h
+ * NDArray calls). Math dispatches through the NDArray function
+ * registry (MXTGetFunction/MXTFuncInvoke) exactly like the reference
+ * synthesizes BinaryFunction/UnaryFunction wrappers at init; the typed
+ * convenience wrappers live in gen/GeneratedOps.scala.
+ */
+package ml.dmlc.mxnet_tpu
+
+import com.sun.jna.{Memory, Pointer}
+import com.sun.jna.ptr.{IntByReference, PointerByReference}
+
+import Base._
+
+class NDArray private[mxnet_tpu] (private[mxnet_tpu] val handle: Pointer,
+                                  val writable: Boolean = true)
+    extends AutoCloseable {
+
+  def shape: IndexedSeq[Int] = {
+    val ndim = new IntByReference
+    val data = new PointerByReference
+    checkCall(_LIB.MXTNDArrayGetShape(handle, ndim, data))
+    if (ndim.getValue == 0) IndexedSeq.empty
+    else data.getValue.getIntArray(0, ndim.getValue).toIndexedSeq
+  }
+
+  def size: Int = shape.product
+
+  def context: Context = {
+    val devType = new IntByReference
+    val devId = new IntByReference
+    checkCall(_LIB.MXTNDArrayGetContext(handle, devType, devId))
+    Context(devType.getValue, devId.getValue)
+  }
+
+  /** blocking read to host (reference NDArray.toArray) */
+  def toArray: Array[Float] = {
+    val n = size
+    val buf = new Memory(n.toLong * 4)
+    checkCall(_LIB.MXTNDArraySyncCopyToCPU(handle, buf, n.toLong))
+    buf.getFloatArray(0, n)
+  }
+
+  def set(values: Array[Float]): this.type = {
+    require(writable, "trying to write to a readonly NDArray")
+    require(values.length == size, "array size mismatch")
+    val buf = new Memory(values.length.toLong * 4)
+    buf.write(0, values, 0, values.length)
+    checkCall(_LIB.MXTNDArraySyncCopyFromCPU(handle, buf,
+                                             values.length.toLong))
+    this
+  }
+
+  def set(value: Float): this.type = {
+    require(writable, "trying to write to a readonly NDArray")
+    NDArray.invoke("_set_value", Array.empty, Array(value), Array(this))
+    this
+  }
+
+  def slice(start: Int, stop: Int): NDArray = {
+    val out = new PointerByReference
+    checkCall(_LIB.MXTNDArraySlice(handle, start, stop, out))
+    new NDArray(out.getValue, writable)
+  }
+
+  def reshape(dims: Array[Int]): NDArray = {
+    val out = new PointerByReference
+    checkCall(_LIB.MXTNDArrayReshape(handle, dims.length, dims, out))
+    new NDArray(out.getValue, writable)
+  }
+
+  def waitToRead(): Unit = checkCall(_LIB.MXTNDArrayWaitToRead(handle))
+
+  def copyTo(other: NDArray): NDArray = {
+    NDArray.invoke("_copyto", Array(this), Array.empty, Array(other))
+    other
+  }
+
+  def +(other: NDArray): NDArray = NDArray.binary("_plus", this, other)
+  def -(other: NDArray): NDArray = NDArray.binary("_minus", this, other)
+  def *(other: NDArray): NDArray = NDArray.binary("_mul", this, other)
+  def /(other: NDArray): NDArray = NDArray.binary("_div", this, other)
+  def +(s: Float): NDArray = NDArray.scalarOp("_plus_scalar", this, s)
+  def -(s: Float): NDArray = NDArray.scalarOp("_minus_scalar", this, s)
+  def *(s: Float): NDArray = NDArray.scalarOp("_mul_scalar", this, s)
+  def /(s: Float): NDArray = NDArray.scalarOp("_div_scalar", this, s)
+
+  def +=(other: NDArray): this.type = {
+    NDArray.invoke("_plus", Array(this, other), Array.empty, Array(this))
+    this
+  }
+
+  override def close(): Unit = checkCall(_LIB.MXTNDArrayFree(handle))
+}
+
+object NDArray {
+  def empty(shape: Seq[Int],
+            ctx: Context = Context.defaultCtx): NDArray = {
+    val out = new PointerByReference
+    checkCall(_LIB.MXTNDArrayCreateEx(shape.toArray, shape.length,
+                                      ctx.deviceTypeId, ctx.deviceId,
+                                      0, 0, out))
+    new NDArray(out.getValue)
+  }
+
+  def zeros(shape: Seq[Int],
+            ctx: Context = Context.defaultCtx): NDArray =
+    empty(shape, ctx).set(0f)
+
+  def ones(shape: Seq[Int],
+           ctx: Context = Context.defaultCtx): NDArray =
+    empty(shape, ctx).set(1f)
+
+  def array(values: Array[Float], shape: Seq[Int],
+            ctx: Context = Context.defaultCtx): NDArray =
+    empty(shape, ctx).set(values)
+
+  /** registry dispatch (reference MXFuncInvoke path) */
+  private[mxnet_tpu] def invoke(name: String, used: Array[NDArray],
+                                scalars: Array[Float],
+                                mutate: Array[NDArray]): Unit = {
+    val fn = new PointerByReference
+    checkCall(_LIB.MXTGetFunction(name, fn))
+    checkCall(_LIB.MXTFuncInvoke(fn.getValue, used.map(_.handle),
+                                 scalars, mutate.map(_.handle)))
+  }
+
+  private def binary(name: String, lhs: NDArray, rhs: NDArray): NDArray = {
+    val out = empty(lhs.shape, lhs.context)
+    invoke(name, Array(lhs, rhs), Array.empty, Array(out))
+    out
+  }
+
+  private def scalarOp(name: String, lhs: NDArray, s: Float): NDArray = {
+    val out = empty(lhs.shape, lhs.context)
+    invoke(name, Array(lhs), Array(s), Array(out))
+    out
+  }
+
+  def save(fname: String, arrays: Map[String, NDArray]): Unit = {
+    val (names, handles) = arrays.toSeq.unzip
+    checkCall(_LIB.MXTNDArraySave(fname, handles.length,
+                                  handles.map(_.handle).toArray,
+                                  names.toArray))
+  }
+
+  def load(fname: String): Map[String, NDArray] = {
+    val outSize = new IntByReference
+    val outArr = new PointerByReference
+    val nameSize = new IntByReference
+    val names = new PointerByReference
+    checkCall(_LIB.MXTNDArrayLoad(fname, outSize, outArr, nameSize, names))
+    val handles = pointerArray(outArr.getValue, outSize.getValue)
+    val keys = stringArray(names.getValue, nameSize.getValue)
+    require(keys.length == handles.length,
+            "unnamed NDArray list load: use loadList")
+    keys.zip(handles.map(new NDArray(_))).toMap
+  }
+
+  def waitall(): Unit = checkCall(_LIB.MXTNDArrayWaitAll())
+}

@@ -1,0 +1,43 @@
+/*
+ * Optimizers (reference scala-package Optimizer.scala — SGD with
+ * momentum/wd, the update math of src/optimizer/sgd-inl.h, applied
+ * host-side through NDArray registry ops).
+ */
+package ml.dmlc.mxnet_tpu
+
+import scala.collection.mutable
+
+abstract class Optimizer extends Serializable {
+  def update(index: Int, weight: NDArray, grad: NDArray): Unit
+
+  /** reference Optimizer.getUpdater: closure for KVStore.setUpdater */
+  def getUpdater: (Int, NDArray, NDArray) => Unit =
+    (index, grad, weight) => update(index, weight, grad)
+}
+
+class SGD(val learningRate: Float = 0.01f, val momentum: Float = 0f,
+          val wd: Float = 0f, val rescaleGrad: Float = 1f,
+          val clipGradient: Float = 0f) extends Optimizer {
+
+  private val momenta = mutable.Map.empty[Int, NDArray]
+
+  override def update(index: Int, weight: NDArray, grad: NDArray): Unit = {
+    var g = grad * rescaleGrad
+    if (clipGradient > 0f) {
+      NDArray.invoke("clip", Array(g),
+                     Array(-clipGradient, clipGradient), Array(g))
+    }
+    if (wd > 0f) g = g + (weight * wd)
+    if (momentum == 0f) {
+      // w -= lr * g
+      (weight += (g * (-learningRate))): Unit
+    } else {
+      val mom = momenta.getOrElseUpdate(
+        index, NDArray.zeros(weight.shape, weight.context))
+      // mom = momentum * mom - lr * g; w += mom
+      val newMom = (mom * momentum) + (g * (-learningRate))
+      newMom.copyTo(mom)
+      (weight += mom): Unit
+    }
+  }
+}

@@ -1,0 +1,169 @@
+/*
+ * Symbolic graph handle (reference scala-package Symbol.scala). Atomic
+ * symbols come from the registry (MXTSymbolListAtomicSymbolCreators);
+ * typed creators are generated into gen/GeneratedOps.scala from the API
+ * manifest, mirroring the reference's macro-generated ops.
+ */
+package ml.dmlc.mxnet_tpu
+
+import com.sun.jna.Pointer
+import com.sun.jna.ptr.{IntByReference, PointerByReference}
+
+import Base._
+
+class Symbol private[mxnet_tpu] (private[mxnet_tpu] val handle: Pointer)
+    extends AutoCloseable {
+
+  def listArguments(): IndexedSeq[String] =
+    Symbol.strList(handle, _LIB.MXTSymbolListArguments)
+
+  def listOutputs(): IndexedSeq[String] =
+    Symbol.strList(handle, _LIB.MXTSymbolListOutputs)
+
+  def listAuxiliaryStates(): IndexedSeq[String] =
+    Symbol.strList(handle, _LIB.MXTSymbolListAuxiliaryStates)
+
+  def toJson: String = {
+    val out = new PointerByReference
+    checkCall(_LIB.MXTSymbolSaveToJSON(handle, out))
+    out.getValue.getString(0)
+  }
+
+  def copy(): Symbol = {
+    val out = new PointerByReference
+    checkCall(_LIB.MXTSymbolCopy(handle, out))
+    new Symbol(out.getValue)
+  }
+
+  def debugStr: String = {
+    val out = new PointerByReference
+    checkCall(_LIB.MXTSymbolPrint(handle, out))
+    out.getValue.getString(0)
+  }
+
+  /** keyword compose: sym(name, "data" -> x, ...) */
+  def compose(name: String, kwargs: Map[String, Symbol]): this.type = {
+    val (keys, args) = kwargs.toSeq.unzip
+    checkCall(_LIB.MXTSymbolCompose(handle, name, args.length,
+                                    keys.toArray,
+                                    args.map(_.handle).toArray))
+    this
+  }
+
+  /** infer shapes from named argument shapes; returns
+    * (argShapes, outShapes, auxShapes) or None if incomplete */
+  def inferShape(kwargs: Map[String, Seq[Int]])
+      : Option[(IndexedSeq[IndexedSeq[Int]], IndexedSeq[IndexedSeq[Int]],
+                IndexedSeq[IndexedSeq[Int]])] = {
+    val keys = kwargs.keys.toArray
+    val indPtr = kwargs.values.scanLeft(0)(_ + _.length).toArray
+    val shapeData = kwargs.values.flatten.toArray
+    val (inN, inNd, inD) = (new IntByReference, new PointerByReference,
+                            new PointerByReference)
+    val (outN, outNd, outD) = (new IntByReference, new PointerByReference,
+                               new PointerByReference)
+    val (auxN, auxNd, auxD) = (new IntByReference, new PointerByReference,
+                               new PointerByReference)
+    val complete = new IntByReference
+    checkCall(_LIB.MXTSymbolInferShape(
+      handle, keys.length, keys, indPtr, shapeData,
+      inN, inNd, inD, outN, outNd, outD, auxN, auxNd, auxD, complete))
+    if (complete.getValue == 0) None
+    else Some((Symbol.shapes(inN, inNd, inD),
+               Symbol.shapes(outN, outNd, outD),
+               Symbol.shapes(auxN, auxNd, auxD)))
+  }
+
+  /** bind with user arrays (reference simple_bind is layered above) */
+  def bind(ctx: Context, args: Seq[NDArray],
+           argsGrad: Seq[Option[NDArray]] = Seq.empty,
+           gradReq: String = "write",
+           auxStates: Seq[NDArray] = Seq.empty): Executor = {
+    val grads =
+      if (argsGrad.isEmpty) args.map(_ => Pointer.NULL)
+      else argsGrad.map(_.map(_.handle).getOrElse(Pointer.NULL))
+    val req = Map("null" -> 0, "write" -> 1, "add" -> 3)(gradReq)
+    val reqs = args.map(_ => req).toArray
+    val out = new PointerByReference
+    checkCall(_LIB.MXTExecutorBind(
+      handle, ctx.deviceTypeId, ctx.deviceId, args.length,
+      args.map(_.handle).toArray, grads.toArray, reqs,
+      auxStates.length, auxStates.map(_.handle).toArray, out))
+    new Executor(out.getValue, this)
+  }
+
+  override def close(): Unit = checkCall(_LIB.MXTSymbolFree(handle))
+}
+
+object Symbol {
+  def Variable(name: String): Symbol = {
+    val out = new PointerByReference
+    checkCall(_LIB.MXTSymbolCreateVariable(name, out))
+    new Symbol(out.getValue)
+  }
+
+  def Group(symbols: Symbol*): Symbol = {
+    val out = new PointerByReference
+    checkCall(_LIB.MXTSymbolCreateGroup(symbols.length,
+                                        symbols.map(_.handle).toArray,
+                                        out))
+    new Symbol(out.getValue)
+  }
+
+  def fromJson(json: String): Symbol = {
+    val out = new PointerByReference
+    checkCall(_LIB.MXTSymbolCreateFromJSON(json, out))
+    new Symbol(out.getValue)
+  }
+
+  /** create an atomic symbol by operator name and compose its inputs —
+    * the primitive the generated typed creators call */
+  def createFromNamedArgs(op: String, name: String,
+                          params: Map[String, String],
+                          inputs: Map[String, Symbol]): Symbol = {
+    val creator = creators.getOrElse(
+      op, throw new Base.MXNetError(s"unknown operator $op"))
+    val (keys, vals) = params.toSeq.unzip
+    val out = new PointerByReference
+    checkCall(_LIB.MXTSymbolCreateAtomicSymbol(
+      creator, keys.length, keys.toArray, vals.toArray, out))
+    val sym = new Symbol(out.getValue)
+    sym.compose(name, inputs)
+    sym
+  }
+
+  /** operator name -> creator handle, introspected once at startup
+    * (reference Symbol.scala initSymbolModule) */
+  private lazy val creators: Map[String, Pointer] = {
+    val size = new IntByReference
+    val arr = new PointerByReference
+    checkCall(_LIB.MXTSymbolListAtomicSymbolCreators(size, arr))
+    pointerArray(arr.getValue, size.getValue).map { c =>
+      val name = new PointerByReference
+      checkCall(_LIB.MXTSymbolGetAtomicSymbolName(c, name))
+      name.getValue.getString(0) -> c
+    }.toMap
+  }
+
+  private def strList(h: Pointer,
+                      f: (Pointer, IntByReference, PointerByReference)
+                        => Int): IndexedSeq[String] = {
+    val size = new IntByReference
+    val arr = new PointerByReference
+    checkCall(f(h, size, arr))
+    stringArray(arr.getValue, size.getValue)
+  }
+
+  private def shapes(n: IntByReference, ndim: PointerByReference,
+                     data: PointerByReference)
+      : IndexedSeq[IndexedSeq[Int]] = {
+    val count = n.getValue
+    if (count == 0) return IndexedSeq.empty
+    val ndims = ndim.getValue.getIntArray(0, count)
+    val rows = pointerArray(data.getValue, count)
+    (0 until count).map { i =>
+      if (ndims(i) == 0) IndexedSeq.empty[Int]
+      else rows(i).getIntArray(0, ndims(i)).toIndexedSeq
+    }
+  }
+}

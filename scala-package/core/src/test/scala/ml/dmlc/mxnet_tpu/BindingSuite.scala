@@ -1,0 +1,54 @@
+/*
+ * Binding smoke tests (reference scala-package core tests). Run with a
+ * JVM + `make -C ../cpp` artifacts on jna.library.path:
+ *     sbt test
+ * The CI image for this repository has no JVM, so these exercise the
+ * same ABI surface the C client (cpp/example/train_c.c) pins in CI.
+ */
+package ml.dmlc.mxnet_tpu
+
+import org.scalatest.funsuite.AnyFunSuite
+
+class BindingSuite extends AnyFunSuite {
+
+  test("NDArray create/set/read round trip") {
+    val a = NDArray.array(Array(1f, 2f, 3f, 4f), Seq(2, 2), Context.cpu())
+    assert(a.shape === IndexedSeq(2, 2))
+    assert(a.toArray === Array(1f, 2f, 3f, 4f))
+    val b = a + a
+    assert(b.toArray === Array(2f, 4f, 6f, 8f))
+    val c = a * 2f
+    assert(c.toArray === Array(2f, 4f, 6f, 8f))
+  }
+
+  test("Symbol compose + infer shape + bind forward") {
+    val data = Symbol.Variable("data")
+    val fc = gen.GeneratedOps.FullyConnected(
+      "fc", Map("data" -> data), Map("num_hidden" -> "3"))
+    val out = gen.GeneratedOps.SoftmaxOutput("softmax", Map("data" -> fc))
+    assert(out.listArguments().contains("fc_weight"))
+    val Some((argShapes, outShapes, _)) =
+      out.inferShape(Map("data" -> Seq(4, 6), "softmax_label" -> Seq(4)))
+    assert(outShapes.head === IndexedSeq(4, 3))
+
+    val ctx = Context.cpu()
+    val args = out.listArguments().zip(argShapes).map {
+      case (_, s) => NDArray.ones(s, ctx)
+    }
+    val exec = out.bind(ctx, args, gradReq = "null")
+    exec.forward()
+    val p = exec.outputs.head.toArray
+    assert(math.abs(p.take(3).sum - 1.0) < 1e-4) // softmax rows sum to 1
+  }
+
+  test("KVStore push/pull with updater") {
+    val kv = KVStore.create("local")
+    val shape = Seq(2, 3)
+    kv.init(7, NDArray.ones(shape, Context.cpu()))
+    kv.setUpdater((_, recv, local) => local += recv)
+    kv.push(7, NDArray.ones(shape, Context.cpu()) * 2f)
+    val out = NDArray.zeros(shape, Context.cpu())
+    kv.pull(7, out)
+    assert(out.toArray.forall(_ == 3f)) // 1 (init) + 2 (pushed)
+  }
+}

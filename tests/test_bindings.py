@@ -1,0 +1,112 @@
+"""Language-binding gates — what the CI image CAN check without a
+JVM/R/MATLAB installation (see scala-package/README.md): the generators
+stay in sync with the live registry, the R C shim compiles against the
+real C ABI header, and the generated surfaces cover every operator.
+The runtime behavior all three bindings share is pinned by the C-ABI
+tests (test_c_api_graph.py, test_c_predict.py) — each binding is a
+marshalling layer over exactly that surface.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, cwd=None):
+    proc = subprocess.run(args, cwd=cwd or ROOT, capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc.stdout
+
+
+def test_api_manifest_matches_live_registry(tmp_path):
+    """doc/api_manifest.json == what the registries produce today (a
+    stale manifest would generate stale bindings)."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import gen_api_manifest
+    finally:
+        sys.path.pop(0)
+    fresh = gen_api_manifest.build_manifest()
+    with open(os.path.join(ROOT, "doc", "api_manifest.json")) as f:
+        committed = json.load(f)
+    # full-document comparison (name sets alone would let per-op
+    # signature drift ship stale bindings); round-trip fresh through
+    # JSON so tuples/None normalize the same way the file did
+    fresh = json.loads(json.dumps(fresh, sort_keys=True, default=str))
+    for section in ("operators", "ndarray_functions", "c_abi"):
+        assert fresh[section] == committed[section], \
+            "doc/api_manifest.json is stale in %r — rerun " \
+            "tools/gen_api_manifest.py" % section
+
+
+def test_scala_generated_ops_cover_registry(tmp_path):
+    """gen/GeneratedOps.scala has a creator for every operator."""
+    with open(os.path.join(ROOT, "doc", "api_manifest.json")) as f:
+        manifest = json.load(f)
+    gen = open(os.path.join(
+        ROOT, "scala-package", "core", "src", "main", "scala", "ml",
+        "dmlc", "mxnet_tpu", "gen", "GeneratedOps.scala")).read()
+    for op in manifest["operators"]:
+        assert ('createFromNamedArgs("%s"' % op) in gen, op
+    # balanced braces — a cheap structural sanity check without scalac
+    assert gen.count("{") == gen.count("}")
+
+
+def test_r_generated_ops_cover_registry():
+    with open(os.path.join(ROOT, "doc", "api_manifest.json")) as f:
+        manifest = json.load(f)
+    gen = open(os.path.join(ROOT, "R-package", "R",
+                            "ops_generated.R")).read()
+    for op in manifest["operators"]:
+        assert ('mx.symbol.internal.create("%s"' % op) in gen, op
+
+
+def test_r_shim_compiles_against_real_abi_header():
+    """src/mxnet_r.c must stay in sync with cpp/c_api_graph.h — compile
+    it (syntax+type checking) against the REAL ABI header plus a
+    minimal R-API stub (tools/r_stub; see its header comment)."""
+    if not _have("gcc"):
+        pytest.skip("no C compiler")
+    _run(["gcc", "-fsyntax-only", "-Wall", "-Werror",
+          "-IR-package/tools/r_stub", "-Icpp",
+          "R-package/src/mxnet_r.c"])
+
+
+def test_generators_are_idempotent(tmp_path):
+    """Re-running both generators reproduces the committed files —
+    WITHOUT touching the working tree (generate into a copy, so a
+    failure leaves the stale-vs-fresh diff intact for inspection)."""
+    import shutil
+
+    scala_rel = os.path.join("core", "src", "main", "scala", "ml",
+                             "dmlc", "mxnet_tpu", "gen",
+                             "GeneratedOps.scala")
+    work = tmp_path / "w"
+    (work / "doc").mkdir(parents=True)
+    shutil.copy(os.path.join(ROOT, "doc", "api_manifest.json"),
+                work / "doc" / "api_manifest.json")
+    for pkg in ("scala-package", "R-package"):
+        shutil.copytree(os.path.join(ROOT, pkg), work / pkg)
+    _run([sys.executable, "generate_ops.py"],
+         cwd=str(work / "scala-package"))
+    _run([sys.executable, "generate_ops_r.py"],
+         cwd=str(work / "R-package"))
+    pairs = [
+        (os.path.join(ROOT, "scala-package", scala_rel),
+         work / "scala-package" / scala_rel),
+        (os.path.join(ROOT, "R-package", "R", "ops_generated.R"),
+         work / "R-package" / "R" / "ops_generated.R"),
+    ]
+    for committed, fresh in pairs:
+        assert open(fresh).read() == open(committed).read(), \
+            "%s is stale — regenerate" % committed
+
+
+def _have(tool):
+    from shutil import which
+    return which(tool) is not None
