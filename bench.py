@@ -82,7 +82,7 @@ def _timed_steps(trainer, batch, steps):
 
 
 def _make_trainer_and_batches(sym, shapes, n_classes, compute_dtype,
-                              opt_params):
+                              opt_params, int_data=False):
     """Shared setup: fused trainer + synthetic host/device batches."""
     import jax
     from mxnet_tpu import parallel as par
@@ -93,9 +93,16 @@ def _make_trainer_and_batches(sym, shapes, n_classes, compute_dtype,
     trainer.init_params()
     rng = np.random.RandomState(0)
     batch = shapes["data"][0]
-    hostb = {"data": rng.rand(*shapes["data"]).astype(np.float32),
-             "softmax_label": rng.randint(0, n_classes, (batch,)
-                                          ).astype(np.float32)}
+    if int_data:  # token ids (LM): data AND label are class indices
+        hostb = {"data": rng.randint(0, n_classes, shapes["data"]
+                                     ).astype(np.float32),
+                 "softmax_label": rng.randint(
+                     0, n_classes, shapes["softmax_label"]
+                 ).astype(np.float32)}
+    else:
+        hostb = {"data": rng.rand(*shapes["data"]).astype(np.float32),
+                 "softmax_label": rng.randint(0, n_classes, (batch,)
+                                              ).astype(np.float32)}
     devb = {k: jax.device_put(v, trainer._data_sh[k])
             for k, v in hostb.items()}
     return trainer, hostb, devb
@@ -169,6 +176,32 @@ def bench_cifar(batch=128, steps=30):
     return batch * steps / dt
 
 
+def bench_transformer_lm(batch=8, seq=1024, layers=12, embed=768,
+                         heads=12, vocab=32000, steps=8):
+    """Long-context flagship: transformer LM train step (flash-attention
+    Pallas kernels, bf16) — tokens/s on one chip. The reference has no
+    attention-era baseline; this anchors the long-context stack's
+    single-chip number (multi-chip sp/ring scaling is exercised by
+    dryrun_multichip and test_parallel)."""
+    from mxnet_tpu.models import get_transformer_lm
+
+    sym = get_transformer_lm(vocab, num_layers=layers, embed_dim=embed,
+                             num_heads=heads, impl="flash")
+    shapes = {"data": (batch, seq), "softmax_label": (batch, seq)}
+    trainer, _, devb = _make_trainer_and_batches(
+        sym, shapes, vocab, "bfloat16",
+        {"learning_rate": 1e-3, "momentum": 0.9}, int_data=True)
+    dt = _timed_steps(trainer, devb, steps)
+    tokens_per_step = batch * seq
+    # 6*N FLOPs/token (fwd+bwd) for N non-embedding params + attention
+    n_params = layers * (12 * embed * embed) + vocab * embed
+    flops_per_tok = 6.0 * n_params + 12.0 * layers * embed * seq
+    tps = tokens_per_step * steps / dt
+    import jax as _jax
+    mfu = tps * flops_per_tok / _peak_flops(_jax.devices()[0])
+    return tps, mfu
+
+
 def bench_recordio_io(n_images=512, batch=128):
     """C++ ImageRecordIOIter img/s on synthetic packed RecordIO
     (reference publishes ~3,000 img/s from packed RecordIO on an HDD,
@@ -212,6 +245,7 @@ def main():
     r50_128, _, _ = bench_resnet50(128)
     incbn = bench_inception_bn()
     cifar = bench_cifar()
+    lm_tps, lm_mfu = bench_transformer_lm()
     io_ips = bench_recordio_io()
     print(json.dumps({
         "metric": "resnet50_imagenet_train_throughput",
@@ -228,6 +262,8 @@ def main():
                 round(incbn / INCEPTION_BN_TITANX_BASELINE, 1),
             "cifar10_inception-bn-28-small": round(cifar, 1),
             "cifar_vs_gtx980_baseline": round(cifar / CIFAR_BASELINE, 3),
+            "transformer_lm_124M_T1024_tokens_per_sec": round(lm_tps, 0),
+            "transformer_lm_mfu_estimate": round(lm_mfu, 3),
             "recordio_io_img_per_sec":
                 None if io_ips is None else round(io_ips, 1),
         },

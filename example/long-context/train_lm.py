@@ -43,6 +43,12 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument('--dp', type=int, default=2)
     parser.add_argument('--sp', type=int, default=4)
+    parser.add_argument('--attn', default='ring',
+                        choices=['ring', 'ring_striped'],
+                        help='ring = contiguous layout; ring_striped = '
+                             'balanced half-block causal ring '
+                             '(striped attention, ~2x causal at equal '
+                             'ring size — parallel/ring.py)')
     parser.add_argument('--seq-len', type=int, default=512)
     parser.add_argument('--batch-size', type=int, default=4)
     parser.add_argument('--vocab', type=int, default=64)
@@ -56,7 +62,7 @@ def main():
 
     sym = get_transformer_lm(args.vocab, num_layers=args.layers,
                              embed_dim=args.embed, num_heads=args.heads,
-                             impl="ring")
+                             impl=args.attn)
     mesh = par.build_mesh({"dp": args.dp, "sp": args.sp})
     trainer = par.SequenceParallelTrainer(
         sym, {"data": (args.batch_size, args.seq_len),

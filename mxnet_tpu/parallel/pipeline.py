@@ -221,13 +221,27 @@ class PipelineTrainer:
     def __init__(self, symbol, input_shapes, mesh, num_microbatches=None,
                  optimizer="sgd", optimizer_params=None, initializer=None,
                  seed=0, label_name="softmax_label",
-                 param_placement="stage"):
+                 param_placement="stage", remat=None):
         if "pp" not in mesh.shape:
             raise MXNetError("PipelineTrainer: mesh needs a 'pp' axis")
         if param_placement not in ("stage", "replicated"):
             raise MXNetError("param_placement must be 'stage' or "
                              "'replicated', got %r" % (param_placement,))
         self.param_placement = param_placement
+        # remat=True checkpoints each stage branch: the backward
+        # recomputes stage activations from the carried boundary instead
+        # of keeping every microbatch's residuals across the whole GPipe
+        # schedule — activation memory drops from O(M·stage) to
+        # O(M·boundary) + one in-flight stage, the practical TPU answer
+        # to 1F1B's memory motivation (the SCHEDULE stays GPipe: XLA
+        # orders the recomputed backward wave for us). Default follows
+        # MXNET_BACKWARD_DO_MIRROR like ParallelTrainer (the reference
+        # knob, static_graph.cc:400-436).
+        if remat is None:
+            import os
+            remat = os.environ.get("MXNET_BACKWARD_DO_MIRROR",
+                                   "0") == "1"
+        self.remat = bool(remat)
         if symbol.list_auxiliary_states():
             raise MXNetError("PipelineTrainer: aux states unsupported "
                              "under the SPMD schedule")
@@ -472,6 +486,13 @@ class PipelineTrainer:
                 branches = [self._make_branch(s, data_mb, label_mb, p,
                                               rng, True)
                             for s in range(S)]
+                if self.remat:
+                    # prevent_cse=False: inside lax.scan the CSE hazard
+                    # checkpoint guards against cannot occur, and the
+                    # default optimization_barrier would pessimize the
+                    # hot loop (jax.checkpoint docs)
+                    branches = [jax.checkpoint(b, prevent_cse=False)
+                                for b in branches]
                 state0 = jnp.zeros(self._boundary_shape,
                                    self._boundary_dtype)
                 out0 = tuple(jnp.zeros((M,) + os_, jnp.float32)
@@ -570,6 +591,13 @@ class PipelineTrainer:
                 branches = [self._make_branch(
                     s, data_mb, label_mb, self._stage_param_dict(s, r),
                     rng, True) for s in range(S)]
+                if self.remat:
+                    # prevent_cse=False: inside lax.scan the CSE hazard
+                    # checkpoint guards against cannot occur, and the
+                    # default optimization_barrier would pessimize the
+                    # hot loop (jax.checkpoint docs)
+                    branches = [jax.checkpoint(b, prevent_cse=False)
+                                for b in branches]
                 state0 = jnp.zeros(self._boundary_shape,
                                    self._boundary_dtype)
                 out0 = tuple(jnp.zeros((M,) + os_, jnp.float32)

@@ -102,6 +102,60 @@ def get_step_stats():
 
 
 # ---------------------------------------------------------------------------
+# honest throughput measurement — the two-chain methodology from
+# doc/performance.md as a library API. On relay/tunnel TPU environments
+# `block_until_ready` can return before execution finishes, so naive
+# timing reports impossible numbers; this utility times two DEPENDENT
+# chain lengths that each end in a real value fetch and differences
+# them, cancelling the constant dispatch/flush overhead. This is the
+# LIBRARY form of the methodology doc/performance.md describes;
+# bench.py (the driver) keeps its own driver-local variant with
+# glitch-retry heuristics tuned for unattended runs.
+
+def benchmark_chain(step_fn, state, *, steps=15, reps=3,
+                    fetch=None):
+    """Seconds per call of ``state = step_fn(state)``.
+
+    ``step_fn`` MUST thread its output back as its input (a donated
+    train step, ``y = f(y)``, ...) — that data dependence is what makes
+    the timing honest. ``fetch(state)`` forces completion (default:
+    ``np.asarray`` of the first leaf's first element). Returns
+    ``(seconds_per_step, spread)`` where spread is the relative
+    max-min range across ``reps`` measurements — distrust results
+    with spread > 0.1.
+    """
+    import numpy as _np
+
+    if fetch is None:
+        def fetch(s):
+            leaf = jax.tree_util.tree_leaves(s)[0]
+            _np.asarray(leaf).ravel()[:1]
+
+    def chain(n, s):
+        tic = _time.perf_counter()
+        for _ in range(n):
+            s = step_fn(s)
+        fetch(s)
+        return _time.perf_counter() - tic, s
+
+    _, state = chain(3, state)  # warmup/compile
+    diffs = []
+    for _ in range(reps):
+        t1, state = chain(steps, state)
+        t2, state = chain(2 * steps, state)
+        if t2 - t1 > 0:
+            diffs.append((t2 - t1) / steps)
+    if not diffs:
+        raise RuntimeError(
+            "benchmark_chain: no positive chain difference — the relay "
+            "glitched every rep; rerun, or raise `steps` so compute "
+            "dominates the flush-cost variance")
+    dt = float(sorted(diffs)[len(diffs) // 2])
+    spread = (max(diffs) - min(diffs)) / dt if len(diffs) > 1 else 0.0
+    return dt, spread
+
+
+# ---------------------------------------------------------------------------
 # compiled-program analysis (the reference's example/memcost tool reports
 # the memory planner's totals; XLA's equivalents are memory_analysis and
 # cost_analysis on the compiled executable)

@@ -1220,3 +1220,41 @@ def test_sequence_parallel_trainer_striped_matches_dense():
         np.testing.assert_allclose(got[n].asnumpy(), want[n].asnumpy(),
                                    rtol=2e-4, atol=2e-5, err_msg=n)
     assert losses[1] < losses[0]
+
+
+def test_pipeline_remat_matches_no_remat():
+    """remat=True (checkpointed stage branches — the GPipe activation-
+    memory mitigation) is value-preserving: identical trained params."""
+    from mxnet_tpu.models import get_transformer_lm
+
+    vocab, B, T, E = 11, 8, 12, 16
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, vocab, (B, T)).astype(np.float32)
+    label = rng.randint(0, vocab, (B, T)).astype(np.float32)
+    shapes = {"data": (B, T), "softmax_label": (B, T)}
+    staged = get_transformer_lm(vocab, num_layers=2, embed_dim=E,
+                                num_heads=2, impl="dense",
+                                pipeline_stages=2)
+    arg_shapes, _, _ = staged.infer_shape(**shapes)
+    prng = np.random.RandomState(3)
+    init = {n: mx.nd.array(prng.uniform(-0.1, 0.1, s).astype("f"))
+            for n, s in zip(staged.list_arguments(), arg_shapes)
+            if n not in shapes}
+    mesh = par.build_mesh({"pp": 2})
+
+    def run(remat):
+        pp = par.PipelineTrainer(
+            staged, shapes, mesh, num_microbatches=4, optimizer="sgd",
+            remat=remat,
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9,
+                              "rescale_grad": 1.0 / B})
+        pp.init_params({k: v.copy() for k, v in init.items()})
+        for _ in range(2):
+            pp.step({"data": data, "softmax_label": label})
+        return pp.get_params()
+
+    got_r, got_n = run(True), run(False)
+    for n in got_n:
+        np.testing.assert_allclose(got_r[n].asnumpy(),
+                                   got_n[n].asnumpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=n)

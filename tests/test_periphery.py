@@ -231,3 +231,24 @@ def test_topk_accuracy_metric():
     acc.update([label], [pred])
     assert m1.get()[1] == acc.get()[1]
     assert mx.metric.create("top_k_accuracy").top_k == 5
+
+
+def test_profiler_benchmark_chain():
+    """The honest-timing utility (doc/performance.md methodology as a
+    library API): measures a dependent jitted chain, returns sane
+    positive per-step time and spread."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def step(x):
+        return x * 0.999 + 0.001
+
+    x0 = jnp.ones((256, 256), jnp.float32)
+    dt, spread = mx.profiler.benchmark_chain(step, x0, steps=8, reps=2)
+    assert dt > 0
+    assert spread >= 0
+
+    with pytest.raises(TypeError):
+        mx.profiler.benchmark_chain(step, x0, 8)  # steps is kw-only
