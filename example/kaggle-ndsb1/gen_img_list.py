@@ -1,0 +1,48 @@
+"""Build stratified train/val image lists for im2rec from a
+directory-per-class tree (reference example/kaggle-ndsb1/gen_img_list.py
+reorganized: one pass, deterministic shuffle, class map emitted)."""
+import argparse
+import os
+import random
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", required=True,
+                    help="train/ directory: one subdirectory per class")
+    ap.add_argument("--out", default="train", help="output list prefix")
+    ap.add_argument("--val-frac", type=float, default=0.1)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    classes = sorted(d for d in os.listdir(args.data_dir)
+                     if os.path.isdir(os.path.join(args.data_dir, d)))
+    with open(args.out + "_classes.txt", "w") as f:
+        for i, c in enumerate(classes):
+            f.write("%d\t%s\n" % (i, c))
+
+    rng = random.Random(args.seed)
+    train, val = [], []
+    idx = 0
+    for label, cls in enumerate(classes):
+        files = sorted(os.listdir(os.path.join(args.data_dir, cls)))
+        rng.shuffle(files)
+        n_val = max(1, int(len(files) * args.val_frac))
+        for i, fname in enumerate(files):
+            rel = os.path.join(cls, fname)
+            row = (idx, label, rel)
+            (val if i < n_val else train).append(row)
+            idx += 1
+    rng.shuffle(train)
+
+    for split, rows in (("train", train), ("val", val)):
+        path = "%s_%s.lst" % (args.out, split)
+        with open(path, "w") as f:
+            for i, label, rel in rows:
+                f.write("%d\t%d\t%s\n" % (i, label, rel))
+        print("wrote %s (%d images, %d classes)"
+              % (path, len(rows), len(classes)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Predict class probabilities for the flat test/ directory (reference
+example/kaggle-ndsb1/predict_dsb.py via the deployment Predictor —
+symbol JSON + params only, no training stack)."""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-prefix", default="dsb")
+    ap.add_argument("--epoch", type=int, default=30)
+    ap.add_argument("--test-dir", default="data/test")
+    ap.add_argument("--image-hw", type=int, default=48)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--out", default="pred.npy")
+    args = ap.parse_args()
+
+    try:
+        import cv2
+    except ImportError:
+        raise SystemExit("predict_dsb.py needs OpenCV to decode images")
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.model_prefix, args.epoch)
+    model = mx.model.FeedForward(sym, ctx=mx.tpu(),
+                                 arg_params=arg_params,
+                                 aux_params=aux_params)
+
+    hw = args.image_hw
+    names = sorted(os.listdir(args.test_dir))
+    batches = []
+    for name in names:
+        img = cv2.imread(os.path.join(args.test_dir, name))
+        img = cv2.resize(img, (hw, hw)).astype(np.float32)
+        batches.append(img.transpose(2, 0, 1))
+    X = np.stack(batches)
+    probs = model.predict(mx.io.NDArrayIter(X,
+                                            batch_size=args.batch_size))
+    np.save(args.out, probs)
+    with open(args.out + ".names", "w") as f:
+        f.write("\n".join(names))
+    print("wrote %s: %s" % (args.out, probs.shape))
+
+
+if __name__ == "__main__":
+    main()

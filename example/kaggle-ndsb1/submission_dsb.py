@@ -1,0 +1,34 @@
+"""Format predictions as a Kaggle NDSB-1 submission csv (reference
+example/kaggle-ndsb1/submission_dsb.py: header from the sample
+submission, one probability row per test image)."""
+import argparse
+import csv
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("pred", help="pred.npy from predict_dsb.py")
+    ap.add_argument("sample", help="Kaggle sample_submission.csv")
+    ap.add_argument("out", help="submission csv to write")
+    args = ap.parse_args()
+
+    probs = np.load(args.pred)
+    with open(args.pred + ".names") as f:
+        names = f.read().splitlines()
+    with open(args.sample) as f:
+        header = f.readline().strip().split(",")
+    assert len(header) == probs.shape[1] + 1, \
+        "class count mismatch vs sample submission"
+
+    with open(args.out, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(header)
+        for name, row in zip(names, probs):
+            w.writerow([name] + ["%.6f" % p for p in row])
+    print("wrote %s (%d rows)" % (args.out, len(names)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Plankton convnet (reference example/kaggle-ndsb1/symbol_dsb.py
+redesigned: same depth class — 4 conv blocks + 2 FC — expressed through
+this framework's conv/BN blocks, BN instead of the 2015 dropout-heavy
+recipe, global pooling head)."""
+import mxnet_tpu as mx
+
+
+def conv_block(data, num_filter, name):
+    c = mx.symbol.Convolution(data=data, num_filter=num_filter,
+                              kernel=(3, 3), pad=(1, 1), no_bias=True,
+                              name=name + "_conv")
+    bn = mx.symbol.BatchNorm(data=c, name=name + "_bn")
+    act = mx.symbol.Activation(data=bn, act_type="relu",
+                               name=name + "_relu")
+    return mx.symbol.Pooling(data=act, kernel=(2, 2), stride=(2, 2),
+                             pool_type="max", name=name + "_pool")
+
+
+def get_symbol(num_classes=121):
+    """48x48 grayscale (or RGB) plankton images -> num_classes."""
+    data = mx.symbol.Variable("data")
+    body = data
+    for i, nf in enumerate([32, 64, 128, 128]):
+        body = conv_block(body, nf, "block%d" % (i + 1))
+    pool = mx.symbol.Pooling(data=body, kernel=(1, 1), global_pool=True,
+                             pool_type="avg", name="global_pool")
+    flat = mx.symbol.Flatten(data=pool)
+    fc1 = mx.symbol.FullyConnected(data=flat, num_hidden=256, name="fc1")
+    act = mx.symbol.Activation(data=fc1, act_type="relu", name="fc1_relu")
+    drop = mx.symbol.Dropout(data=act, p=0.5, name="drop")
+    fc2 = mx.symbol.FullyConnected(data=drop, num_hidden=num_classes,
+                                   name="fc2")
+    return mx.symbol.SoftmaxOutput(data=fc2, name="softmax")

@@ -1,0 +1,48 @@
+"""Train the plankton net from packed RecordIO (reference
+example/kaggle-ndsb1/train_dsb.py over this framework's
+ImageRecordIter + FeedForward; checkpoints each epoch)."""
+import argparse
+import logging
+
+import mxnet_tpu as mx
+from symbol_dsb import get_symbol
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--train-rec", default="train_train.rec")
+    ap.add_argument("--val-rec", default="train_val.rec")
+    ap.add_argument("--num-classes", type=int, default=121)
+    ap.add_argument("--image-hw", type=int, default=48)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--num-epochs", type=int, default=30)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--model-prefix", default="dsb")
+    ap.add_argument("--num-parts", type=int, default=1,
+                    help="data-parallel workers (tools/launch.py)")
+    ap.add_argument("--part-index", type=int, default=0)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    hw = args.image_hw
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.train_rec, data_shape=(3, hw, hw),
+        batch_size=args.batch_size, shuffle=True, rand_mirror=True,
+        num_parts=args.num_parts, part_index=args.part_index)
+    val = mx.io.ImageRecordIter(
+        path_imgrec=args.val_rec, data_shape=(3, hw, hw),
+        batch_size=args.batch_size, shuffle=False)
+
+    model = mx.model.FeedForward(
+        get_symbol(args.num_classes), ctx=mx.tpu(),
+        num_epoch=args.num_epochs, learning_rate=args.lr, momentum=0.9,
+        wd=1e-4, initializer=mx.initializer.Xavier())
+    model.fit(train, eval_data=val,
+              epoch_end_callback=mx.callback.do_checkpoint(
+                  args.model_prefix),
+              batch_end_callback=mx.callback.Speedometer(
+                  args.batch_size, 50))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Slice DSB-2 cardiac MRI studies into fixed-size CSV tensors
+(reference example/kaggle-ndsb2/Preprocessing.py restructured: one
+function per stage, loud dependency errors, deterministic ordering).
+
+Output: ``<out>-data.csv`` rows of 64*64 pixel values and
+``<out>-label.csv`` rows of (systole, diastole) ml volumes, ready for
+``mx.io.CSVIter``.
+"""
+import argparse
+import csv
+import os
+
+
+def load_study_frames(study_dir, hw):
+    try:
+        import cv2
+        import pydicom
+    except ImportError as e:
+        raise SystemExit(
+            "Preprocessing.py needs pydicom and OpenCV (%s) — install "
+            "them or start from pre-packed CSVs (see README)" % (e,))
+    frames = []
+    for root, _, files in sorted(os.walk(study_dir)):
+        for fname in sorted(files):
+            if not fname.endswith(".dcm"):
+                continue
+            ds = pydicom.dcmread(os.path.join(root, fname))
+            img = ds.pixel_array.astype("float32")
+            img -= img.min()
+            if img.max() > 0:
+                img /= img.max()
+            frames.append(cv2.resize(img, (hw, hw)))
+    return frames
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--labels", default="train.csv",
+                    help="Kaggle train.csv: Id,Systole,Diastole")
+    ap.add_argument("--out", default="train_data")
+    ap.add_argument("--image-hw", type=int, default=64)
+    args = ap.parse_args()
+
+    volumes = {}
+    with open(args.labels) as f:
+        for row in csv.DictReader(f):
+            volumes[row["Id"]] = (float(row["Systole"]),
+                                  float(row["Diastole"]))
+
+    n = 0
+    with open(args.out + "-data.csv", "w", newline="") as df, \
+            open(args.out + "-label.csv", "w", newline="") as lf:
+        dw, lw = csv.writer(df), csv.writer(lf)
+        for study in sorted(os.listdir(args.data_dir),
+                            key=lambda s: int(s) if s.isdigit() else 0):
+            if study not in volumes:
+                continue
+            for img in load_study_frames(
+                    os.path.join(args.data_dir, study), args.image_hw):
+                dw.writerow(["%.5f" % v for v in img.ravel()])
+                lw.writerow(["%.2f" % v for v in volumes[study]])
+                n += 1
+    print("wrote %d frames to %s-data.csv / -label.csv" % (n, args.out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+# DSB-2 volume regressor in R (reference example/kaggle-ndsb2/Train.R),
+# through this repository's R binding (R-package/ — see its README for
+# installation). Same CDF formulation as Train.py.
+#
+#   Rscript Train.R train_data-data.csv train_data-label.csv
+
+library(mxnet.tpu)
+
+args <- commandArgs(trailingOnly = TRUE)
+data.csv <- ifelse(length(args) >= 1, args[[1]], "train_data-data.csv")
+label.csv <- ifelse(length(args) >= 2, args[[2]], "train_data-label.csv")
+bins <- 600
+
+X <- as.matrix(read.csv(data.csv, header = FALSE))
+vols <- as.matrix(read.csv(label.csv, header = FALSE))[, 1]
+# volumes -> 0/1 CDF rows: P(volume <= v) for v in 0..bins-1
+y <- t(vapply(vols, function(v) as.numeric(seq_len(bins) - 1 >= v),
+              numeric(bins)))
+
+data <- mx.symbol.Variable("data")
+fc1 <- mx.symbol.FullyConnected(data = data, name = "fc1",
+                                num_hidden = 256)
+act <- mx.symbol.Activation(data = fc1, act_type = "relu")
+fc2 <- mx.symbol.FullyConnected(data = act, name = "cdf",
+                                num_hidden = bins)
+net <- mx.symbol.LogisticRegressionOutput(data = fc2, name = "softmax")
+
+model <- mx.model.FeedForward.create(
+    net, X = X, y = y, ctx = mx.cpu(), num.round = 40,
+    array.batch.size = 64, learning.rate = 0.01)
+message("training done; parameters in model$arg.params")

@@ -1,0 +1,69 @@
+"""DSB-2 volume regressor (reference example/kaggle-ndsb2/Train.py): a
+small convnet predicting the 600-bin volume CDF with
+LogisticRegressionOutput per bin — the competition's CRPS formulation,
+P(volume <= v) for v in 0..599 ml."""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def get_symbol(bins=600):
+    data = mx.symbol.Variable("data")
+    body = data
+    for i, nf in enumerate([32, 64, 128]):
+        c = mx.symbol.Convolution(data=body, num_filter=nf,
+                                  kernel=(3, 3), pad=(1, 1),
+                                  no_bias=True, name="conv%d" % i)
+        b = mx.symbol.BatchNorm(data=c, name="bn%d" % i)
+        a = mx.symbol.Activation(data=b, act_type="relu",
+                                 name="relu%d" % i)
+        body = mx.symbol.Pooling(data=a, kernel=(2, 2), stride=(2, 2),
+                                 pool_type="max", name="pool%d" % i)
+    flat = mx.symbol.Flatten(data=body)
+    fc = mx.symbol.FullyConnected(data=flat, num_hidden=bins, name="cdf")
+    # one logistic output per volume bin: the label is the 0/1 CDF row
+    return mx.symbol.LogisticRegressionOutput(data=fc, name="softmax")
+
+
+def cdf_labels(volumes, bins=600):
+    """(N,) ml volumes -> (N, bins) 0/1 CDF rows."""
+    v = np.asarray(volumes)[:, None]
+    return (np.arange(bins)[None, :] >= v).astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default="train_data",
+                    help="prefix from Preprocessing.py")
+    ap.add_argument("--image-hw", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-epochs", type=int, default=40)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--target", default="systole",
+                    choices=["systole", "diastole"])
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    hw = args.image_hw
+    X = np.loadtxt(args.data + "-data.csv", delimiter=",",
+                   dtype=np.float32).reshape(-1, 1, hw, hw)
+    vols = np.loadtxt(args.data + "-label.csv", delimiter=",",
+                      dtype=np.float32)
+    y = cdf_labels(vols[:, 0 if args.target == "systole" else 1])
+
+    it = mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                           shuffle=True)
+    model = mx.model.FeedForward(
+        get_symbol(), ctx=mx.tpu(), num_epoch=args.num_epochs,
+        learning_rate=args.lr, momentum=0.9, wd=1e-4,
+        initializer=mx.initializer.Xavier())
+    model.fit(it, eval_metric="rmse",
+              epoch_end_callback=mx.callback.do_checkpoint(
+                  "dsb2_" + args.target))
+
+
+if __name__ == "__main__":
+    main()

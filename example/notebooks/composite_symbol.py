@@ -1,0 +1,54 @@
+"""composite_symbol walkthrough (reference
+notebooks/composite_symbol.ipynb): build an Inception-style multi-branch
+block by composing symbols like values, then inspect the graph."""
+import mxnet_tpu as mx
+
+
+def conv_factory(data, num_filter, kernel, stride, pad, name):
+    conv = mx.symbol.Convolution(data=data, num_filter=num_filter,
+                                 kernel=kernel, stride=stride, pad=pad,
+                                 name="conv_" + name)
+    bn = mx.symbol.BatchNorm(data=conv, name="bn_" + name)
+    return mx.symbol.Activation(data=bn, act_type="relu",
+                                name="relu_" + name)
+
+
+def inception_block(data, name):
+    """Four parallel branches concatenated on channels — symbols
+    compose like expressions, so a branchy block is just four
+    sub-expressions and one Concat."""
+    b1 = conv_factory(data, 32, (1, 1), (1, 1), (0, 0), name + "_1x1")
+    b3 = conv_factory(data, 16, (1, 1), (1, 1), (0, 0), name + "_3x3r")
+    b3 = conv_factory(b3, 32, (3, 3), (1, 1), (1, 1), name + "_3x3")
+    b5 = conv_factory(data, 8, (1, 1), (1, 1), (0, 0), name + "_5x5r")
+    b5 = conv_factory(b5, 16, (5, 5), (1, 1), (2, 2), name + "_5x5")
+    pool = mx.symbol.Pooling(data=data, kernel=(3, 3), stride=(1, 1),
+                             pad=(1, 1), pool_type="max",
+                             name=name + "_pool")
+    pp = conv_factory(pool, 16, (1, 1), (1, 1), (0, 0), name + "_proj")
+    return mx.symbol.Concat(b1, b3, b5, pp, name=name + "_concat")
+
+
+data = mx.symbol.Variable("data")
+block = inception_block(data, "in1")
+block = inception_block(block, "in2")
+pool = mx.symbol.Pooling(data=block, kernel=(1, 1), global_pool=True,
+                         pool_type="avg", name="gp")
+net = mx.symbol.SoftmaxOutput(
+    data=mx.symbol.FullyConnected(data=mx.symbol.Flatten(pool),
+                                  num_hidden=10, name="fc"),
+    name="softmax")
+
+print("%d arguments" % len(net.list_arguments()))
+arg_shapes, out_shapes, _ = net.infer_shape(data=(2, 3, 28, 28),
+                                            softmax_label=(2,))
+print("output shape:", out_shapes[0])
+# channel math: 32 + 32 + 16 + 16 = 96 channels out of each block
+idx = net.list_arguments().index("conv_in2_1x1_weight")
+print("second block's 1x1 weight:", arg_shapes[idx])
+assert arg_shapes[idx][1] == 96
+
+# the JSON serialization every binding shares
+js = net.tojson()
+print("graph JSON: %d bytes, round-trips: %s"
+      % (len(js), mx.symbol.load_json(js).list_outputs()))

@@ -1,0 +1,50 @@
+"""simple_bind walkthrough (reference notebooks/simple_bind.ipynb):
+compose a symbol, inspect it, bind it, and run the training triangle —
+forward / backward / update — BY HAND, which is everything
+FeedForward.fit automates."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+# -- 1. compose ------------------------------------------------------------
+data = mx.symbol.Variable("data")
+fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=64)
+act = mx.symbol.Activation(data=fc1, act_type="relu", name="relu1")
+fc2 = mx.symbol.FullyConnected(data=act, name="fc2", num_hidden=3)
+net = mx.symbol.SoftmaxOutput(data=fc2, name="softmax")
+print("arguments:", net.list_arguments())
+print("outputs:  ", net.list_outputs())
+
+# -- 2. shapes propagate from the data shape -------------------------------
+arg_shapes, out_shapes, _ = net.infer_shape(data=(16, 10),
+                                            softmax_label=(16,))
+for n, s in zip(net.list_arguments(), arg_shapes):
+    print("  %-16s %s" % (n, s))
+
+# -- 3. bind: allocate arrays + compile the program ------------------------
+exe = net.simple_bind(mx.cpu(), data=(16, 10), softmax_label=(16,))
+rng = np.random.RandomState(0)
+for name, arr in exe.arg_dict.items():
+    if name not in ("data", "softmax_label"):
+        arr[:] = rng.uniform(-0.1, 0.1, arr.shape)
+
+# -- 4. the training triangle ---------------------------------------------
+X = rng.randn(16, 10).astype(np.float32)
+w = rng.randn(10, 3)
+y = np.argmax(X @ w, axis=1).astype(np.float32)
+lr = 0.5
+for step in range(30):
+    exe.forward(is_train=True, data=X, softmax_label=y)
+    exe.backward()
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            g = exe.grad_dict[name]
+            arr[:] = arr.asnumpy() - lr / 16 * g.asnumpy()
+    if step % 10 == 0:
+        p = exe.outputs[0].asnumpy()
+        acc = (np.argmax(p, 1) == y).mean()
+        print("step %2d  acc %.2f" % (step, acc))
+
+p = exe.outputs[0].asnumpy()
+print("final acc %.2f" % (np.argmax(p, 1) == y).mean())
+assert (np.argmax(p, 1) == y).mean() > 0.9

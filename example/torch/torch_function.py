@@ -1,0 +1,28 @@
+"""Call torch tensor functions on framework NDArrays (reference
+example/torch/torch_function.py — mx.th.* wrappers; here, the
+``to_torch``/``from_torch`` zero-ceremony converters).
+
+Run:  PYTHONPATH=../..:$PYTHONPATH python torch_function.py
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.torch import to_torch, from_torch
+
+
+def main():
+    import torch
+
+    x = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    t = to_torch(x)                      # torch.Tensor view of the data
+    print("torch sees:", t.shape, t.dtype)
+
+    y = from_torch(torch.softmax(t, dim=1))   # back to NDArray
+    print("softmax rows sum to", y.asnumpy().sum(axis=1))
+
+    u, s, v = (from_torch(a) for a in torch.linalg.svd(t))
+    print("singular values:", s.asnumpy())
+
+
+if __name__ == "__main__":
+    main()

@@ -50,9 +50,11 @@ def transformer_block(data, num_heads, hidden, embed_dim, name,
 
 
 def moe_transformer_block(data, num_heads, hidden, embed_dim, num_experts,
-                          name, causal=True, impl="flash", dropout=0.0):
+                          name, causal=True, impl="flash", dropout=0.0,
+                          moe_top_k=0):
     """Transformer block whose FFN is a mixture of experts (MoEFFN):
-    shard the expert dim over ``ep`` (ep_rules) for expert parallelism."""
+    shard the expert dim over ``ep`` (ep_rules) for expert parallelism.
+    ``moe_top_k>0`` enables static-shaped top-k hard routing."""
     x, ln2 = _attn_sublayer(data, num_heads, name, causal, impl, dropout)
     moe = sym.MoEFFN(
         data=ln2,
@@ -61,13 +63,15 @@ def moe_transformer_block(data, num_heads, hidden, embed_dim, num_experts,
         expert_b1=sym.Variable(name + "_expert_b1"),
         expert_w2=sym.Variable(name + "_expert_w2"),
         expert_b2=sym.Variable(name + "_expert_b2"),
-        num_experts=num_experts, hidden=hidden, name=name + "_moe")
+        num_experts=num_experts, hidden=hidden, top_k=moe_top_k,
+        name=name + "_moe")
     return x + moe
 
 
 def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
                        ffn_hidden=None, seq_len=None, impl="flash",
-                       dropout=0.0, num_experts=0, pipeline_stages=None):
+                       dropout=0.0, num_experts=0, pipeline_stages=None,
+                       moe_top_k=0):
     """Decoder-only LM: Embedding -> N blocks -> tied-free FC -> softmax
     over vocab per position (multi_output SoftmaxOutput, the reference's
     per-position softmax mode, softmax_output-inl.h multi_output).
@@ -107,7 +111,8 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
                 net = moe_transformer_block(net, num_heads, ffn_hidden,
                                             embed_dim, num_experts,
                                             "layer%d" % i, impl=impl,
-                                            dropout=dropout)
+                                            dropout=dropout,
+                                            moe_top_k=moe_top_k)
             else:
                 net = transformer_block(net, num_heads, ffn_hidden,
                                         embed_dim, "layer%d" % i,

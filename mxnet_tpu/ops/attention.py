@@ -76,7 +76,7 @@ class PositionalEmbedding(OpSpec):
 
 @register
 class MoEFFN(OpSpec):
-    """Mixture-of-experts position-wise FFN with soft (dense) routing.
+    """Mixture-of-experts position-wise FFN (soft or top-k routing).
 
     data: [B, T, E]. gate_weight: [X, E] (X = num_experts);
     expert_w1: [X, H, E], expert_b1: [X, H]; expert_w2: [X, E, H],
@@ -85,14 +85,26 @@ class MoEFFN(OpSpec):
     Expert parallelism: shard the leading X dim of the expert params
     over an ``ep`` mesh axis (``models.transformer.ep_rules()``) — each
     device computes its experts for all tokens and XLA inserts the psum
-    over ``ep`` for the gate-weighted combine. Soft routing keeps the op
-    fully differentiable and static-shaped (no capacity overflow), the
-    XLA-friendly starting point; top-k hard routing is a gating refinement
-    on the same parameter layout. No reference counterpart (2015).
+    over ``ep`` for the gate-weighted combine.
+
+    Routing: ``top_k=0`` (default) is soft/dense routing — every expert
+    weighs in, fully differentiable, the XLA-friendly baseline.
+    ``top_k=k`` is the standard MoE hard routing in its STATIC-SHAPED
+    form: keep the k largest gates per token, renormalize them, zero
+    the rest. All experts still COMPUTE every token (no dynamic
+    dispatch — XLA needs static shapes, and under ``ep`` sharding the
+    per-device compute is already experts/n_ep of the total); what
+    top-k changes is the LEARNING dynamics (sparse credit assignment,
+    expert specialization) and it reproduces exactly the reference-free
+    standard gating math. The straight-through trick is unnecessary:
+    the mask is a function of the gate ORDER, and gradients flow
+    through the kept gates' renormalized values like in Shazeer-style
+    noisy-top-k without the noise. No reference counterpart (2015).
     """
 
     name = "MoEFFN"
-    params = {"num_experts": Param("int"), "hidden": Param("int")}
+    params = {"num_experts": Param("int"), "hidden": Param("int"),
+              "top_k": Param("int", 0)}
 
     def arguments(self, p):
         return ["data", "gate_weight", "expert_w1", "expert_b1",
@@ -115,8 +127,27 @@ class MoEFFN(OpSpec):
 
     def forward(self, p, ins, aux, is_train, rng):
         x, gate_w, w1, b1, w2, b2 = ins
-        gates = jax.nn.softmax(jnp.einsum("bte,xe->btx", x, gate_w),
-                               axis=-1)
+        logits = jnp.einsum("bte,xe->btx", x, gate_w)
+        k = int(p["top_k"])
+        nx = int(p["num_experts"])
+        if k > 0:
+            if k >= nx:
+                raise MXNetError(
+                    "MoEFFN: top_k=%d must be < num_experts=%d (use "
+                    "top_k=0 for dense routing)" % (k, nx))
+            # static-shaped hard routing: mask logits outside the top-k
+            # BEFORE the softmax, so kept gates renormalize among
+            # themselves and dropped gates get exactly zero weight.
+            # Build the mask from top_k's INDICES (not a >= threshold,
+            # which would keep every expert tied with the k-th — e.g.
+            # all of them at zero-init): exactly k experts, ties broken
+            # by index like lax.top_k itself
+            _, idx = jax.lax.top_k(logits, k)
+            mask = jnp.sum(jax.nn.one_hot(idx, nx, dtype=logits.dtype),
+                           axis=-2) > 0
+            logits = jnp.where(mask, logits,
+                               jnp.float32(-1e30).astype(logits.dtype))
+        gates = jax.nn.softmax(logits, axis=-1)
         h = jax.nn.relu(jnp.einsum("bte,xhe->btxh", x, w1)
                         + b1[None, None])
         y = jnp.einsum("btxh,xeh->btxe", h, w2) + b2[None, None]

@@ -134,3 +134,55 @@ def test_fcn_xs():
              timeout=560)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "fcn-32s nll" in r.stderr + r.stdout
+
+
+def test_notebook_simple_bind():
+    r = _run("notebooks", "simple_bind.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final acc" in r.stderr + r.stdout
+
+
+def test_notebook_composite_symbol():
+    r = _run("notebooks", "composite_symbol.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "round-trips" in r.stderr + r.stdout
+
+
+def test_notebook_predict_with_pretrained():
+    r = _run("notebooks", "predict_with_pretrained.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "deployment == training forward: OK" in r.stderr + r.stdout
+
+
+@pytest.mark.slow
+def test_notebook_cifar10_recipe():
+    r = _run("notebooks", "cifar10_recipe.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "validation accuracy after resume" in r.stderr + r.stdout
+
+
+def test_torch_examples():
+    pytest.importorskip("torch")
+    r = _run("torch", "torch_function.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "softmax rows sum" in r.stderr + r.stdout
+    r = _run("torch", "torch_module.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final accuracy" in r.stderr + r.stdout
+
+
+def test_kaggle_ndsb1_gen_img_list(tmp_path):
+    for cls in ("copepod", "diatom", "radiolarian"):
+        d = tmp_path / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(5):
+            (d / ("%s%d.jpg" % (cls, i))).touch()
+    r = _run("kaggle-ndsb1", "gen_img_list.py",
+             "--data-dir", str(tmp_path / "train"),
+             "--out", str(tmp_path / "plk"), timeout=60)
+    assert r.returncode == 0, r.stderr
+    lst = (tmp_path / "plk_train.lst").read_text().splitlines()
+    val = (tmp_path / "plk_val.lst").read_text().splitlines()
+    assert len(lst) + len(val) == 15
+    classes = (tmp_path / "plk_classes.txt").read_text().splitlines()
+    assert len(classes) == 3

@@ -1258,3 +1258,115 @@ def test_pipeline_remat_matches_no_remat():
         np.testing.assert_allclose(got_r[n].asnumpy(),
                                    got_n[n].asnumpy(),
                                    rtol=1e-5, atol=1e-6, err_msg=n)
+
+
+def test_moe_top_k_routing():
+    """MoEFFN top_k: only the k largest gates carry weight (renormalized
+    among themselves), output matches a numpy oracle, the op stays
+    differentiable, and an ep-sharded top-k MoE LM trains."""
+    from mxnet_tpu.models import get_transformer_lm
+    from mxnet_tpu.models.transformer import ep_rules
+
+    rng = np.random.RandomState(0)
+    B, T, E, X, H, K = 2, 3, 4, 4, 8, 2
+    x = rng.randn(B, T, E).astype(np.float32)
+    gate_w = rng.randn(X, E).astype(np.float32)
+    w1 = rng.randn(X, H, E).astype(np.float32) * 0.1
+    b1 = np.zeros((X, H), np.float32)
+    w2 = rng.randn(X, E, H).astype(np.float32) * 0.1
+    b2 = np.zeros((X, E), np.float32)
+
+    data = mx.symbol.Variable("data")
+    moe = mx.symbol.MoEFFN(
+        data=data, gate_weight=mx.symbol.Variable("g"),
+        expert_w1=mx.symbol.Variable("w1"),
+        expert_b1=mx.symbol.Variable("b1"),
+        expert_w2=mx.symbol.Variable("w2"),
+        expert_b2=mx.symbol.Variable("b2"),
+        num_experts=X, hidden=H, top_k=K, name="moe")
+    exe = moe.bind(mx.cpu(), {
+        "data": mx.nd.array(x), "g": mx.nd.array(gate_w),
+        "w1": mx.nd.array(w1), "b1": mx.nd.array(b1),
+        "w2": mx.nd.array(w2), "b2": mx.nd.array(b2)})
+    exe.forward()
+    got = exe.outputs[0].asnumpy()
+
+    # numpy oracle
+    logits = np.einsum("bte,xe->btx", x, gate_w)
+    out_ref = np.zeros((B, T, E), np.float32)
+    for b in range(B):
+        for t in range(T):
+            order = np.argsort(logits[b, t])[::-1][:K]
+            kept = logits[b, t, order]
+            gs = np.exp(kept - kept.max())
+            gs /= gs.sum()
+            for g_, xi in zip(gs, order):
+                hpre = np.maximum(w1[xi] @ x[b, t] + b1[xi], 0)
+                out_ref[b, t] += g_ * (w2[xi] @ hpre + b2[xi])
+    np.testing.assert_allclose(got, out_ref, rtol=1e-4, atol=1e-5)
+
+    with pytest.raises(mx.base.MXNetError, match="top_k"):
+        mx.symbol.MoEFFN(data=data,
+                         gate_weight=mx.symbol.Variable("g2"),
+                         expert_w1=mx.symbol.Variable("w12"),
+                         expert_b1=mx.symbol.Variable("b12"),
+                         expert_w2=mx.symbol.Variable("w22"),
+                         expert_b2=mx.symbol.Variable("b22"),
+                         num_experts=X, hidden=H, top_k=X,
+                         name="moe2").bind(mx.cpu(), {
+                             "data": mx.nd.array(x),
+                             "g2": mx.nd.array(gate_w),
+                             "w12": mx.nd.array(w1),
+                             "b12": mx.nd.array(b1),
+                             "w22": mx.nd.array(w2),
+                             "b22": mx.nd.array(b2)}).forward()
+
+    # end-to-end: ep-sharded top-2 MoE LM still trains
+    vocab = 8
+    lm = get_transformer_lm(vocab, num_layers=1, embed_dim=8,
+                            num_heads=2, impl="dense", num_experts=4,
+                            moe_top_k=2)
+    mesh = par.build_mesh({"dp": 2, "ep": 4})
+    tr = par.ParallelTrainer(
+        lm, {"data": (4, 4), "softmax_label": (4, 4)},
+        optimizer="sgd", mesh=mesh,
+        rules=par.ShardingRules(mesh, param_rules=ep_rules()),
+        optimizer_params={"learning_rate": 0.1})
+    tr.init_params()
+    d = rng.randint(0, vocab, (4, 4)).astype(np.float32)
+    lab = rng.randint(0, vocab, (4, 4)).astype(np.float32)
+    outs = tr.step({"data": d, "softmax_label": lab})
+    assert np.isfinite(np.asarray(outs[0])).all()
+
+
+def test_moe_top_k_tie_breaking():
+    """Tied gate logits (e.g. zero-initialized gate weights) must still
+    route to EXACTLY k experts (index order, like lax.top_k) — not fall
+    back to dense routing."""
+    import jax
+    from mxnet_tpu.ops.registry import REGISTRY
+
+    rng = np.random.RandomState(1)
+    B, T, E, X, H, K = 1, 2, 4, 4, 8, 2
+    x = rng.randn(B, T, E).astype(np.float32)
+    gate_w = np.zeros((X, E), np.float32)  # all logits tie at 0
+    w1 = rng.randn(X, H, E).astype(np.float32) * 0.1
+    b1 = np.zeros((X, H), np.float32)
+    w2 = rng.randn(X, E, H).astype(np.float32) * 0.1
+    b2 = np.zeros((X, E), np.float32)
+
+    spec = REGISTRY["MoEFFN"]
+    p = spec.parse_params({"num_experts": X, "hidden": H, "top_k": K})
+    (out,), _ = spec.forward(p, [jnp.asarray(v) for v in
+                                 (x, gate_w, w1, b1, w2, b2)],
+                             [], True, jax.random.PRNGKey(0))
+    got = np.asarray(out)
+
+    # oracle: experts 0..K-1 (tie-break by index) at weight 1/K each
+    ref = np.zeros((B, T, E), np.float32)
+    for b in range(B):
+        for t in range(T):
+            for xi in range(K):
+                h = np.maximum(w1[xi] @ x[b, t] + b1[xi], 0)
+                ref[b, t] += (w2[xi] @ h + b2[xi]) / K
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
