@@ -1,0 +1,55 @@
+/*
+ * Checkpoint save/load (reference scala-package Model.scala): the
+ * prefix-symbol.json + prefix-NNNN.params format every surface of the
+ * framework shares (Python model.py save_checkpoint, the C predict ABI,
+ * the R binding) — arg params saved under "arg:<name>", aux under
+ * "aux:<name>", NDArray-list binary via the C ABI's save/load.
+ */
+package ml.dmlc.mxnet_tpu
+
+import java.nio.charset.StandardCharsets
+import java.nio.file.{Files, Paths}
+
+object Model {
+
+  /** write prefix-symbol.json + prefix-%04d.params */
+  def saveCheckpoint(prefix: String, epoch: Int, symbol: Symbol,
+                     argParams: Map[String, NDArray],
+                     auxParams: Map[String, NDArray] = Map.empty): Unit = {
+    Files.write(Paths.get(f"$prefix%s-symbol.json"),
+                symbol.toJson.getBytes(StandardCharsets.UTF_8))
+    val named: Map[String, NDArray] =
+      argParams.map { case (k, v) => s"arg:$k" -> v } ++
+        auxParams.map { case (k, v) => s"aux:$k" -> v }
+    NDArray.save(f"$prefix%s-$epoch%04d.params", named)
+  }
+
+  /** read back (symbol, argParams, auxParams) */
+  def loadCheckpoint(prefix: String, epoch: Int)
+      : (Symbol, Map[String, NDArray], Map[String, NDArray]) = {
+    val json = new String(
+      Files.readAllBytes(Paths.get(f"$prefix%s-symbol.json")),
+      StandardCharsets.UTF_8)
+    val symbol = Symbol.fromJson(json)
+    val loaded = NDArray.load(f"$prefix%s-$epoch%04d.params")
+    val arg = loaded.collect {
+      case (k, v) if k.startsWith("arg:") => k.stripPrefix("arg:") -> v
+    }
+    val aux = loaded.collect {
+      case (k, v) if k.startsWith("aux:") => k.stripPrefix("aux:") -> v
+    }
+    (symbol, arg, aux)
+  }
+
+  /** attach a checkpoint to a FeedForward for further training/scoring */
+  def load(prefix: String, epoch: Int,
+           ctx: Context = Context.defaultCtx,
+           numEpoch: Int = 10,
+           optimizer: Optimizer = new SGD()): FeedForward = {
+    val (symbol, arg, aux) = loadCheckpoint(prefix, epoch)
+    val ff = new FeedForward(symbol, ctx, numEpoch, optimizer)
+    ff.argParams = arg
+    ff.auxParams = aux
+    ff
+  }
+}

@@ -1,0 +1,28 @@
+/*
+ * Global RNG + sampling (reference scala-package Random.scala):
+ * mx.random.seed reproduces the whole framework's stream (registry
+ * functions _random_uniform/_random_gaussian fill NDArrays in place).
+ */
+package ml.dmlc.mxnet_tpu
+
+import Base._
+
+object Random {
+  /** seed the framework-wide stream (MXTRandomSeed) */
+  def seed(seedState: Int): Unit =
+    checkCall(_LIB.MXTRandomSeed(seedState))
+
+  /** uniform [low, high) samples into `out` */
+  def uniform(low: Float, high: Float, out: NDArray): NDArray = {
+    NDArray.invoke("_random_uniform", Array.empty, Array(low, high),
+                   Array(out))
+    out
+  }
+
+  /** gaussian (mean, stdvar) samples into `out` */
+  def normal(mean: Float, stdvar: Float, out: NDArray): NDArray = {
+    NDArray.invoke("_random_gaussian", Array.empty, Array(mean, stdvar),
+                   Array(out))
+    out
+  }
+}

@@ -111,53 +111,3 @@ def test_kvstore_server_commands():
 
     # worker-role import is a no-op (does not sys.exit)
     import mxnet_tpu.kvstore_server  # noqa: F401
-
-
-def test_ps_transport_hmac(monkeypatch):
-    """With MXNET_KVSTORE_SECRET set, every parameter-server frame
-    carries an HMAC-SHA256 tag; a peer with the wrong secret is
-    rejected BEFORE pickle.loads ever sees its bytes."""
-    import socket
-    from mxnet_tpu import kvstore_dist as kd
-
-    def roundtrip(send_secret, recv_secret):
-        a, b = socket.socketpair()
-        try:
-            monkeypatch.setenv("MXNET_KVSTORE_SECRET", send_secret)
-            kd._send_msg(a, ("push", 1, 0, np.arange(3)))
-            monkeypatch.setenv("MXNET_KVSTORE_SECRET", recv_secret)
-            return kd._recv_msg(b)
-        finally:
-            a.close()
-            b.close()
-
-    op, key, part, val = roundtrip("sekrit", "sekrit")
-    assert (op, key, part) == ("push", 1, 0)
-    np.testing.assert_array_equal(val, np.arange(3))
-
-    with pytest.raises(mx.base.MXNetError, match="HMAC"):
-        roundtrip("sekrit", "wrong-secret")
-
-
-def test_ps_dead_server_loud_error(monkeypatch):
-    """A dead/unreachable parameter server surfaces as a loud MXNetError
-    naming the peer — not a bare ConnectionError (reference ps-lite
-    aborts the run when a server van connection drops)."""
-    import socket as socket_mod
-    import threading
-    from mxnet_tpu.kvstore_dist import PSBackend
-
-    # grab a port nobody listens on
-    probe = socket_mod.socket()
-    probe.bind(("127.0.0.1", 0))
-    dead_port = probe.getsockname()[1]
-    probe.close()
-    monkeypatch.setenv("MXNET_KVSTORE_PORT_BASE", str(dead_port))
-
-    ps = PSBackend.__new__(PSBackend)  # skip __init__ (spawns a server)
-    ps.rank, ps.nserv, ps.generation = 0, 1, 1
-    ps.hosts = ["127.0.0.1"]
-    ps._conns, ps._lock = {}, threading.Lock()
-    with pytest.raises(mx.base.MXNetError,
-                       match="unreachable or died"):
-        ps._request(0, ("pull", 1, 0))
