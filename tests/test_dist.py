@@ -18,7 +18,7 @@ def _launch(n, local_devices):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # worker sets its own platform config
     env.pop("XLA_FLAGS", None)
-    for attempt in range(2):
+    for attempt in range(3):
         proc = subprocess.run(
             [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
              "-n", str(n), "--local-devices", str(local_devices), "--",
@@ -34,7 +34,7 @@ def _launch(n, local_devices):
                        or "CoordinationService" in out
                        or "coordination service" in out
                        or "DEADLINE_EXCEEDED" in out)
-        if proc.returncode != 0 and attempt == 0 and infra_flake:
+        if proc.returncode != 0 and attempt < 2 and infra_flake:
             continue
         break
     assert proc.returncode == 0, out[-4000:]

@@ -1,6 +1,5 @@
 """Port of /root/reference/tests/python/unittest/test_kvstore.py."""
 import numpy as np
-import pytest
 
 import mxnet_tpu as mx
 
