@@ -22,7 +22,9 @@ from . import classifiers, alexnet, vgg, resnet, inception, lstm, fcn
 from .classifiers import get_mlp, get_lenet
 from .alexnet import get_alexnet
 from .vgg import get_vgg
-from .resnet import get_resnet, get_resnet_cifar
+from .resnet import (get_resnet, get_resnet_cifar,
+                     convert_stem_weight_s2d,
+                     space_to_depth_batch)
 from .inception import (get_inception_bn_small, get_inception_bn,
                         get_inception_v3, get_googlenet)
 from .lstm import lstm_unroll, LSTMState, LSTMParam

@@ -244,6 +244,43 @@ class SliceChannel(OpSpec):
 
 
 @register
+class SpaceToDepth(OpSpec):
+    """Rearrange spatial blocks into channels (NCHW):
+    ``out[b, c·bs² + p·bs + q, i, j] = x[b, c, i·bs + p, j·bs + q]``.
+
+    The MLPerf-era transform that makes low-channel stem convolutions
+    MXU-friendly (a 7×7/2 conv on 3 channels becomes a 4×4/1 conv on 12
+    — see ``models.resnet.get_resnet(stem="s2d")`` and
+    ``convert_stem_weight_s2d`` for the EXACT reparameterization). Later
+    MXNet grew the same op; the 2015 reference predates it."""
+
+    name = "SpaceToDepth"
+    params = {"block_size": Param("int")}
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return list(in_shapes), [None], []
+        bs = p["block_size"]
+        if len(d) != 4:
+            raise MXNetError("SpaceToDepth: data must be 4D NCHW")
+        if bs < 1 or d[2] % bs or d[3] % bs:
+            raise MXNetError(
+                "SpaceToDepth: block_size %d must divide H=%d and W=%d"
+                % (bs, d[2], d[3]))
+        out = (d[0], d[1] * bs * bs, d[2] // bs, d[3] // bs)
+        return list(in_shapes), [out], []
+
+    def forward(self, p, ins, aux, is_train, rng):
+        x = ins[0]
+        bs = p["block_size"]
+        b, c, h, w = x.shape
+        r = x.reshape(b, c, h // bs, bs, w // bs, bs)
+        r = r.transpose(0, 1, 3, 5, 2, 4)
+        return [r.reshape(b, c * bs * bs, h // bs, w // bs)], []
+
+
+@register
 class SwapAxis(OpSpec):
     """Swap two axes (``swapaxis-inl.h``)."""
 

@@ -127,3 +127,81 @@ def test_get_symbol_registry():
     assert out_shapes == [(2, 10)]
     with pytest.raises(ValueError):
         models.get_symbol("nope")
+
+
+def test_resnet_s2d_stem_exact_equivalence():
+    """get_resnet(stem='s2d') — SpaceToDepth + 4x4/1 conv + crop — is
+    the EXACT same function as the standard 7x7/2 stem once the weight
+    is reparameterized with convert_stem_weight_s2d (the MLPerf stem
+    transform, shipped opt-in for the MXU-lane win)."""
+    import numpy as np
+    from mxnet_tpu.models import get_resnet, convert_stem_weight_s2d
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 3, 224, 224).astype(np.float32)
+    w7 = (rng.randn(64, 3, 7, 7) * 0.05).astype(np.float32)
+
+    def stem_out(sym_model, wname_val):
+        arg_shapes, _, aux_shapes = sym_model.infer_shape(
+            data=(1, 3, 224, 224), softmax_label=(1,))
+        args = {}
+        prng = np.random.RandomState(1)
+        for n, s in zip(sym_model.list_arguments(), arg_shapes):
+            if n == "data":
+                args[n] = mx.nd.array(x)
+            elif n == "stem_conv_weight":
+                args[n] = mx.nd.array(wname_val)
+            elif n == "softmax_label":
+                args[n] = mx.nd.zeros(s)
+            else:
+                args[n] = mx.nd.array(
+                    prng.uniform(-0.05, 0.05, s).astype(np.float32))
+        aux = [mx.nd.zeros(s) if "mean" in n else mx.nd.ones(s)
+               for n, s in zip(sym_model.list_auxiliary_states(),
+                               aux_shapes)]
+        # observe the stem conv output through the internals
+        internals = sym_model.get_internals()
+        stem = internals["stem_conv_output"]
+        sargs = {n: args[n] for n in stem.list_arguments()}
+        exe = stem.bind(mx.cpu(), sargs)
+        exe.forward()
+        return exe.outputs[0].asnumpy()
+
+    std = get_resnet(num_classes=10, num_layers=50, stem="standard")
+    s2d = get_resnet(num_classes=10, num_layers=50, stem="s2d")
+    out_std = stem_out(std, w7)
+    out_s2d_raw = stem_out(s2d, convert_stem_weight_s2d(w7))
+    # s2d's raw conv output is 113x113 (pre-crop): compare the cropped
+    # region, which is what the rest of the network consumes
+    np.testing.assert_allclose(out_s2d_raw[:, :, :112, :112], out_std,
+                               rtol=1e-5, atol=1e-5)
+
+    with pytest.raises(ValueError):
+        get_resnet(stem="nope")
+
+
+def test_resnet_s2d_input_stem_matches_host_transform():
+    """stem='s2d_input' (pre-dealt input) equals stem='s2d' (in-graph
+    transform) given the same converted weight and host-transformed
+    data — the input-pipeline form of the same exact function."""
+    import numpy as np
+    from mxnet_tpu.models import (get_resnet, convert_stem_weight_s2d,
+                                  space_to_depth_batch)
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 3, 224, 224).astype(np.float32)
+    w7 = (rng.randn(64, 3, 7, 7) * 0.05).astype(np.float32)
+    w2 = convert_stem_weight_s2d(w7)
+
+    def stem_out(sym_model, data_val):
+        internals = sym_model.get_internals()
+        stem = internals["stem_crop_output"]
+        exe = stem.bind(mx.cpu(), {"data": mx.nd.array(data_val),
+                                   "stem_conv_weight": mx.nd.array(w2)})
+        exe.forward()
+        return exe.outputs[0].asnumpy()
+
+    ingraph = stem_out(get_resnet(num_classes=10, stem="s2d"), x)
+    dealt = stem_out(get_resnet(num_classes=10, stem="s2d_input"),
+                     space_to_depth_batch(x))
+    np.testing.assert_allclose(dealt, ingraph, rtol=1e-6, atol=1e-6)

@@ -823,3 +823,36 @@ def test_batchnorm_large_mean_stability():
     got = np.asarray(out)
     assert abs(got.std() - 1.0) < 0.05, got.std()
     assert abs(got.mean()) < 0.05, got.mean()
+
+
+def test_space_to_depth():
+    """SpaceToDepth rearrangement + shape errors + gradient (a pure
+    permutation: grad is the inverse deal)."""
+    x = np.arange(2 * 3 * 4 * 4, dtype=np.float32).reshape(2, 3, 4, 4)
+    s = mx.symbol.SpaceToDepth(mx.symbol.Variable("data"), block_size=2,
+                               name="s2d")
+    exe = s.bind(mx.cpu(), {"data": mx.nd.array(x)},
+                 args_grad={"data": mx.nd.zeros(x.shape)})
+    exe.forward(is_train=True)
+    out = exe.outputs[0].asnumpy()
+    assert out.shape == (2, 12, 2, 2)
+    # out[b, c*4 + p*2 + q, i, j] == x[b, c, 2i+p, 2j+q]
+    for c in range(3):
+        for p in range(2):
+            for q in range(2):
+                np.testing.assert_array_equal(
+                    out[:, c * 4 + p * 2 + q],
+                    x[:, c, p::2, q::2])
+    # gradient of a permutation is the inverse permutation
+    g = np.arange(out.size, dtype=np.float32).reshape(out.shape)
+    exe.backward([mx.nd.array(g)])
+    dx = exe.grad_dict["data"].asnumpy()
+    for c in range(3):
+        for p in range(2):
+            for q in range(2):
+                np.testing.assert_array_equal(
+                    dx[:, c, p::2, q::2], g[:, c * 4 + p * 2 + q])
+
+    with pytest.raises(mx.base.MXNetError, match="divide"):
+        mx.symbol.SpaceToDepth(mx.symbol.Variable("d2"), block_size=3,
+                               name="bad").infer_shape(d2=(1, 3, 4, 4))
