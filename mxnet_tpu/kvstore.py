@@ -80,6 +80,7 @@ class KVStore:
         self._store = {}
         self._sharded = {}  # key -> _ShardedValue (big-array sync path)
         self._updater = None
+        self._updater_key = None  # private rank-synced stream, see _call_updater
         self._is_dist = kv_type.startswith("dist")
         self._is_async = kv_type == "dist_async"
         self._ps = None
@@ -181,9 +182,28 @@ class KVStore:
                     red, self._store[k].context.jax_device())
             merged_nd = NDArray._from_jax(merged, self._store[k].context)
             if self._updater is not None:
-                self._updater(k, merged_nd, self._store[k])
+                self._call_updater(k, merged_nd, self._store[k])
             else:
                 self._store[k]._set(merged)
+
+    def _call_updater(self, k, recv, local):
+        """Run the updater under its PRIVATE rank-synced RNG stream (if
+        one was established at _set_updater time).  The global
+        ``mx.random`` key is swapped out for the duration of the call
+        and restored afterwards, so updater-internal draws (SGLD noise)
+        are identical on every process — the BSP invariant — while user
+        streams (dropout, augmentation) keep their per-process state."""
+        if self._updater_key is None:
+            self._updater(k, recv, local)
+            return
+        from . import random as mx_random
+        user_key = mx_random._KEY
+        mx_random._KEY = self._updater_key
+        try:
+            self._updater(k, recv, local)
+        finally:
+            self._updater_key = mx_random._KEY
+            mx_random._KEY = user_key
 
     def pull(self, key, out=None, priority=0):
         """Pull current value into out array(s) — broadcast to all device
@@ -237,15 +257,16 @@ class KVStore:
         replica of the store, so an updater that draws from the global
         ``mx.random`` stream (e.g. SGLD's noise) must draw IDENTICAL
         values everywhere or the replicas silently diverge, breaking the
-        BSP identical-params invariant. Broadcast a seed drawn from RANK
-        0's OWN mx.random stream: with the same starting key and the
-        same (key, order) push sequence under BSP, every process's
-        updater-visible stream stays in lockstep — the same fix as the
-        sp trainer's replicated fwd rng. Deriving from rank 0's stream
-        (not numpy's global RNG) keeps user-requested determinism: after
-        ``mx.random.seed(42)`` on every process, the broadcast value —
-        and so the whole run — is reproducible, and no process's numpy
-        state is touched."""
+        BSP identical-params invariant. Establish a PRIVATE updater key
+        from a seed drawn on RANK 0 and broadcast: with the same starting
+        key and the same (key, order) push sequence under BSP, every
+        process's updater-visible stream stays in lockstep — the same
+        fix as the sp trainer's replicated fwd rng. The key is swapped
+        in only around updater calls (_call_updater), so user-visible
+        streams (dropout, augmentation draws) keep their independent
+        per-process state, and deriving the seed from rank 0's mx.random
+        stream keeps user-requested determinism after mx.random.seed(42)
+        without touching any process's numpy state."""
         import jax
         from . import random as mx_random
         seed = np.zeros((1,), np.int64)
@@ -253,7 +274,7 @@ class KVStore:
             seed[0] = int(jax.random.randint(
                 mx_random._next_key(), (), 0, 2 ** 31 - 1))
         shared = _allreduce_dcn(seed, shard_big=False)
-        mx_random.seed(int(np.asarray(shared)[0]))
+        self._updater_key = jax.random.PRNGKey(int(np.asarray(shared)[0]))
 
     def set_optimizer(self, optimizer):
         """Use an optimizer as the updater. In dist mode the reference

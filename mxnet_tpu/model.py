@@ -83,30 +83,58 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
             kvstore.pull(idx, param_on_devs, priority=-idx)
 
 
+def _updatable(param_arrays, grad_arrays):
+    """Yield (key, weights-per-device, grads-per-device) for every param
+    that actually has a gradient (grad_req='null' entries yield None)."""
+    for key, (weights, grads) in enumerate(zip(param_arrays, grad_arrays)):
+        if grads[0] is not None:
+            yield key, weights, grads
+
+
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
-    """Push grads, pull updated weights (reference :88-97)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        kvstore.push(index, grad_list, priority=-index)
-        kvstore.pull(index, arg_list, priority=-index)
+    """update_on_kvstore step: the store aggregates each key's device
+    grads, applies its optimizer, and the pull fans fresh weights back
+    out (behavioral parity with reference model.py:88-97)."""
+    for key, weights, grads in _updatable(param_arrays, grad_arrays):
+        kvstore.push(key, grads, priority=-key)
+        kvstore.pull(key, weights, priority=-key)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None):
-    """Aggregate grads (optionally via kvstore) and run the local updater
-    per device (reference :99-116)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
+    """Allreduce step: aggregate grads (via kvstore when present — the
+    pull overwrites each device grad with the reduced value), then run
+    the local updater once per (key, device) pair (behavioral parity
+    with reference model.py:99-116)."""
+    for key, weights, grads in _updatable(param_arrays, grad_arrays):
         if kvstore:
-            kvstore.push(index, grad_list, priority=-index)
-            kvstore.pull(index, grad_list, priority=-index)
-        for k, p in enumerate(zip(arg_list, grad_list)):
-            w, g = p
-            updater(index * num_device + k, g, w)
+            kvstore.push(key, grads, priority=-key)
+            kvstore.pull(key, grads, priority=-key)
+        for dev, (w, g) in enumerate(zip(weights, grads)):
+            updater(key * num_device + dev, g, w)
+
+
+def _epoch_batches(train_data, epoch_size, logger, epoch):
+    """Yield one epoch's worth of batches.
+
+    With ``epoch_size`` set, an "epoch" is exactly that many batches and
+    the iterator is rewound as often as needed to supply them; without
+    it, an epoch is one full pass and the iterator is rewound once at
+    the end (reference epoch_size semantics, model.py:118-308)."""
+    served = 0
+    while True:
+        ran_dry = True
+        for batch in train_data:
+            yield batch
+            served += 1
+            if epoch_size is not None and served >= epoch_size:
+                ran_dry = False
+                break
+        if ran_dry:
+            logger.info("Epoch[%d] Resetting Data Iterator", epoch)
+            train_data.reset()
+        if epoch_size is None or served >= epoch_size:
+            return
 
 
 def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
@@ -143,41 +171,32 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
         tic = time.time()
         eval_metric.reset()
         nbatch = 0
-        while True:
-            do_reset = True
-            for data_batch in train_data:
-                executor_manager.load_data_batch(data_batch)
-                if monitor is not None:
-                    monitor.tic()
-                executor_manager.forward(is_train=True)
-                executor_manager.backward()
-                if update_on_kvstore:
-                    _update_params_on_kvstore(
-                        executor_manager.param_arrays,
-                        executor_manager.grad_arrays, kvstore)
-                else:
-                    _update_params(executor_manager.param_arrays,
-                                   executor_manager.grad_arrays,
-                                   updater=updater, num_device=len(ctx),
-                                   kvstore=kvstore)
-                if monitor is not None:
-                    monitor.toc_print()
-                executor_manager.update_metric(eval_metric, data_batch.label)
-                nbatch += 1
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch,
-                                                     nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    _run_callbacks(batch_end_callback, batch_end_params)
-                if epoch_size is not None and nbatch >= epoch_size:
-                    do_reset = False
-                    break
-            if do_reset:
-                logger.info("Epoch[%d] Resetting Data Iterator", epoch)
-                train_data.reset()
-            if epoch_size is None or nbatch >= epoch_size:
-                break
+        for data_batch in _epoch_batches(train_data, epoch_size, logger,
+                                         epoch):
+            executor_manager.load_data_batch(data_batch)
+            if monitor is not None:
+                monitor.tic()
+            executor_manager.forward(is_train=True)
+            executor_manager.backward()
+            if update_on_kvstore:
+                _update_params_on_kvstore(
+                    executor_manager.param_arrays,
+                    executor_manager.grad_arrays, kvstore)
+            else:
+                _update_params(executor_manager.param_arrays,
+                               executor_manager.grad_arrays,
+                               updater=updater, num_device=len(ctx),
+                               kvstore=kvstore)
+            if monitor is not None:
+                monitor.toc_print()
+            executor_manager.update_metric(eval_metric, data_batch.label)
+            nbatch += 1
+            if batch_end_callback is not None:
+                batch_end_params = BatchEndParam(epoch=epoch,
+                                                 nbatch=nbatch,
+                                                 eval_metric=eval_metric,
+                                                 locals=locals())
+                _run_callbacks(batch_end_callback, batch_end_params)
         toc = time.time()
         logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
 
@@ -197,13 +216,12 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
                 executor_manager.forward(is_train=False)
                 executor_manager.update_metric(eval_metric, eval_batch.label)
                 if eval_batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=i,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    _run_callbacks(eval_batch_end_callback, batch_end_params)
-            name_value = [eval_metric.get()]
-            for name, value in name_value:
-                logger.info("Epoch[%d] Validation-%s=%f", epoch, name, value)
+                    _run_callbacks(eval_batch_end_callback,
+                                   BatchEndParam(epoch=epoch, nbatch=i,
+                                                 eval_metric=eval_metric,
+                                                 locals=locals()))
+            name, value = eval_metric.get()
+            logger.info("Epoch[%d] Validation-%s=%f", epoch, name, value)
             eval_data.reset()
 
     # drain async writers (do_checkpoint(async_write=True)) before
@@ -543,74 +561,66 @@ class FeedForward(BASE_ESTIMATOR):
             return self._init_iter(eval_data[0], eval_data[1], is_train=True)
         return eval_data
 
+    def _forward_batches(self, X, num_batch):
+        """Feed each batch into the shared predictor executor, run it
+        forward, and yield (index, batch, valid) where ``valid`` counts
+        the non-padding rows (``batch.pad`` semantics). Stops after
+        ``num_batch`` batches WITHOUT fetching the next one, so a
+        reset=False caller can keep consuming the iterator."""
+        feeds = [self._pred_exec.arg_dict[name]
+                 for name, _ in X.provide_data]
+        for i, batch in enumerate(X):
+            _load_general(batch.data, [[(slice(None), a)] for a in feeds])
+            self._pred_exec.forward(is_train=False)
+            yield i, batch, X.batch_size - (batch.pad or 0)
+            if num_batch is not None and i + 1 >= num_batch:
+                return
+
     def predict(self, X, num_batch=None, return_data=False, reset=True):
-        """Run prediction (reference :573); returns numpy output(s)."""
+        """Run prediction; returns numpy output(s), and with
+        ``return_data`` also the (unpadded) data/label streams
+        (behavioral parity with reference model.py:573)."""
         X = self._init_iter(X, None, is_train=False)
         if reset:
             X.reset()
-        data_shapes = X.provide_data
-        data_names = [x[0] for x in data_shapes]
-        self._init_predictor(data_shapes)
-        batch_size = X.batch_size
-        data_arrays = [self._pred_exec.arg_dict[name] for name in data_names]
-        output_list = [[] for _ in range(len(self._pred_exec.outputs))]
-        if return_data:
-            data_list = [[] for _ in X.provide_data]
-            label_list = [[] for _ in X.provide_label]
-        i = 0
-        for batch in X:
-            _load_general(batch.data, [[(slice(None), a)]
-                                          for a in data_arrays])
-            self._pred_exec.forward(is_train=False)
-            padded = batch.pad or 0
-            real_size = batch_size - padded
-            for o_list, o_nd in zip(output_list, self._pred_exec.outputs):
-                o_list.append(o_nd.asnumpy()[0:real_size])
+        self._init_predictor(X.provide_data)
+
+        def _merge(streams):
+            merged = [np.concatenate(chunks) for chunks in streams]
+            return merged[0] if len(merged) == 1 else merged
+
+        outs = [[] for _ in self._pred_exec.outputs]
+        datas = [[] for _ in X.provide_data]
+        labels = [[] for _ in (X.provide_label or [])]
+        for _, batch, valid in self._forward_batches(X, num_batch):
+            for sink, out_nd in zip(outs, self._pred_exec.outputs):
+                sink.append(out_nd.asnumpy()[:valid])
             if return_data:
-                for j, x in enumerate(batch.data):
-                    data_list[j].append(x.asnumpy()[0:real_size])
-                for j, x in enumerate(batch.label):
-                    label_list[j].append(x.asnumpy()[0:real_size])
-            i += 1
-            if num_batch is not None and i == num_batch:
-                break
-        outputs = [np.concatenate(x) for x in output_list]
-        if len(outputs) == 1:
-            outputs = outputs[0]
+                for sink, x in zip(datas, batch.data):
+                    sink.append(x.asnumpy()[:valid])
+                for sink, x in zip(labels, batch.label):
+                    sink.append(x.asnumpy()[:valid])
         if return_data:
-            data = [np.concatenate(x) for x in data_list]
-            label = [np.concatenate(x) for x in label_list]
-            if len(data) == 1:
-                data = data[0]
-            if len(label) == 1:
-                label = label[0]
-            return outputs, data, label
-        return outputs
+            return _merge(outs), _merge(datas), _merge(labels)
+        return _merge(outs)
 
     def score(self, X, eval_metric="acc", num_batch=None,
               batch_end_callback=None, reset=True):
-        """Evaluate on a metric (reference :634)."""
+        """Evaluate on a metric (behavioral parity with reference
+        model.py:634)."""
         if not isinstance(eval_metric, metric.EvalMetric):
             eval_metric = metric.create(eval_metric)
         X = self._init_iter(X, None, is_train=False)
         if reset:
             X.reset()
-        data_shapes = X.provide_data
-        data_names = [x[0] for x in data_shapes]
-        self._init_predictor(data_shapes)
-        data_arrays = [self._pred_exec.arg_dict[name] for name in data_names]
-        for i, batch in enumerate(X):
-            if num_batch is not None and i == num_batch:
-                break
-            _load_general(batch.data, [[(slice(None), a)]
-                                          for a in data_arrays])
-            self._pred_exec.forward(is_train=False)
+        self._init_predictor(X.provide_data)
+        for i, batch, _ in self._forward_batches(X, num_batch):
             eval_metric.update(batch.label, self._pred_exec.outputs)
             if batch_end_callback is not None:
-                batch_end_params = BatchEndParam(epoch=0, nbatch=i,
-                                                 eval_metric=eval_metric,
-                                                 locals=locals())
-                _run_callbacks(batch_end_callback, batch_end_params)
+                _run_callbacks(batch_end_callback,
+                               BatchEndParam(epoch=0, nbatch=i,
+                                             eval_metric=eval_metric,
+                                             locals=locals()))
         return eval_metric.get()[1]
 
     def fit(self, X, y=None, eval_data=None, eval_metric="acc",

@@ -185,8 +185,10 @@ def save_sharded(prefix, params, step=0, extra=None, async_write=False):
 
     # Per-save unique token, agreed on the MAIN thread where device
     # collectives are still legal, then matched by the writer thread's
-    # filesystem protocol (see _write_shards).
-    tok = np.array([np.random.randint(0, 2 ** 31 - 1)], np.int32)
+    # filesystem protocol (see _write_shards).  Drawn from os.urandom so
+    # saving a checkpoint never mutates user-visible RNG streams.
+    tok = np.array([int.from_bytes(os.urandom(4), "little") & 0x7fffffff],
+                   np.int32)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         tok = multihost_utils.broadcast_one_to_all(tok)

@@ -27,7 +27,32 @@ if os.environ.get("MXNET_TPU_TEST_ON_TPU") != "1":
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: multi-process / long tests")
-    _ensure_native_built()
+
+
+def _needs_native(path, _cache={}):
+    """Does this test module touch the native libraries?  Detected from
+    the module SOURCE (``.so`` / ``get_lib`` / ``im2rec`` references), so
+    a future native-dependent test file is picked up automatically —
+    no hand-maintained file list to drift."""
+    if path not in _cache:
+        try:
+            with open(path, "r", errors="ignore") as f:
+                src = f.read()
+        except OSError:
+            src = ""
+        _cache[path] = any(tok in src for tok in
+                           (".so", "get_lib", "im2rec", "dist_worker"))
+    return _cache[path]
+
+
+def pytest_collection_modifyitems(config, items):
+    """Build the native libs only when a selected test actually needs
+    them, so pure-Python selections (``pytest tests/test_symbol.py``)
+    pay nothing (advisor round 3)."""
+    if os.environ.get("MXNET_TPU_SKIP_NATIVE_BUILD") == "1":
+        return
+    if any(_needs_native(str(it.fspath)) for it in items):
+        _ensure_native_built()
 
 
 def _ensure_native_built():
