@@ -567,6 +567,8 @@ class FeedForward(BASE_ESTIMATOR):
         the non-padding rows (``batch.pad`` semantics). Stops after
         ``num_batch`` batches WITHOUT fetching the next one, so a
         reset=False caller can keep consuming the iterator."""
+        if num_batch is not None and num_batch <= 0:
+            return
         feeds = [self._pred_exec.arg_dict[name]
                  for name, _ in X.provide_data]
         for i, batch in enumerate(X):

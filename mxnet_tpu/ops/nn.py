@@ -35,6 +35,22 @@ from .registry import OpSpec, Param, register, shape_assign, same_shape_infer
 _DIMNUMS = ("NCHW", "OIHW", "NCHW")
 
 
+def _BN_STATS_MODE():
+    """Training BatchNorm statistics algorithm via MXNET_BN_STATS:
+    "auto" (default) = one fused read, flax-parity E[x^2]-mean^2 with
+    clamp — fastest, precision contract assumes roughly-normalized
+    inputs; "centered" = exact two-pass; "welford" = exact one-read
+    variadic reduce (see _bn_train_fwd and doc/performance.md).
+    Unknown values raise so a typo cannot silently select the inexact
+    default."""
+    import os
+    mode = os.environ.get("MXNET_BN_STATS", "auto")
+    if mode not in ("auto", "centered", "welford", "onepass_unsafe"):
+        raise MXNetError(
+            "MXNET_BN_STATS=%r: expected auto|centered|welford" % mode)
+    return mode
+
+
 def _use_nhwc():
     """Run convs/pools internally in NHWC (API stays NCHW).
 
@@ -336,9 +352,10 @@ def _bn_train_fwd(x, gamma, beta, eps):
     the v5e puts BatchNorm at ~1/3 of the ResNet-50 train step —
     doc/performance.md), and differentiating through the two-reduction
     stats graph makes XLA materialize extra activation-sized
-    intermediates. This form does the minimum that is numerically safe:
-    forward = centered two-pass stats (mean, then E[(x-mean)^2]) + one
-    folded scale/shift pass; backward = one fused reduction pass
+    intermediates. This form does the minimum the selected stats mode
+    needs (see _BN_STATS_MODE: fused one-pass flax-parity default,
+    exact "centered"/"welford" escapes) + one folded scale/shift pass;
+    backward = one fused reduction pass
     (sum(dy), sum(dy*xhat)) + one elementwise pass, all in the compute
     dtype, recomputing xhat from (x, mean, inv) so no extra activation
     residual is kept beyond x itself (which the surrounding conv's
@@ -348,14 +365,56 @@ def _bn_train_fwd(x, gamma, beta, eps):
     shape = (1, -1) + (1,) * (x.ndim - 2)
     n = x.size // x.shape[1]
     # accumulate at >= f32 (bf16 in stays bf16 TRAFFIC, f64 parity runs
-    # keep full precision). Variance is the TWO-pass centered form —
-    # E[(x-mean)^2] — NOT E[x^2]-mean^2, which catastrophically cancels
-    # in f32 for large-mean inputs (confirmed: mean ~3e4, std 1 ->
-    # var == 0.0 one-pass vs ~1.0 centered)
+    # keep full precision)
     acc = jnp.promote_types(x.dtype, jnp.float32)
     xf = x.astype(acc)
-    mean = jnp.mean(xf, axis=axes)
-    var = jnp.mean(jnp.square(xf - mean.reshape(shape)), axis=axes)
+    mode = _BN_STATS_MODE()
+    if mode == "centered":
+        # TWO full reads: mean, then E[(x-mean)^2] — exact
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.mean(jnp.square(xf - mean.reshape(shape)), axis=axes)
+    elif mode == "welford":
+        # exact ONE-read variance via a variadic reduce with the
+        # parallel Welford combiner (Chan et al. pairwise merge);
+        # measured +10 ms vs "auto" on the ResNet-50 step (the custom
+        # computation misses XLA's fast reduction emitter) but keeps
+        # full precision at one read where "centered" takes two
+        def _comb(a, b):
+            mu1, m1, n1 = a
+            mu2, m2, n2 = b
+            nt = n1 + n2
+            w = jnp.where(nt > 0, n2 / jnp.maximum(nt, 1.0), 0.0)
+            d = mu2 - mu1
+            return (mu1 + d * w, m1 + m2 + d * d * n1 * w, nt)
+        zero = jnp.zeros((), xf.dtype)
+        mean, m2, cnt = lax.reduce(
+            (xf, jnp.zeros_like(xf), jnp.ones_like(xf)),
+            (zero, zero, zero), _comb, axes)
+        var = m2 / cnt
+    else:
+        # "auto" (default): ONE full read. sum(x) and sum(x^2) are
+        # sibling reductions over the same input, which XLA fuses into
+        # a single pass (measured -6.4 ms on the 106.4 ms ResNet-50
+        # b256 train step vs the two-pass form; full A/B table in
+        # doc/performance.md). The combine E[x^2]-mean^2 loses
+        # ~mean^2/var relative precision to cancellation, which is
+        # catastrophic for channels with |mean|/sigma >~ 2000 (mean
+        # ~3e4, std 1 -> var computes EXACTLY 0) — this is the SAME
+        # algorithm and contract as flax/haiku BatchNorm on TPU
+        # (flax.linen.normalization computes mean and mean-of-squares
+        # exactly like this), and it is benign for conv outputs, whose
+        # channel means sit within a few sigma of 0. Guarded variants
+        # were all measured SLOWER THAN THE SAVING on this backend
+        # (lax.cond +25 ms — XLA select-izes it; any subsampled or
+        # shifted second read +15..+44 ms — a third consumer of the
+        # activation materializes an f32 copy; Welford variadic reduce
+        # +10 ms — misses the fast reduction emitter): the honest
+        # options are fast-with-contract or exact-two-pass, selected by
+        # MXNET_BN_STATS ("centered" = exact two-pass, "welford" =
+        # exact one-read variadic reduce).
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.maximum(
+            jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean), 0.0)
     inv = lax.rsqrt(var + eps)
     # fold per-channel scalars so the big pass is one multiply-add
     scale = (gamma.astype(acc) * inv).astype(x.dtype)
