@@ -11,12 +11,17 @@ chain as ONE Pallas kernel instead of separate XLA ops:
 
 * ``FullyConnected -> Activation`` (relu/sigmoid/tanh) — train and eval;
   gradient via ``fused_linear``'s custom_vjp.
-* ``Convolution -> BatchNorm [-> Activation(relu)]`` — eval only: the
+* ``Convolution -> BatchNorm [-> Activation(relu)]`` — eval: the
   moving-stats normalization folds into a per-channel scale/bias GEMM
-  epilogue (``fused_conv_bn_act``). Training BatchNorm needs batch stats
-  of the full conv output, so the train path keeps the XLA ops (XLA
-  already fuses the normalize+relu elementwise chain into the conv's
-  epilogue; measured in doc/performance.md).
+  epilogue (``fused_conv_bn_act``). TRAIN, for 1x1/stride-1/no-pad
+  convs: the conv runs as a Pallas GEMM whose epilogue also emits the
+  per-channel sum/sum-of-squares of its own output from the VMEM
+  accumulator (``matmul_stats``) — the batch-stats HBM read of the
+  activation disappears, the remaining normalize+relu is one fused
+  elementwise pass, and the moving-stat updates keep reference
+  semantics. Opt-in via MXNET_PALLAS_CONVBN_TRAIN=1 (measured SLOWER
+  end-to-end than the XLA path on this chip — see
+  ``_convbn_train_enabled``) and requires MXNET_BN_STATS=auto.
 
 Selection control: ``MXNET_PALLAS_FUSION=1`` forces on (any backend,
 interpreter on CPU), ``=0`` forces off; default = on when running on
@@ -42,6 +47,24 @@ def fusion_enabled():
     return jax.default_backend() == "tpu"
 
 
+def _convbn_train_enabled():
+    """Train-time conv+BN stats-epilogue fusion. Requires the default
+    one-pass BN stats contract (the exact modes are defined by their own
+    pass structure over the activation, which the epilogue replaces).
+
+    DEFAULT OFF: measured end-to-end at 212.5 ms/step vs the 99.9 ms
+    XLA baseline on ResNet-50 b256 (doc/performance.md round-4 table) —
+    a pallas_call pins its operand layout, so every fused conv pays two
+    materialized NCHW<->[M,C] conversions, and XLA's native conv
+    emitters outrun a general Pallas GEMM on these shapes. Kept behind
+    MXNET_PALLAS_CONVBN_TRAIN=1 with full exact-value tests
+    (test_fusion.py) as the measured-and-rejected record."""
+    from .nn import _BN_STATS_MODE
+    if _BN_STATS_MODE() != "auto":
+        return False
+    return os.environ.get("MXNET_PALLAS_CONVBN_TRAIN") == "1"
+
+
 _FC_ACTS = ("relu", "sigmoid", "tanh")
 
 
@@ -54,7 +77,7 @@ class FusionPlan:
         # BatchNorm gamma/beta variables, which topo-sort AFTER the conv)
         # is in env. Earlier members are 'covered' (skipped while active).
         self.chains = {}   # id(last_node) -> (kind, [nodes...])
-        self.covered = {}  # id(earlier_node) -> kind
+        self.covered = {}  # id(earlier_node) -> id(last_node of its chain)
         self.aux_off = {}  # id(node) -> aux cursor at that node
         cursor = 0
         consumers = {}
@@ -83,7 +106,7 @@ class FusionPlan:
                         and act.params.get("act_type") in _FC_ACTS \
                         and act.inputs[0][0] is n:
                     self.chains[id(act)] = ("fc_act", [n, act])
-                    self.covered[id(n)] = "fc_act"
+                    self.covered[id(n)] = id(act)
             elif op == "Convolution" and n.params.get("num_group", 1) == 1:
                 bn = sole_consumer(n)
                 if bn is None or bn.spec.name != "BatchNorm" \
@@ -94,27 +117,98 @@ class FusionPlan:
                         and act.params.get("act_type") == "relu" \
                         and act.inputs[0][0] is bn:
                     self.chains[id(act)] = ("conv_bn_relu", [n, bn, act])
-                    self.covered[id(n)] = "conv_bn_relu"
-                    self.covered[id(bn)] = "conv_bn_relu"
+                    self.covered[id(n)] = id(act)
+                    self.covered[id(bn)] = id(act)
                 else:
                     self.chains[id(bn)] = ("conv_bn", [n, bn])
-                    self.covered[id(n)] = "conv_bn"
+                    self.covered[id(n)] = id(bn)
 
     @staticmethod
-    def _active(kind, is_train):
-        # conv+bn folding needs the moving stats — inference only
-        return kind == "fc_act" or not is_train
+    def _conv_is_pointwise(p):
+        return (tuple(p["kernel"]) == (1, 1)
+                and tuple(p["stride"]) == (1, 1)
+                and tuple(p["pad"]) == (0, 0)
+                and tuple(p["dilate"]) == (1, 1))
+
+    @classmethod
+    def _active(cls, kind, nodes, is_train):
+        if kind == "fc_act":
+            return True
+        if not is_train:
+            # eval conv+bn folds the moving stats — always available
+            return True
+        # train conv+bn: the stats epilogue serves 1x1 convs under the
+        # default one-pass BN contract (exact modes need their own
+        # pass structure over the activation)
+        return (_convbn_train_enabled()
+                and cls._conv_is_pointwise(nodes[0].params))
 
     def is_covered(self, n, is_train):
-        kind = self.covered.get(id(n))
-        return kind is not None and self._active(kind, is_train)
-
-    def execute(self, n, env, aux_vals, is_train):
-        """If ``n`` ends an active chain, compute the fused result into
-        its env slot and return True."""
-        entry = self.chains.get(id(n))
-        if entry is None or not self._active(entry[0], is_train):
+        last_id = self.covered.get(id(n))
+        if last_id is None:
             return False
+        kind, nodes = self.chains[last_id]
+        return self._active(kind, nodes, is_train)
+
+    def execute(self, n, env, aux_vals, is_train, new_aux=None):
+        """If ``n`` ends an active chain, compute the fused result into
+        its env slot and return True. ``new_aux`` receives the BN
+        moving-stat updates on the fused TRAIN path."""
+        entry = self.chains.get(id(n))
+        if entry is None or not self._active(entry[0], entry[1], is_train):
+            return False
+        kind = entry[0]
+        if is_train and kind in ("conv_bn", "conv_bn_relu"):
+            return self._execute_conv_bn_train(entry, env, aux_vals,
+                                               new_aux)
+        return self._execute_eval(entry, env, aux_vals)
+
+    def _execute_conv_bn_train(self, entry, env, aux_vals, new_aux):
+        """1x1 conv as a Pallas GEMM whose epilogue emits sum/sumsq of
+        its own output (``matmul_stats``): train BatchNorm stats without
+        the activation re-read. A conv bias is algebraically absorbed —
+        BN subtracts the batch mean, so the bias cancels out of the
+        normalized output (its gradient is exactly 0, matching the
+        unfused path) and only shifts the recorded moving_mean."""
+        from . import pallas_kernels as pk
+        kind, nodes = entry
+        conv, bn = nodes[0], nodes[1]
+        p, bp = conv.params, bn.params
+        ins = [env[(id(inp), idx)] for inp, idx in conv.inputs]
+        x, w = ins[0], ins[1]
+        gamma, beta = (env[(id(inp), idx)] for inp, idx in bn.inputs[1:3])
+        if bp["fix_gamma"]:
+            gamma = jnp.ones_like(gamma)
+        nb, c, h, wd = x.shape
+        nf = p["num_filter"]
+        xm = jnp.transpose(x, (0, 2, 3, 1)).reshape(-1, c)
+        y, s1, s2 = pk.matmul_stats(xm, w.reshape(nf, c).T)
+        m = xm.shape[0]
+        acc = jnp.promote_types(x.dtype, jnp.float32)
+        mean = s1.astype(acc) / m
+        var = jnp.maximum(s2.astype(acc) / m - jnp.square(mean), 0.0)
+        inv = jax.lax.rsqrt(var + float(bp["eps"]))
+        scale = (gamma.astype(acc) * inv).astype(y.dtype)
+        shift = (beta.astype(acc)
+                 - mean * gamma.astype(acc) * inv).astype(y.dtype)
+        out = y * scale[None, :] + shift[None, :]
+        if kind == "conv_bn_relu":
+            out = jnp.maximum(out, 0)
+        env[(id(nodes[-1]), 0)] = \
+            out.reshape(nb, h, wd, nf).transpose(0, 3, 1, 2)
+        # moving-stat updates (reference momentum form); the absorbed
+        # conv bias reappears in the recorded mean
+        rec_mean = mean if p["no_bias"] else mean + ins[2].astype(acc)
+        off = self.aux_off[id(bn)]
+        mmean, mvar = aux_vals[off], aux_vals[off + 1]
+        mom = bp["momentum"]
+        new_aux[off] = (mom * mmean
+                        + (1 - mom) * rec_mean.astype(mmean.dtype))
+        new_aux[off + 1] = (mom * mvar
+                            + (1 - mom) * var.astype(mvar.dtype))
+        return True
+
+    def _execute_eval(self, entry, env, aux_vals):
         from . import pallas_kernels as pk
         kind, nodes = entry
         ins = [env[(id(inp), idx)] for inp, idx in nodes[0].inputs]
@@ -172,10 +266,11 @@ def eval_graph(topo, heads, arg_vals, aux_vals, is_train, rng, plan=None):
         n_aux = len(n.spec.aux_states(n.params))
         if fuse and plan.is_covered(n, is_train):
             # produced by a fused chain head; aux (BN moving stats) pass
-            # through unchanged — fusion is inference-only for stateful ops
+            # through unchanged on eval paths, and the TRAIN conv+bn
+            # chain head writes its BN aux updates into new_aux directly
             aux_cursor += n_aux
             continue
-        if fuse and plan.execute(n, env, aux_vals, is_train):
+        if fuse and plan.execute(n, env, aux_vals, is_train, new_aux):
             aux_cursor += n_aux
             continue
         ins = [env[(id(inp), idx)] for inp, idx in n.inputs]

@@ -30,7 +30,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "fused_linear", "striped_pair_attention"]
+__all__ = ["flash_attention", "fused_linear", "striped_pair_attention",
+           "matmul_stats"]
 
 
 def _use_interpret():
@@ -751,3 +752,119 @@ def fused_conv_bn_act(x, w, scale, bias, stride=(1, 1), pad=(0, 0),
     out = _matmul_epilogue(xm, wm, scale, bias, act, block_m, block_n,
                            block_k, interpret)
     return out.reshape(nb, oh, ow, nf).transpose(0, 3, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# training conv(1x1)+BN-stats epilogue: GEMM that also emits per-column
+# sum and sum-of-squares of its OWN output, accumulated while the MXU
+# tile is still in VMEM — the batch-stats read the training BatchNorm
+# would otherwise do against HBM disappears. Reference analogue: the
+# cuDNN-selected conv + batch_norm pair (cudnn_convolution-inl.h,
+# batch_norm-inl.h:95-125), fused the TPU way.
+
+def _gemm_stats_kernel(x_ref, w_ref, o_ref, s1_ref, s2_ref, acc_ref, *,
+                       nk):
+    """One (M,N) tile of x@w; on the last K step also reduce the f32
+    accumulator tile to per-column sum / sum-of-squares partials
+    (grid_m x N), BEFORE the output is rounded to its storage dtype —
+    the stats see the exact f32 GEMM results."""
+    kidx = pl.program_id(2)
+
+    @pl.when(kidx == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    acc_ref[...] += jnp.dot(x_ref[...], w_ref[...],
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(kidx == jnp.int32(nk - 1))
+    def _epilogue():
+        acc = acc_ref[...]
+        o_ref[...] = acc.astype(o_ref.dtype)
+        # Mosaic wants >=8 sublanes per output block: broadcast the
+        # per-column partials over the 8 rows (the host-side combine
+        # divides the final sum by 8 — exact in binary fp)
+        s1 = jnp.sum(acc, axis=0, keepdims=True)
+        s2 = jnp.sum(acc * acc, axis=0, keepdims=True)
+        s1_ref[...] = jnp.broadcast_to(s1, s1_ref.shape)
+        s2_ref[...] = jnp.broadcast_to(s2, s2_ref.shape)
+
+
+def _matmul_stats_impl(x, w, block_m, block_n, block_k, interpret):
+    m, kdim = x.shape
+    n = w.shape[1]
+    bm = min(block_m, _round_up(m, 8))
+    bn = min(block_n, _round_up(n, 128))
+    bk = min(block_k, _round_up(kdim, 128))
+    mp, np_, kp = _round_up(m, bm), _round_up(n, bn), _round_up(kdim, bk)
+    xp = jnp.pad(x, ((0, mp - m), (0, kp - kdim))) \
+        if (mp, kp) != (m, kdim) else x
+    wp = jnp.pad(w, ((0, kp - kdim), (0, np_ - n))) \
+        if (kp, np_) != (kdim, n) else w
+    nk = kp // bk
+    gm = mp // bm
+    out, s1p, s2p = pl.pallas_call(
+        functools.partial(_gemm_stats_kernel, nk=nk),
+        out_shape=(jax.ShapeDtypeStruct((mp, np_), x.dtype),
+                   jax.ShapeDtypeStruct((gm * 8, np_), jnp.float32),
+                   jax.ShapeDtypeStruct((gm * 8, np_), jnp.float32)),
+        grid=(gm, np_ // bn, nk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+        ],
+        out_specs=(pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
+                   pl.BlockSpec((8, bn), lambda i, j, k: (i, j)),
+                   pl.BlockSpec((8, bn), lambda i, j, k: (i, j))),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+    )(xp, wp)
+    # tiny (8*grid_m, N) partial reduction — each tile's partial is
+    # replicated over 8 sublanes (Mosaic min block), hence the /8,
+    # which is exact in binary fp; padded M rows are zeros in x, so
+    # they contribute exactly 0 to both partials
+    return (out[:m, :n], s1p.sum(axis=0)[:n] / 8.0,
+            s2p.sum(axis=0)[:n] / 8.0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _matmul_stats_core(x, w, block_m, block_n, block_k, interpret):
+    return _matmul_stats_impl(x, w, block_m, block_n, block_k, interpret)
+
+
+def _matmul_stats_fwd(x, w, block_m, block_n, block_k, interpret):
+    outs = _matmul_stats_impl(x, w, block_m, block_n, block_k, interpret)
+    return outs, (x, w, outs[0])
+
+
+def _matmul_stats_bwd(block_m, block_n, block_k, interpret, res, gs):
+    x, w, y = res
+    gy, gs1, gs2 = gs
+    # s1 = sum_rows(y), s2 = sum_rows(y^2): their cotangents fold into
+    # the output cotangent as broadcasts, keeping ONE pair of backward
+    # MXU dots for the whole fused op
+    g = (gy.astype(jnp.float32)
+         + gs1[None, :].astype(jnp.float32)
+         + 2.0 * y.astype(jnp.float32) * gs2[None, :].astype(jnp.float32))
+    g = g.astype(x.dtype)
+    dx = jnp.dot(g, w.T)
+    dw = jnp.dot(x.T, g)
+    return dx, dw
+
+
+_matmul_stats_core.defvjp(_matmul_stats_fwd, _matmul_stats_bwd)
+
+
+def matmul_stats(x, w, *, block_m=256, block_n=256, block_k=512,
+                 interpret=None):
+    """(x @ w, per-column sum, per-column sum-of-squares) in ONE kernel.
+
+    x: [M, K], w: [K, N]. The stats are exact f32 sums of the GEMM
+    output read from the VMEM accumulator — the consumer (training
+    conv+BatchNorm fusion, ops/fusion.py) derives batch mean/var
+    without re-reading the activation from HBM. Differentiable:
+    d(s1)/d(s2) cotangents fold into the output cotangent, so the
+    backward is the usual two MXU dots."""
+    if interpret is None:
+        interpret = _use_interpret()
+    return _matmul_stats_core(x, w, block_m, block_n, block_k, interpret)

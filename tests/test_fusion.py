@@ -126,3 +126,144 @@ def test_fused_training_converges(monkeypatch):
     monkeypatch.setenv("MXNET_PALLAS_FUSION", "0")
     acc = model.score(mx.io.NDArrayIter(X, y, batch_size=100))
     assert acc > 0.9, acc
+
+
+def _bottleneck_net(with_relu=True, with_bias=False):
+    """1x1 conv -> BN [-> relu] chains (the train stats-epilogue shape)."""
+    data = mx.symbol.Variable("data")
+    c1 = mx.symbol.Convolution(data=data, name="p1", kernel=(1, 1),
+                               num_filter=16, no_bias=not with_bias)
+    b1 = mx.symbol.BatchNorm(data=c1, name="pbn1", fix_gamma=False)
+    net = mx.symbol.Activation(data=b1, name="pr1", act_type="relu") \
+        if with_relu else b1
+    c2 = mx.symbol.Convolution(data=net, name="p2", kernel=(1, 1),
+                               num_filter=8, no_bias=True)
+    b2 = mx.symbol.BatchNorm(data=c2, name="pbn2")
+    p = mx.symbol.Pooling(data=b2, name="pool", kernel=(4, 4),
+                          pool_type="avg", global_pool=True)
+    fc = mx.symbol.FullyConnected(data=mx.symbol.Flatten(data=p),
+                                  name="fc", num_hidden=10)
+    return mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+
+
+def _run_exec_aux(sym, shapes, seed, fused, monkeypatch, convbn="1"):
+    """Like _run_exec (train) but also returns the updated aux states."""
+    monkeypatch.setenv("MXNET_PALLAS_FUSION", "1" if fused else "0")
+    monkeypatch.setenv("MXNET_PALLAS_CONVBN_TRAIN", convbn)
+    rng = np.random.RandomState(seed)
+    arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+    args = {n: mx.nd.array(rng.uniform(-0.5, 0.5, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes)}
+    grads = {n: mx.nd.zeros(s)
+             for n, s in zip(sym.list_arguments(), arg_shapes)
+             if n not in shapes}
+    exe = sym.bind(mx.cpu(), args, args_grad=grads)
+    for a, s in zip(exe.aux_arrays, aux_shapes):
+        r = np.random.RandomState(5)
+        a[:] = r.rand(*s).astype(np.float32) + 0.5
+    exe.forward(is_train=True)
+    outs = [o.asnumpy() for o in exe.outputs]
+    exe.backward()
+    gvals = {n: g.asnumpy() for n, g in grads.items()}
+    aux = [a.asnumpy() for a in exe.aux_arrays]
+    return outs, gvals, aux
+
+
+@pytest.mark.parametrize("with_relu,with_bias",
+                         [(True, False), (False, False), (True, True)])
+def test_fused_convbn_train_matches_plain(with_relu, with_bias,
+                                          monkeypatch):
+    """TRAIN-mode 1x1 conv+BN stats-epilogue fusion (matmul_stats) must
+    match the plain XLA graph: outputs, every gradient, AND the BN
+    moving-stat aux updates (including the absorbed-conv-bias shift in
+    moving_mean)."""
+    monkeypatch.setenv("MXNET_PALLAS_CONVBN_TRAIN", "1")
+    monkeypatch.setenv("MXNET_BN_STATS", "auto")
+    sym = _bottleneck_net(with_relu, with_bias)
+    plan = FusionPlan(sym._topo(), sym._heads)
+    kinds = sorted(k for k, _ in plan.chains.values())
+    want = "conv_bn_relu" if with_relu else "conv_bn"
+    assert want in kinds
+    # the chain must be active in train mode for pointwise convs
+    nodes = next(v for v in plan.chains.values() if v[0] == want)
+    assert plan._active(want, nodes[1], True)
+
+    shapes = {"data": (4, 6, 8, 8), "softmax_label": (4,)}
+    o1, g1, aux1 = _run_exec_aux(sym, shapes, 3, True, monkeypatch)
+    o2, g2, aux2 = _run_exec_aux(sym, shapes, 3, False, monkeypatch)
+    for a, b in zip(o1, o2):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    for n in g2:
+        np.testing.assert_allclose(g1[n], g2[n], rtol=1e-3, atol=1e-4,
+                                   err_msg=n)
+    for a, b in zip(aux1, aux2):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_convbn_train_gating(monkeypatch):
+    """The train chain must deactivate for non-pointwise convs, under
+    exact BN stats modes, and under MXNET_PALLAS_CONVBN_TRAIN=0."""
+    sym = _convnet()  # 3x3 convs
+    plan = FusionPlan(sym._topo(), sym._heads)
+    for kind, nodes in plan.chains.values():
+        if kind.startswith("conv_bn"):
+            assert not plan._active(kind, nodes, True)   # not pointwise
+            assert plan._active(kind, nodes, False)      # eval still on
+
+    sym2 = _bottleneck_net()
+    plan2 = FusionPlan(sym2._topo(), sym2._heads)
+    entry = next(v for v in plan2.chains.values()
+                 if v[0].startswith("conv_bn"))
+    monkeypatch.setenv("MXNET_BN_STATS", "centered")
+    assert not plan2._active(entry[0], entry[1], True)
+    monkeypatch.delenv("MXNET_BN_STATS")
+    monkeypatch.setenv("MXNET_PALLAS_CONVBN_TRAIN", "0")
+    assert not plan2._active(entry[0], entry[1], True)
+    # measured-and-rejected: off unless explicitly opted in
+    monkeypatch.delenv("MXNET_PALLAS_CONVBN_TRAIN")
+    assert not plan2._active(entry[0], entry[1], True)
+    monkeypatch.setenv("MXNET_PALLAS_CONVBN_TRAIN", "1")
+    assert plan2._active(entry[0], entry[1], True)
+
+
+def test_matmul_stats_kernel():
+    """matmul_stats: product, per-column sum/sumsq, and the custom vjp
+    (s1/s2 cotangents fold into the output cotangent) vs autodiff of
+    the plain formulation — including non-multiple-of-block shapes."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas_kernels import matmul_stats
+
+    rng = np.random.RandomState(0)
+    for m, k, n in [(64, 32, 16), (130, 70, 36)]:
+        x = jnp.asarray(rng.randn(m, k).astype(np.float32))
+        w = jnp.asarray(rng.randn(k, n).astype(np.float32))
+        y, s1, s2 = matmul_stats(x, w, interpret=True)
+        ref = np.asarray(x) @ np.asarray(w)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(s1), ref.sum(0), rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(s2), (ref * ref).sum(0),
+                                   rtol=1e-4, atol=1e-4)
+
+        co = jnp.asarray(rng.randn(m, n).astype(np.float32))
+        c1 = jnp.asarray(rng.randn(n).astype(np.float32))
+        c2 = jnp.asarray(rng.randn(n).astype(np.float32))
+
+        def loss_pk(x_, w_):
+            y_, a_, b_ = matmul_stats(x_, w_, interpret=True)
+            return (jnp.sum(y_ * co) + jnp.sum(a_ * c1)
+                    + jnp.sum(b_ * c2))
+
+        def loss_ref(x_, w_):
+            y_ = x_ @ w_
+            return (jnp.sum(y_ * co) + jnp.sum(jnp.sum(y_, 0) * c1)
+                    + jnp.sum(jnp.sum(y_ * y_, 0) * c2))
+
+        g_pk = jax.grad(loss_pk, argnums=(0, 1))(x, w)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+        for a, b, what in zip(g_pk, g_ref, ("dx", "dw")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4,
+                                       err_msg=what)
