@@ -1,0 +1,27 @@
+/* Harness-facing helpers of the mini R runtime (r_runtime.c). */
+#ifndef MXNET_TPU_R_STUB_R_RUNTIME_H_
+#define MXNET_TPU_R_STUB_R_RUNTIME_H_
+
+#include <R.h>
+#include <R_ext/Rdynload.h>
+
+/* run fn(arg); returns 1 if Rf_error was raised (message via
+ * mini_last_error), 0 on success */
+int mini_try(void (*fn)(void *), void *arg);
+const char *mini_last_error(void);
+
+SEXP mini_real_vec(const double *vals, R_xlen_t n);
+SEXP mini_int_vec(const int *vals, R_xlen_t n);
+SEXP mini_str_vec(const char **vals, R_xlen_t n);
+SEXP mini_list(SEXP *vals, R_xlen_t n);
+SEXP mini_get_names(SEXP obj);
+
+int mini_gc_all(void);           /* run all extptr finalizers */
+int mini_protect_depth(void);    /* PROTECT-stack balance check */
+DL_FUNC mini_find_call(const char *name, int *nargs);
+
+/* the shim's registration entry (mxnet_r.c) */
+typedef struct _DllInfo DllInfo;
+void R_init_mxnet_r(DllInfo *dll);
+
+#endif

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import jax
@@ -202,71 +203,192 @@ def bench_transformer_lm(batch=8, seq=1024, layers=12, embed=768,
     return tps, mfu
 
 
-def bench_recordio_io(n_images=512, batch=128):
-    """C++ ImageRecordIOIter img/s on synthetic packed RecordIO
-    (reference publishes ~3,000 img/s from packed RecordIO on an HDD,
-    doc/tutorial/imagenet_full.md:37)."""
+def bench_recordio_io():
+    """C++ ImageRecordIOIter: run tools/bench_io.py in a CLEAN
+    subprocess (no jax): on this 1-core container the jax/axon runtime
+    threads degrade the in-process measurement 3.3x (round-3's 125 img/s
+    driver capture vs ~460 exclusive was exactly this contention — see
+    doc/performance.md). The subprocess measures the pipeline; the
+    in-process number is reported separately as the contended figure.
+    Returns (modes_dict or None, contended_img_per_sec or None)."""
+    import subprocess
     import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    modes = None
+    try:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        r = subprocess.run(
+            [sys.executable, os.path.join(here, "tools", "bench_io.py")],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=here)
+        for line in reversed(r.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                modes = json.loads(line)
+                break
+    except Exception:
+        modes = None
+    # contended: same 480x360-source jpeg pipeline measured in THIS
+    # process, where the TPU runtime threads steal the core
+    contended = None
     try:
         import cv2  # noqa: F401
         import mxnet_tpu as mx
         from mxnet_tpu import recordio as rec
-    except Exception:
-        return None
-    tmpd = tempfile.mkdtemp(prefix="benchrec")
-    path = os.path.join(tmpd, "bench.rec")
-    rng = np.random.RandomState(0)
-    w = rec.MXRecordIO(path, "w")
-    img = (rng.rand(224, 224, 3) * 255).astype(np.uint8)
-    for i in range(n_images):
-        hdr = rec.IRHeader(0, float(i % 10), i, 0)
-        w.write(rec.pack_img(hdr, img, quality=85))
-    w.close()
-    try:
-        it = mx.ImageRecordIter(path_imgrec=path,
-                                data_shape=(3, 224, 224),
-                                batch_size=batch, shuffle=False)
-        it.reset()
-        for b in it:  # warm epoch (thread spin-up)
+
+        tmpd = tempfile.mkdtemp(prefix="benchrec")
+        path = os.path.join(tmpd, "bench.rec")
+        rng = np.random.RandomState(0)
+        w = rec.MXRecordIO(path, "w")
+        base = (rng.rand(24, 32, 3) * 255).astype(np.uint8)
+        img = cv2.resize(base, (480, 360), interpolation=cv2.INTER_CUBIC)
+        for i in range(256):
+            hdr = rec.IRHeader(0, float(i % 10), i, 0)
+            w.write(rec.pack_img(hdr, img, quality=85))
+        w.close()
+        it = mx.ImageRecordIter(path_imgrec=path, data_shape=(3, 224, 224),
+                                batch_size=128, resize=256, rand_crop=True,
+                                rand_mirror=True, shuffle=False)
+        for _ in it.iter_numpy():
             pass
         it.reset()
         tic = time.perf_counter()
         n = 0
-        for b in it:
-            n += batch
-        dt = time.perf_counter() - tic
-        return n / dt
+        for _ in it.iter_numpy():
+            n += 128
+        contended = n / (time.perf_counter() - tic)
     except Exception:
+        contended = None
+    return modes, contended
+
+
+def bench_gemm_calibration(steps=8):
+    """This chip's PRACTICAL compute ceiling through the relay: chained
+    dependent 8192^3 bf16 GEMMs (the best program the chip can run).
+
+    Methodology hazard (round 4): a chain of SEPARATE dispatches with
+    value-identical inputs measured 192-453 TF/s — above the 197 TF/s
+    datasheet peak, i.e. the relay elides repeated identical dispatches
+    rather than executing them. The chain therefore lives INSIDE one
+    program as a ``lax.scan`` of dependent matmuls (nothing to elide;
+    compile excluded by warmup), timed as the k-vs-2k program
+    difference with fresh input values per repetition."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = 8192
+    w = jnp.ones((n, n), jnp.bfloat16) * jnp.bfloat16(1.0 / n)
+
+    def make(k):
+        @jax.jit
+        def run(a):
+            def body(c, _):
+                return jnp.dot(c, w), None
+            out, _ = lax.scan(body, a, None, length=k)
+            return out[0, 0]
+        return run
+
+    run1, run2 = make(steps), make(2 * steps)
+
+    def timed(fn, seed):
+        a = jnp.full((n, n), 1.0 + seed * 1e-3, jnp.bfloat16)
+        tic = time.perf_counter()
+        np.asarray(fn(a))
+        return time.perf_counter() - tic
+
+    timed(run1, 99)  # compile+warm both programs
+    timed(run2, 98)
+    diffs = []
+    for rep in range(3):
+        t1 = timed(run1, rep * 2)
+        t2 = timed(run2, rep * 2 + 1)
+        if t2 - t1 > 0.02 * t1:
+            diffs.append((t2 - t1) / steps)
+    if not diffs:
         return None
+    sec = sorted(diffs)[len(diffs) // 2]
+    return 2.0 * n * n * n / sec
 
 
 def main():
+    ceiling = bench_gemm_calibration()
+    peak = _peak_flops(__import__("jax").devices()[0])
     r50_256, r50_256_h2d, mfu = bench_resnet50(256)
     r50_128, _, _ = bench_resnet50(128)
     incbn = bench_inception_bn()
     cifar = bench_cifar()
     lm_tps, lm_mfu = bench_transformer_lm()
-    io_ips = bench_recordio_io()
+    io_modes, io_contended = bench_recordio_io()
+
+    def vs_ceiling(nominal_mfu):
+        if ceiling is None:
+            return None
+        return round(nominal_mfu * peak / ceiling, 3)
+
+    extra = {
+        "resnet50_b256_bf16": round(r50_256, 1),
+        "resnet50_b128_bf16": round(r50_128, 1),
+        "resnet50_mfu_nominal": round(mfu, 3),
+        "resnet50_mfu_vs_measured_ceiling": vs_ceiling(mfu),
+        "inception-bn_imagenet_b128": round(incbn, 1),
+        "inception-bn_vs_titanx_per_gpu":
+            round(incbn / INCEPTION_BN_TITANX_BASELINE, 1),
+        "transformer_lm_124M_T1024_tokens_per_sec": round(lm_tps, 0),
+        "transformer_lm_mfu_nominal": round(lm_mfu, 3),
+        "transformer_lm_mfu_vs_measured_ceiling": vs_ceiling(lm_mfu),
+        "calibration": {
+            "gemm_8192_bf16_tflops":
+                None if ceiling is None else round(ceiling / 1e12, 1),
+            "datasheet_peak_tflops": round(peak / 1e12, 1),
+            "note": "measured ceiling of a chained 8192^3 bf16 GEMM "
+                    "through the relay; MFUs reported vs both this and "
+                    "the datasheet number",
+        },
+        # --- numbers that need caveats to be interpretable ------------
+        "resnet50_b256_bf16_host_infeed": {
+            "value": round(r50_256_h2d, 1),
+            "caveat": "tunnel-bound: measures the ~30 MB/s relay h2d "
+                      "link, not the framework; on a local TPU host "
+                      "h2d rides PCIe and prefetch overlaps it",
+        },
+        "cifar10_inception-bn-28-small": {
+            "value": round(cifar, 1),
+            "vs_gtx980_baseline": round(cifar / CIFAR_BASELINE, 3),
+            "caveat": "dispatch-bound through the relay at ~2-16 "
+                      "ms/step; spread across runs is 7k-53k img/s, "
+                      "so this is a lower bound, not a measurement",
+        },
+        "recordio_io": {
+            "img_per_sec":
+                None if io_modes is None
+                else round(io_modes.get("jpeg_scaled", 0), 1),
+            "caveat": "exclusive: measured in a clean subprocess (no "
+                      "jax runtime threads); 480x360-source JPEGs, "
+                      "resize 256, random crop+mirror to 224, 1 CPU "
+                      "core",
+            "in_process_img_per_sec":
+                None if io_contended is None else round(io_contended, 1),
+            "in_process_caveat": "same pipeline measured inside the "
+                                 "bench process (jax initialized). "
+                                 "Degrades up to 3.3x when jax/axon "
+                                 "runtime threads are active on the "
+                                 "single core - round-3's 125 img/s "
+                                 "driver capture was exactly that; "
+                                 "compare against the exclusive number "
+                                 "above",
+            "modes": io_modes,
+        },
+    }
     print(json.dumps({
         "metric": "resnet50_imagenet_train_throughput",
         "value": round(r50_256, 1),
         "unit": "img/s/chip",
         "vs_baseline": round(r50_256 / NORTH_STAR_IMG_PER_SEC, 3),
-        "extra": {
-            "resnet50_b256_bf16": round(r50_256, 1),
-            "resnet50_b256_bf16_host_infeed": round(r50_256_h2d, 1),
-            "resnet50_b128_bf16": round(r50_128, 1),
-            "resnet50_mfu_estimate": round(mfu, 3),
-            "inception-bn_imagenet_b128": round(incbn, 1),
-            "inception-bn_vs_titanx_per_gpu":
-                round(incbn / INCEPTION_BN_TITANX_BASELINE, 1),
-            "cifar10_inception-bn-28-small": round(cifar, 1),
-            "cifar_vs_gtx980_baseline": round(cifar / CIFAR_BASELINE, 3),
-            "transformer_lm_124M_T1024_tokens_per_sec": round(lm_tps, 0),
-            "transformer_lm_mfu_estimate": round(lm_mfu, 3),
-            "recordio_io_img_per_sec":
-                None if io_ips is None else round(io_ips, 1),
-        },
+        "extra": extra,
     }))
 
 

@@ -113,6 +113,15 @@ int MXTRecordIOReaderFree(void* handle) {
 }
 
 // ---- Image record iterator --------------------------------------------
+int MXTImRecIterCreateEx(const char* rec_path, int batch_size, int channels,
+                         int height, int width, int label_width,
+                         float mean_r, float mean_g, float mean_b,
+                         float scale, int resize_shorter, int rand_crop,
+                         int rand_mirror, int shuffle, unsigned seed,
+                         int num_parts, int part_index, int num_threads,
+                         int prefetch, int round_batch, int out_uint8,
+                         int scaled_decode, void** out);
+
 int MXTImRecIterCreate(const char* rec_path, int batch_size, int channels,
                        int height, int width, int label_width, float mean_r,
                        float mean_g, float mean_b, float scale,
@@ -120,6 +129,27 @@ int MXTImRecIterCreate(const char* rec_path, int batch_size, int channels,
                        int shuffle, unsigned seed, int num_parts,
                        int part_index, int num_threads, int prefetch,
                        int round_batch, void** out) {
+  // legacy ABI-stable entry point: float output, scaled decode on
+  return MXTImRecIterCreateEx(rec_path, batch_size, channels, height,
+                              width, label_width, mean_r, mean_g, mean_b,
+                              scale, resize_shorter, rand_crop,
+                              rand_mirror, shuffle, seed, num_parts,
+                              part_index, num_threads, prefetch,
+                              round_batch, /*out_uint8=*/0,
+                              /*scaled_decode=*/1, out);
+}
+
+// Extended create: adds the device-augment uint8 output mode and the
+// scaled-JPEG-decode toggle (kept separate so the original entry point
+// stays ABI-stable for existing clients/bindings).
+int MXTImRecIterCreateEx(const char* rec_path, int batch_size, int channels,
+                         int height, int width, int label_width,
+                         float mean_r, float mean_g, float mean_b,
+                         float scale, int resize_shorter, int rand_crop,
+                         int rand_mirror, int shuffle, unsigned seed,
+                         int num_parts, int part_index, int num_threads,
+                         int prefetch, int round_batch, int out_uint8,
+                         int scaled_decode, void** out) {
   API_BEGIN();
   mxtpu::ImRecParams p;
   p.rec_path = rec_path;
@@ -142,12 +172,24 @@ int MXTImRecIterCreate(const char* rec_path, int batch_size, int channels,
   p.num_threads = num_threads;
   p.prefetch = prefetch;
   p.round_batch = round_batch != 0;
+  p.out_uint8 = out_uint8 != 0;
+  p.scaled_decode = scaled_decode != 0;
   auto* it = new mxtpu::ImageRecordIter(p);
   if (!it->ok()) {
     delete it;
     return Fail("cannot open .rec (missing, empty, or empty shard)");
   }
   *out = it;
+  API_END();
+}
+
+int MXTImRecIterNextU8(void* handle, uint8_t* data, float* label, int* pad,
+                       int* has_batch) {
+  API_BEGIN();
+  *has_batch = static_cast<mxtpu::ImageRecordIter*>(handle)->NextU8(
+                   data, label, pad)
+                   ? 1
+                   : 0;
   API_END();
 }
 

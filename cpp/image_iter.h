@@ -21,6 +21,8 @@
 #include <thread>
 #include <vector>
 
+#include <opencv2/core.hpp>
+
 namespace mxtpu {
 
 struct ImRecParams {
@@ -39,6 +41,13 @@ struct ImRecParams {
   int num_threads = 4;
   int prefetch = 4;          // batches in flight
   bool round_batch = true;   // pad last batch (reports pad count)
+  // Emit uint8 HWC batches with NO normalize/mirror — the device-side
+  // augmentation path (crop/flip/normalize run inside the compiled
+  // step; 4x less infeed bytes, no per-pixel host float work).
+  bool out_uint8 = false;
+  // Decode JPEGs at 1/2, 1/4 or 1/8 DCT scale when the target shape
+  // permits (IMREAD_REDUCED_*) — the classic imagenet-pipeline trick.
+  bool scaled_decode = true;
 };
 
 class ImageRecordIter {
@@ -49,12 +58,15 @@ class ImageRecordIter {
   // Copy next batch into caller buffers (data: B*C*H*W floats, label:
   // B*label_width floats). Returns false at epoch end.
   bool Next(float* data_out, float* label_out, int* pad_out);
+  // uint8 variant (out_uint8 mode): data_out is B*H*W*C bytes, HWC RGB.
+  bool NextU8(uint8_t* data_out, float* label_out, int* pad_out);
   void Reset();
   int64_t num_records() const { return (int64_t)my_offsets_.size(); }
 
  private:
   struct Batch {
     std::vector<float> data, label;
+    std::vector<uint8_t> data_u8;
     std::atomic<int> remaining{0};
     int pad = 0;
     int id = -1;
@@ -74,6 +86,11 @@ class ImageRecordIter {
   void WorkerLoop();
   void DecodeInto(const std::string& rec, Batch* b, int slot,
                   uint64_t rng_tag);
+  cv::Mat DecodePayload(const uint8_t* payload, size_t payload_size);
+  static bool ProbeImageSize(const uint8_t* d, size_t n, int* rows,
+                             int* cols);
+  bool NextImpl(float* data_f, uint8_t* data_u8, float* label_out,
+                int* pad_out);
 
   ImRecParams p_;
   bool ok_ = false;

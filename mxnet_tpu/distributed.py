@@ -53,16 +53,23 @@ def initialize(coordinator=None, num_processes=None, process_id=None,
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except Exception:
             pass
+    # generous join deadline: on oversubscribed hosts (the 1-core CI
+    # box runs 4 jax processes) a peer's XLA compile can stall it for
+    # minutes before it reaches the rendezvous; the default 5-minute
+    # window was the main source of coordination-service flakes
+    init_timeout = int(os.environ.get("MXNET_TPU_INIT_TIMEOUT", 600))
     if coordinator is None and num_processes is None:
         # single process (or TPU pod with full auto-detection)
         try:
-            jax.distributed.initialize()
+            jax.distributed.initialize(
+                initialization_timeout=init_timeout)
         except Exception:
             pass  # not in a managed multi-host environment
     else:
         jax.distributed.initialize(coordinator,
                                    num_processes=num_processes,
-                                   process_id=process_id)
+                                   process_id=process_id,
+                                   initialization_timeout=init_timeout)
     _initialized = True
 
 

@@ -39,6 +39,15 @@ Parameter placement (``param_placement``):
   over (weight, grad, state), so flat-row updates are bit-equivalent
   to per-name updates. Per-device parameter+optimizer HBM is
   ``P_max`` ≈ total/S for balanced cuts, instead of the total.
+  Parameters BIGGER than an average stage (``pp_shard_min_size``,
+  default auto = total/S; an LM's embedding is the canonical case)
+  do NOT set ``P_max`` for everyone: they persist ZeRO-3-style as
+  ``[S, size/S]`` chunks sharded over ``pp`` (optimizer state too),
+  are all-gathered by the step at use time, and their gradients come
+  back reduce-scattered through the all_gather's transpose — so a
+  stage-0-heavy cut keeps per-device persistent memory ≈ total/S.
+  ``partition_stages``-time imbalance of the remaining row-packed
+  params warns with per-stage byte counts (``stage_param_bytes``).
 * ``"replicated"`` — every device holds all parameters (the round-2
   form, kept for A/B): one SPMD program, non-taken switch branches
   contribute zero gradients, cross-stage psum reassembles them. Costs
@@ -221,7 +230,8 @@ class PipelineTrainer:
     def __init__(self, symbol, input_shapes, mesh, num_microbatches=None,
                  optimizer="sgd", optimizer_params=None, initializer=None,
                  seed=0, label_name="softmax_label",
-                 param_placement="stage", remat=None):
+                 param_placement="stage", remat=None,
+                 pp_shard_min_size="auto"):
         if "pp" not in mesh.shape:
             raise MXNetError("PipelineTrainer: mesh needs a 'pp' axis")
         if param_placement not in ("stage", "replicated"):
@@ -303,19 +313,59 @@ class PipelineTrainer:
                                  "%d, must be stage 0" % (n.name, s))
 
         # per-stage flat layout: stage s's params (topo order) packed
-        # into one padded row of a [S, P_max] buffer sharded over pp
-        self._flat_meta = [[] for _ in range(self.S)]
-        sizes = [0] * self.S
+        # into one padded row of a [S, P_max] buffer sharded over pp.
+        # Params BIGGER than an average stage (an LM's embedding table
+        # is the canonical case: stage 0 would set P_max for everyone)
+        # instead get ZeRO-3-style storage SHARDED over pp — each device
+        # persists 1/S of the tensor (and of its optimizer state); the
+        # owning stage all-gathers it at use time and the gradient
+        # arrives back reduce-scattered. This keeps per-device param
+        # memory near total/S for arbitrarily imbalanced cuts.
+        all_params = []
+        total = 0
         for n in symbol._topo():
             if not n.is_var or n.name not in self.param_names:
                 continue
-            s = self.stage_of[id(n)]
             shape = self.arg_shapes[n.name]
             size = int(np.prod(shape)) if shape else 1
+            all_params.append((n, shape, size))
+            total += size
+        if pp_shard_min_size == "auto":
+            # any single param above half an average stage would skew
+            # P_max; the gather cost of sharding it is marginal
+            pp_shard_min_size = max(1, total // (2 * self.S))
+        self._flat_meta = [[] for _ in range(self.S)]
+        self._big_meta = []  # (name, shape, size, padded, stage)
+        sizes = [0] * self.S
+        for n, shape, size in all_params:
+            s = self.stage_of[id(n)]
+            if (self.param_placement == "stage" and pp_shard_min_size
+                    and size > pp_shard_min_size and self.S > 1):
+                padded = -(-size // self.S) * self.S
+                self._big_meta.append((n.name, shape, size, padded, s))
+                continue
             self._flat_meta[s].append((n.name, shape, sizes[s], size))
             sizes[s] = sizes[s] + size
         self._stage_sizes = sizes
         self._pmax = max(sizes + [1])
+        #: per-stage parameter bytes (row-packed + pp-sharded), for
+        #: operators sizing a cut
+        self.stage_param_bytes = [4 * sz for sz in sizes]
+        for _, _, size, _, s in self._big_meta:
+            self.stage_param_bytes[s] += 4 * size
+        mean_sz = max(1.0, sum(sizes) / float(self.S))
+        waste_bytes = 4.0 * (self._pmax - mean_sz)  # per-device padding
+        if (self.param_placement == "stage"
+                and self._pmax / mean_sz > 1.5
+                and waste_bytes > 16384):
+            import warnings
+            warnings.warn(
+                "PipelineTrainer: row-packed stage params are imbalanced "
+                "(max %.0f vs mean %.0f elements; per-stage bytes %s): "
+                "every device pays the max row. Re-cut the stages more "
+                "evenly, or lower pp_shard_min_size so the heavy "
+                "parameters take the pp-sharded path."
+                % (self._pmax, mean_sz, self.stage_param_bytes))
 
         if isinstance(optimizer, str):
             okw = dict(optimizer_params or {})
@@ -372,15 +422,37 @@ class PipelineTrainer:
             rows = np.zeros((self.S, self._pmax), np.float32)
             for s, meta in enumerate(self._flat_meta):
                 for name, shape, off, size in meta:
-                    rows[s, off:off + size] = \
-                        self._init_value(name, arg_params).ravel()
+                    val = self._init_value(name, arg_params)
+                    if val.dtype != np.float32:
+                        # the packed rows are f32; silently downcasting
+                        # a non-f32 param would corrupt it (advisor r3)
+                        raise MXNetError(
+                            "param_placement='stage' packs f32 "
+                            "parameters; %r is %s — use "
+                            "param_placement='replicated'"
+                            % (name, val.dtype))
+                    rows[s, off:off + size] = val.ravel()
             row_sh = NamedSharding(self.mesh, P("pp"))
-            self.params = jax.device_put(rows, row_sh)
-            struct = jax.eval_shape(self._opt_init, self.params)
+            big = {}
+            for name, shape, size, padded, _s in self._big_meta:
+                val = self._init_value(name, arg_params)
+                if val.dtype != np.float32:
+                    raise MXNetError(
+                        "param_placement='stage' packs f32 parameters; "
+                        "%r is %s — use param_placement='replicated'"
+                        % (name, val.dtype))
+                flat = np.zeros((padded,), np.float32)
+                flat[:size] = val.ravel()
+                big[name] = jax.device_put(
+                    flat.reshape(self.S, padded // self.S), row_sh)
+            self.params = {"rows": jax.device_put(rows, row_sh),
+                           "big": big}
+            struct = jax.eval_shape(self._opt_init_tree, self.params)
             out_sh = jax.tree.map(lambda _: row_sh, struct)
             with self.mesh:
                 self.opt_state = jax.jit(
-                    self._opt_init, out_shardings=out_sh)(self.params)
+                    self._opt_init_tree,
+                    out_shardings=out_sh)(self.params)
             self._t = 0
             return self
         params = {}
@@ -453,11 +525,24 @@ class PipelineTrainer:
 
         return branch
 
-    def _stage_param_dict(self, s, row):
+    def _stage_param_dict(self, s, row, big_full=None):
         """Unflatten stage ``s``'s params from its flat row (static
-        slices — resolved at trace time inside the switch branch)."""
-        return {name: row[off:off + size].reshape(shape)
-                for name, shape, off, size in self._flat_meta[s]}
+        slices — resolved at trace time inside the switch branch),
+        plus any pp-sharded big params owned by this stage (already
+        all-gathered to full tensors by the caller)."""
+        out = {name: row[off:off + size].reshape(shape)
+               for name, shape, off, size in self._flat_meta[s]}
+        if big_full:
+            for name, shape, size, _padded, owner in self._big_meta:
+                if owner == s:
+                    out[name] = big_full[name][:size].reshape(shape)
+        return out
+
+    def _opt_init_tree(self, params):
+        """Optimizer state matching the staged params pytree."""
+        return {"rows": self._opt_init(params["rows"]),
+                "big": {k: self._opt_init(v)
+                        for k, v in params["big"].items()}}
 
     def _build_step(self):
         if self.param_placement == "stage":
@@ -559,11 +644,17 @@ class PipelineTrainer:
         return jax.jit(step, donate_argnums=(0, 1))
 
     def _build_step_staged(self):
-        """Per-stage placement: params/opt state are [S, P_max] rows
-        sharded over ``pp``; each device computes with — and updates —
-        only its own row. Gradients need no cross-stage psum (each row's
-        cotangent IS its stage's gradient); with dp, replicas' rows sum
-        over ``dp`` only."""
+        """Per-stage placement: row-packed params/opt state are
+        [S, P_max] rows sharded over ``pp``; each device computes with —
+        and updates — only its own row. Gradients need no cross-stage
+        psum (each row's cotangent IS its stage's gradient); with dp,
+        replicas' rows sum over ``dp`` only.
+
+        pp-sharded BIG params (``_big_meta``): persisted as
+        [S, size/S] chunks (each device holds 1/S of the tensor and of
+        its optimizer state), all-gathered over ``pp`` at use time; the
+        all_gather's transpose delivers the gradient back
+        reduce-scattered, so the chunk update is purely local."""
         S, M = self.S, self.M
         perm = [(i, (i + 1) % S) for i in range(S)]
         data_names = [k for k in self.input_shapes
@@ -571,9 +662,13 @@ class PipelineTrainer:
         has_dp = "dp" in self.mesh.shape
         batch_spec = P(None, "dp") if has_dp else P()
         row_spec = P("pp")
-        opt_struct = jax.eval_shape(
-            self._opt_init,
-            jax.ShapeDtypeStruct((S, self._pmax), jnp.float32))
+        param_struct = {
+            "rows": jax.ShapeDtypeStruct((S, self._pmax), jnp.float32),
+            "big": {name: jax.ShapeDtypeStruct((S, padded // S),
+                                               jnp.float32)
+                    for name, _sh, _sz, padded, _s in self._big_meta}}
+        param_specs = jax.tree.map(lambda _: row_spec, param_struct)
+        opt_struct = jax.eval_shape(self._opt_init_tree, param_struct)
         opt_specs = jax.tree.map(lambda _: row_spec, opt_struct)
 
         def local_step(params, opt_state, data_mb, label_mb, lr, t_opt,
@@ -585,11 +680,19 @@ class PipelineTrainer:
             opt_rng = jax.random.fold_in(rng, idx)
             if has_dp:
                 rng = jax.random.fold_in(rng, lax.axis_index("dp"))
-            row = params[0]  # local view of the pp-sharded [S, Pmax]
+            row = params["rows"][0]  # local pp-shard of [S, Pmax]
+            big_local = {k: v[0] for k, v in params["big"].items()}
 
-            def fwd(r):
+            def fwd(r, bl):
+                # gather each pp-sharded big param to its full flat
+                # value; only the owning stage's branch consumes it,
+                # and the transpose (psum_scatter) hands back exactly
+                # this device's chunk gradient
+                big_full = {k: lax.all_gather(v, "pp", tiled=True)
+                            for k, v in bl.items()}
                 branches = [self._make_branch(
-                    s, data_mb, label_mb, self._stage_param_dict(s, r),
+                    s, data_mb, label_mb,
+                    self._stage_param_dict(s, r, big_full),
                     rng, True) for s in range(S)]
                 if self.remat:
                     # prevent_cse=False: inside lax.scan the CSE hazard
@@ -621,22 +724,34 @@ class PipelineTrainer:
                                         jnp.arange(M + S - 1))
                 return tuple(lax.psum(o, "pp") for o in outs)
 
-            out, vjp_fn = jax.vjp(fwd, row)
-            (g,) = vjp_fn(tuple(jnp.ones_like(o) for o in out))
+            out, vjp_fn = jax.vjp(fwd, row, big_local)
+            g_row, g_big = vjp_fn(tuple(jnp.ones_like(o) for o in out))
             if has_dp:
-                g = lax.psum(g, "dp")
+                g_row = lax.psum(g_row, "dp")
+                g_big = jax.tree.map(lambda g: lax.psum(g, "dp"), g_big)
             local_opt = jax.tree.map(lambda a: a[0], opt_state)
-            new_row, new_opt = self._opt_update(row, g, local_opt, lr,
-                                                t_opt, opt_rng)
-            return (new_row[None],
-                    jax.tree.map(lambda a: a[None], new_opt), out)
+            new_row, new_opt_rows = self._opt_update(
+                row, g_row, local_opt["rows"], lr, t_opt, opt_rng)
+            new_big, new_opt_big = {}, {}
+            for ki, k in enumerate(sorted(big_local)):
+                # stable per-param stream: fold by sorted index, NOT
+                # hash(str) (PYTHONHASHSEED varies across processes)
+                new_big[k], new_opt_big[k] = self._opt_update(
+                    big_local[k], g_big[k], local_opt["big"][k], lr,
+                    t_opt, jax.random.fold_in(opt_rng, 1 + ki))
+            lift = lambda t: jax.tree.map(lambda a: a[None], t)
+            return ({"rows": new_row[None],
+                     "big": {k: v[None] for k, v in new_big.items()}},
+                    {"rows": lift(new_opt_rows),
+                     "big": {k: lift(v) for k, v in new_opt_big.items()}},
+                    out)
 
         mapped = shard_map(
             local_step, mesh=self.mesh,
-            in_specs=(row_spec, opt_specs,
+            in_specs=(param_specs, opt_specs,
                       {k: batch_spec for k in data_names}, batch_spec,
                       P(), P(), P()),
-            out_specs=(row_spec, opt_specs,
+            out_specs=(param_specs, opt_specs,
                        tuple(batch_spec for _ in self.out_shapes)),
             check_vma=False)
 
@@ -679,18 +794,24 @@ class PipelineTrainer:
 
     def get_params(self):
         if self.param_placement == "stage":
-            rows = self.params
+            tree = self.params
             if jax.process_count() > 1:
                 with self.mesh:
-                    rows = jax.jit(lambda x: x,
-                                   out_shardings=NamedSharding(
-                                       self.mesh, P()))(rows)
-            rows = np.asarray(jax.device_get(rows))
+                    tree = jax.jit(
+                        lambda x: x,
+                        out_shardings=jax.tree.map(
+                            lambda _: NamedSharding(self.mesh, P()),
+                            tree))(tree)
+            rows = np.asarray(jax.device_get(tree["rows"]))
             out = {}
             for s, meta in enumerate(self._flat_meta):
                 for name, shape, off, size in meta:
                     out[name] = nd.array(
                         rows[s, off:off + size].reshape(shape))
+            for name, shape, size, _padded, _s in self._big_meta:
+                flat = np.asarray(
+                    jax.device_get(tree["big"][name])).ravel()
+                out[name] = nd.array(flat[:size].reshape(shape))
             return out
         return {n: nd.array(np.asarray(jax.device_get(v)))
                 for n, v in self.params.items()}

@@ -1370,3 +1370,117 @@ def test_moe_top_k_tie_breaking():
                 h = np.maximum(w1[xi] @ x[b, t] + b1[xi], 0)
                 ref[b, t] += (w2[xi] @ h + b2[xi]) / K
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_pp_sharded_big_params():
+    """A stage-0-heavy cut (big embedding): params larger than an
+    average stage persist as pp-SHARDED chunks (ZeRO-3 in the pipe), so
+    per-device memory stays ~total/S instead of paying stage 0's row
+    everywhere (VERDICT r3 #7). Exact-value vs replicated, and the
+    padding-imbalance warning fires when the sharded path is disabled."""
+    import warnings as _warnings
+    from mxnet_tpu.models import get_transformer_lm
+
+    vocab, B, T, E = 257, 8, 12, 16  # embedding 257*16 dominates
+    rng = np.random.RandomState(1)
+    data = rng.randint(0, vocab, (B, T)).astype(np.float32)
+    label = rng.randint(0, vocab, (B, T)).astype(np.float32)
+    shapes = {"data": (B, T), "softmax_label": (B, T)}
+    sym = get_transformer_lm(vocab, num_layers=2, embed_dim=E,
+                             num_heads=2, impl="dense",
+                             pipeline_stages=2)
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    prng = np.random.RandomState(3)
+    init = {n: mx.nd.array(prng.uniform(-0.1, 0.1, s).astype("f"))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in shapes}
+    mesh = par.build_mesh({"pp": 2})
+
+    def run(placement, **kw):
+        pp = par.PipelineTrainer(
+            sym, shapes, mesh, num_microbatches=4,
+            optimizer="sgd", param_placement=placement,
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9,
+                              "rescale_grad": 1.0 / B}, **kw)
+        pp.init_params({k: v.copy() for k, v in init.items()})
+        for _ in range(2):
+            pp.step({"data": data, "softmax_label": label})
+        return pp
+
+    pp_s = run("stage")
+    # the heavy params actually took the sharded path
+    assert pp_s._big_meta, "expected pp-sharded big params"
+    big_names = {m[0] for m in pp_s._big_meta}
+    assert any("embed" in n or "weight" in n for n in big_names)
+    # exact-value oracle vs replicated
+    pp_r = run("replicated")
+    got_s, got_r = pp_s.get_params(), pp_r.get_params()
+    assert set(got_s) == set(got_r)
+    for n in got_s:
+        np.testing.assert_allclose(got_s[n].asnumpy(),
+                                   got_r[n].asnumpy(),
+                                   rtol=2e-5, atol=2e-6, err_msg=n)
+    # padded path (sharding disabled) must still be numerically correct
+    pp_pad = run("stage", pp_shard_min_size=None)
+    assert not pp_pad._big_meta
+    got_p = pp_pad.get_params()
+    for n in got_s:
+        np.testing.assert_allclose(got_p[n].asnumpy(),
+                                   got_r[n].asnumpy(),
+                                   rtol=2e-5, atol=2e-6, err_msg=n)
+    # per-stage byte report exists and covers all params
+    assert len(pp_s.stage_param_bytes) == 2
+    assert sum(pp_s.stage_param_bytes) >= 4 * (vocab * E)
+
+
+def _imbalanced_fc_sym():
+    from mxnet_tpu.symbol import AttrScope
+
+    data = mx.symbol.Variable("data")
+    with AttrScope(ctx_group="stage0"):
+        big = mx.symbol.FullyConnected(data=data, name="bigfc",
+                                       num_hidden=512)
+        a = mx.symbol.Activation(data=big, act_type="relu", name="a0")
+    with AttrScope(ctx_group="stage1"):
+        small = mx.symbol.FullyConnected(data=a, name="smallfc",
+                                         num_hidden=4)
+        return mx.symbol.SoftmaxOutput(data=small, name="softmax")
+
+
+def test_pipeline_imbalanced_memory_and_warning():
+    """A stage-0-heavy cut: with pp-sharding (default) per-device
+    persistent bytes drop well below the padded [S, P_max] cost that
+    charges stage 0's row to every device (VERDICT r3 #7); with the
+    sharded path disabled, construction warns with per-stage byte
+    counts."""
+    import warnings as _warnings
+
+    sym = _imbalanced_fc_sym()
+    shapes = {"data": (8, 32), "softmax_label": (8,)}
+    mesh = par.build_mesh({"pp": 2})
+    rng = np.random.RandomState(0)
+    batch = {"data": rng.randn(8, 32).astype(np.float32),
+             "softmax_label": rng.randint(0, 4, (8,)).astype(np.float32)}
+
+    def run(**kw):
+        with _warnings.catch_warnings(record=True) as rec:
+            _warnings.simplefilter("always")
+            pp = par.PipelineTrainer(
+                sym, shapes, mesh, num_microbatches=4,
+                optimizer="sgd", param_placement="stage",
+                optimizer_params={"learning_rate": 0.1}, **kw)
+            msgs = [str(w.message) for w in rec]
+        pp.init_params()
+        pp.step(batch)
+        return pp, msgs
+
+    pp_s, msgs_s = run()
+    assert pp_s._big_meta, "bigfc_weight should take the sharded path"
+    assert not any("imbalanced" in m for m in msgs_s), msgs_s
+    pp_pad, msgs_p = run(pp_shard_min_size=None)
+    assert any("imbalanced" in m for m in msgs_p), msgs_p
+    assert any("per-stage bytes" in m for m in msgs_p), msgs_p
+    bytes_sharded = _per_device_param_bytes(pp_s)
+    bytes_padded = _per_device_param_bytes(pp_pad)
+    assert bytes_sharded < 0.7 * bytes_padded, (bytes_sharded,
+                                                bytes_padded)
