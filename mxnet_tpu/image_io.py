@@ -179,29 +179,35 @@ class ImageRecordIter(DataIter):
         else:
             self._py.reset()
 
+    def _native_next(self):
+        """One native-iterator step into the reused buffers; returns
+        (has_batch, pad). Shared by iter_next and iter_numpy."""
+        has = ctypes.c_int()
+        pad = ctypes.c_int()
+        if self._device_augment:
+            check_call(self._lib.MXTImRecIterNextU8(
+                self.handle,
+                self._buf_data.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint8)),
+                self._buf_label.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_float)),
+                ctypes.byref(pad), ctypes.byref(has)))
+        else:
+            check_call(self._lib.MXTImRecIterNext(
+                self.handle,
+                self._buf_data.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_float)),
+                self._buf_label.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_float)),
+                ctypes.byref(pad), ctypes.byref(has)))
+        return bool(has.value), pad.value
+
     def iter_next(self):
         if self._lib is not None:
-            has = ctypes.c_int()
-            pad = ctypes.c_int()
-            if self._device_augment:
-                check_call(self._lib.MXTImRecIterNextU8(
-                    self.handle,
-                    self._buf_data.ctypes.data_as(
-                        ctypes.POINTER(ctypes.c_uint8)),
-                    self._buf_label.ctypes.data_as(
-                        ctypes.POINTER(ctypes.c_float)),
-                    ctypes.byref(pad), ctypes.byref(has)))
-            else:
-                check_call(self._lib.MXTImRecIterNext(
-                    self.handle,
-                    self._buf_data.ctypes.data_as(
-                        ctypes.POINTER(ctypes.c_float)),
-                    self._buf_label.ctypes.data_as(
-                        ctypes.POINTER(ctypes.c_float)),
-                    ctypes.byref(pad), ctypes.byref(has)))
-            if not has.value:
+            has, pad = self._native_next()
+            if not has:
                 return False
-            self._pad = pad.value
+            self._pad = pad
             data, label = self._buf_data, self._buf_label
         else:
             got = self._py.next()
@@ -226,28 +232,11 @@ class ImageRecordIter(DataIter):
                 if got is None:
                     return
                 yield got
-        has = ctypes.c_int()
-        pad = ctypes.c_int()
         while True:
-            if self._device_augment:
-                check_call(self._lib.MXTImRecIterNextU8(
-                    self.handle,
-                    self._buf_data.ctypes.data_as(
-                        ctypes.POINTER(ctypes.c_uint8)),
-                    self._buf_label.ctypes.data_as(
-                        ctypes.POINTER(ctypes.c_float)),
-                    ctypes.byref(pad), ctypes.byref(has)))
-            else:
-                check_call(self._lib.MXTImRecIterNext(
-                    self.handle,
-                    self._buf_data.ctypes.data_as(
-                        ctypes.POINTER(ctypes.c_float)),
-                    self._buf_label.ctypes.data_as(
-                        ctypes.POINTER(ctypes.c_float)),
-                    ctypes.byref(pad), ctypes.byref(has)))
-            if not has.value:
+            has, pad = self._native_next()
+            if not has:
                 return
-            yield self._buf_data, self._buf_label, pad.value
+            yield self._buf_data, self._buf_label, pad
 
     def getdata(self):
         return [self._data]
@@ -392,22 +381,51 @@ class _PyEngine:
             label[0] = lab
         return label
 
+    @staticmethod
+    def _probe_size(blob):
+        """(rows, cols) from JPEG SOF / PNG IHDR header bytes (the
+        Python port of cpp/image_iter.cc ProbeImageSize) — no decode."""
+        d = blob
+        n = len(d)
+        if n >= 24 and d[:4] == b"\x89PNG":
+            cols = int.from_bytes(d[16:20], "big")
+            rows = int.from_bytes(d[20:24], "big")
+            return (rows, cols) if rows and cols else None
+        if n < 4 or d[0] != 0xFF or d[1] != 0xD8:
+            return None
+        i = 2
+        while i + 9 < n:
+            if d[i] != 0xFF:
+                return None
+            marker = d[i + 1]
+            if marker == 0xD8 or 0xD0 <= marker <= 0xD9:
+                i += 2
+                continue
+            seg = (d[i + 2] << 8) | d[i + 3]
+            if (0xC0 <= marker <= 0xCF and marker not in (0xC4, 0xC8,
+                                                          0xCC)):
+                rows = (d[i + 5] << 8) | d[i + 6]
+                cols = (d[i + 7] << 8) | d[i + 8]
+                return (rows, cols) if rows and cols else None
+            i += 2 + seg
+        return None
+
     def _decode(self, raw):
         """Header + pixels; JPEG/PNG decode picks the reduced-DCT scale
         (IMREAD_REDUCED_*) exactly like the native engine when the
-        resize/crop target permits (a cheap 1/8 probe decode infers the
-        source size)."""
+        resize/crop target permits (byte-level header probe, no extra
+        decode)."""
         import cv2
 
         iscolor = 1 if self.data_shape[0] == 3 else 0
         header, blob = rec.unpack(raw)
         if blob[:4] == rec._RAW_MAGIC or not self.scaled_decode:
             return rec.unpack_img(raw, iscolor)
-        buf = np.frombuffer(blob, np.uint8)
-        probe = cv2.imdecode(buf, cv2.IMREAD_REDUCED_GRAYSCALE_8)
-        if probe is None:
+        probed = self._probe_size(blob)
+        if probed is None:
             return rec.unpack_img(raw, iscolor)
-        rows, cols = probe.shape[0] * 8, probe.shape[1] * 8
+        rows, cols = probed
+        buf = np.frombuffer(blob, np.uint8)
         c, h, w = self.data_shape
         need = self.resize if self.resize > 0 else max(h, w)
         flags = {8: cv2.IMREAD_REDUCED_COLOR_8,

@@ -366,8 +366,28 @@ class PSBackend:
             self._request(s, ("set_optimizer", pickled))
 
     def close(self):
-        """Close client connections and the server's listening socket
-        (unblocks a later dist_async store binding the same port)."""
+        """Finalize the parameter-server backend (reference ps-lite
+        Postoffice::Finalize semantics): BARRIER FIRST, then close
+        sockets. The barrier must come before ANY server shard goes
+        away — a worker that finishes early and tears down its server
+        while a slow peer is still pulling kills that peer with a
+        connection reset (observed as the 1-core 4-worker flake: ranks
+        1-3 GC'd their kvstore while rank 0 was mid-pull on the key
+        range rank 2's server owned). Idempotent: only the first close
+        barriers and closes, so a second close can never deadlock
+        waiting for peers that already left."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        try:
+            from jax.experimental import multihost_utils
+            # If a peer DIED before reaching this barrier, the jax
+            # coordination service detects the missing heartbeat and
+            # aborts the collective (it does not hang forever) — the
+            # same unhappy-path contract as ps-lite's Finalize barrier.
+            multihost_utils.sync_global_devices("kvstore_ps_close")
+        except Exception:
+            pass  # interpreter teardown / single process: best effort
         with self._lock:
             for c in self._conns.values():
                 try:

@@ -71,7 +71,7 @@ def moe_transformer_block(data, num_heads, hidden, embed_dim, num_experts,
 def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
                        ffn_hidden=None, seq_len=None, impl="flash",
                        dropout=0.0, num_experts=0, pipeline_stages=None,
-                       moe_top_k=0):
+                       moe_top_k=0, loss_layout="reference"):
     """Decoder-only LM: Embedding -> N blocks -> tied-free FC -> softmax
     over vocab per position (multi_output SoftmaxOutput, the reference's
     per-position softmax mode, softmax_output-inl.h multi_output).
@@ -81,6 +81,13 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
     graph_executor.cc:341-458): embedding with the first block group,
     final LN + head + loss with the last; blocks spread evenly. The
     tagged symbol drives ``parallel.PipelineTrainer``.
+
+    ``loss_layout``: "reference" (default) swaps the [B,T,V] logits to
+    [B,V,T] and uses the reference's multi_output per-position softmax
+    (output [B,V,T]). "flat" reshapes to [B*T,V] and applies the plain
+    softmax along the LAST (lane-aligned) axis — identical loss and
+    gradients without transposing the vocab-sized logits tensor
+    (output [B*T,V]).
     """
     from ..attribute import AttrScope
 
@@ -122,6 +129,14 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
                              beta=sym.Variable("lnf_beta"), name="lnf")
         logits = sym.FullyConnected(data=ln_f, num_hidden=vocab_size,
                                     name="lm_head", flatten=False)
+        if loss_layout == "flat":
+            flat = sym.Reshape(data=logits, shape=(-1, vocab_size),
+                               name="logits_flat")
+            flat_label = sym.Reshape(
+                data=sym.Variable("softmax_label"), shape=(-1,),
+                name="label_flat")
+            return sym.SoftmaxOutput(data=flat, label=flat_label,
+                                     name="softmax")
         # per-position softmax: label [B, T]
         logits_t = sym.SwapAxis(data=logits, dim1=1, dim2=2,
                                 name="logits_t")

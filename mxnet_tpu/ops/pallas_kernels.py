@@ -286,13 +286,25 @@ def _flash_core_bwd(causal, scale, block_q, block_k, interpret, true_tq,
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
-def flash_attention(q, k, v, *, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None):
+def flash_attention(q, k, v, *, causal=False, scale=None, block_q=None,
+                    block_k=None, interpret=None):
     """Fused attention. q,k,v: [B, T, H, D]; returns [B, T, H, D].
 
     Pads T to block multiples internally (padded keys masked out, padded
     queries dropped). Use inside jit; differentiable.
+
+    Block sizes default from MXNET_FLASH_BLOCK_Q/K, else 512 for
+    head_dim <= 128 and 128 above (bigger tiles amortize the streaming
+    loop: measured -33% on the 124M-LM train step vs the round-3
+    128-blocks, doc/performance.md; large head_dims overflow VMEM at
+    512).
     """
+    import os
+    d_default = 512 if q.shape[-1] <= 128 else 128
+    if block_q is None:
+        block_q = int(os.environ.get("MXNET_FLASH_BLOCK_Q", d_default))
+    if block_k is None:
+        block_k = int(os.environ.get("MXNET_FLASH_BLOCK_K", d_default))
     if interpret is None:
         interpret = _use_interpret()
     b, tq, h, d = q.shape

@@ -129,33 +129,55 @@ class ElementWiseSum(OpSpec):
 
 @register
 class Reshape(OpSpec):
-    """View change (``reshape-inl.h``). target_shape excludes batch dim 0
-    in the 2015 interface."""
+    """View change (``reshape-inl.h``). ``target_shape`` excludes batch
+    dim 0 in the 2015 interface; ``shape`` (the successor mxnet API)
+    reshapes the WHOLE tensor, batch dim included, with one ``-1``
+    inferred — needed e.g. to merge [B,T,V] logits into [B*T,V]."""
 
     name = "Reshape"
-    params = {"target_shape": Param("shape")}
+    params = {"target_shape": Param("shape", ()),
+              "shape": Param("shape", ())}
+
+    @staticmethod
+    def _full_target(p, d):
+        """Resolve the output shape given input shape ``d``."""
+        if p["shape"]:
+            tgt = tuple(int(t) for t in p["shape"])
+            if tgt.count(-1) > 1:
+                raise MXNetError("Reshape: more than one -1 in shape")
+            # 0 copies the input dim at that position (mxnet semantics:
+            # shape=(0,-1) is the canonical flatten)
+            tgt = tuple(d[i] if t == 0 and i < len(d) else t
+                        for i, t in enumerate(tgt))
+            if 0 in tgt:
+                raise MXNetError("Reshape: 0 dim beyond input rank")
+            total = int(np.prod(d))
+            if -1 in tgt:
+                known = int(np.prod([t for t in tgt if t != -1]))
+                tgt = tuple(total // max(known, 1) if t == -1 else t
+                            for t in tgt)
+            return tgt
+        tgt = (d[0],) + tuple(p["target_shape"])
+        # one dim may be 0 = inferred (2015 semantics)
+        if 0 in tgt[1:]:
+            known = int(np.prod([x for x in tgt[1:] if x != 0])) * tgt[0]
+            total = int(np.prod(d))
+            tgt = tuple(total // max(known, 1) if x == 0 else x
+                        for x in tgt)
+        return tgt
 
     def infer_shape(self, p, in_shapes):
         d = in_shapes[0]
         if d is None:
             return [None], [None], []
-        tgt = (d[0],) + tuple(p["target_shape"])
-        # one dim may be 0 = inferred
-        if 0 in tgt[1:]:
-            known = int(np.prod([x for x in tgt[1:] if x != 0])) * tgt[0]
-            total = int(np.prod(d))
-            tgt = tuple(total // max(known, 1) if x == 0 else x for x in tgt)
+        tgt = self._full_target(p, d)
         if int(np.prod(tgt)) != int(np.prod(d)):
             raise MXNetError("Reshape: size mismatch %s -> %s" % (d, tgt))
         return [d], [tgt], []
 
     def forward(self, p, ins, aux, is_train, rng):
         x = ins[0]
-        tgt = (x.shape[0],) + tuple(p["target_shape"])
-        if 0 in tgt[1:]:
-            known = int(np.prod([t for t in tgt[1:] if t != 0])) * tgt[0]
-            tgt = tuple(x.size // max(known, 1) if t == 0 else t for t in tgt)
-        return [x.reshape(tgt)], []
+        return [x.reshape(self._full_target(p, x.shape))], []
 
 
 @register

@@ -24,6 +24,8 @@ def _launch(n, local_devices, checks=None, timeout=900):
     env.pop("XLA_FLAGS", None)
     if checks:
         env["MXNET_DISTTEST_CHECKS"] = ",".join(checks)
+    else:
+        env.pop("MXNET_DISTTEST_CHECKS", None)  # stale shell values
     # persistent XLA compile cache SHARED by all workers (and across
     # runs/retries): on the 1-core host, N simultaneous XLA compiles of
     # the same tiny programs were the main starvation source

@@ -205,3 +205,72 @@ def test_resnet_s2d_input_stem_matches_host_transform():
     dealt = stem_out(get_resnet(num_classes=10, stem="s2d_input"),
                      space_to_depth_batch(x))
     np.testing.assert_allclose(dealt, ingraph, rtol=1e-6, atol=1e-6)
+
+
+def test_transformer_lm_flat_loss_layout_equivalent():
+    """loss_layout='flat' (reshape to [B*T,V], lane-aligned softmax, no
+    vocab-sized transpose) must produce IDENTICAL gradients to the
+    reference multi_output layout."""
+    from mxnet_tpu.models import get_transformer_lm
+
+    rng = np.random.RandomState(0)
+    B, T, V, E = 4, 8, 17, 16
+    data = rng.randint(0, V, (B, T)).astype(np.float32)
+    label = rng.randint(0, V, (B, T)).astype(np.float32)
+
+    def grads(layout):
+        sym = get_transformer_lm(V, num_layers=1, embed_dim=E,
+                                 num_heads=2, impl="dense",
+                                 loss_layout=layout)
+        shapes = {"data": (B, T), "softmax_label": (B, T)}
+        arg_shapes, _, _ = sym.infer_shape(**shapes)
+        prng = np.random.RandomState(5)
+        args, gbufs = {}, {}
+        for n, s in zip(sym.list_arguments(), arg_shapes):
+            if n == "data":
+                args[n] = mx.nd.array(data)
+            elif n == "softmax_label":
+                args[n] = mx.nd.array(label)
+            else:
+                args[n] = mx.nd.array(
+                    prng.uniform(-0.1, 0.1, s).astype("f"))
+                gbufs[n] = mx.nd.zeros(s)
+        exe = sym.bind(mx.cpu(), args, args_grad=gbufs)
+        exe.forward(is_train=True)
+        out = exe.outputs[0].asnumpy()
+        exe.backward()
+        return out, {n: g.asnumpy() for n, g in gbufs.items()}
+
+    out_r, g_ref = grads("reference")
+    out_f, g_flat = grads("flat")
+    assert out_r.shape == (B, V, T)
+    assert out_f.shape == (B * T, V)
+    # same probabilities, different layout
+    np.testing.assert_allclose(
+        out_f.reshape(B, T, V).transpose(0, 2, 1), out_r,
+        rtol=1e-5, atol=1e-7)
+    assert set(g_ref) == set(g_flat)
+    for n in g_ref:
+        np.testing.assert_allclose(g_flat[n], g_ref[n], rtol=1e-5,
+                                   atol=1e-7, err_msg=n)
+
+
+def test_reshape_full_shape_param():
+    """Reshape's successor-API ``shape`` param: whole-tensor reshape,
+    batch dim included, with one -1 inferred — plus gradient."""
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    s = mx.symbol.Reshape(mx.symbol.Variable("data"), shape=(-1, 4),
+                          name="rs")
+    exe = s.bind(mx.cpu(), {"data": mx.nd.array(x)},
+                 args_grad={"data": mx.nd.zeros(x.shape)})
+    exe.forward(is_train=True)
+    np.testing.assert_array_equal(exe.outputs[0].asnumpy(),
+                                  x.reshape(6, 4))
+    g = np.arange(24, dtype=np.float32).reshape(6, 4)
+    exe.backward([mx.nd.array(g)])
+    np.testing.assert_array_equal(exe.grad_dict["data"].asnumpy(),
+                                  g.reshape(2, 3, 4))
+    # shape inference errors on double -1
+    with pytest.raises(mx.base.MXNetError, match="-1"):
+        mx.symbol.Reshape(mx.symbol.Variable("d2"), shape=(-1, -1),
+                          name="bad").infer_shape(d2=(2, 3, 4))

@@ -49,13 +49,14 @@ def make_rec(tmpd, n, img_fmt, hw=(360, 480), quality=85):
 
 
 def run_iter(path, n_images, batch=128, shape=(3, 224, 224), resize=256,
-             device_augment=False, scaled_decode=True, threads=2):
+             device_augment=False, scaled_decode=True, threads=2,
+             center=False):
     import mxnet_tpu as mx
 
     it = mx.ImageRecordIter(
         path_imgrec=path, data_shape=shape, batch_size=batch,
-        resize=resize, rand_crop=not device_augment,
-        rand_mirror=not device_augment, shuffle=False,
+        resize=resize, rand_crop=not device_augment and not center,
+        rand_mirror=not device_augment and not center, shuffle=False,
         preprocess_threads=threads, device_augment=device_augment,
         scaled_decode=scaled_decode)
     # iter_numpy: the host fast path (trainer.prefetch consumes numpy);
@@ -96,6 +97,13 @@ def main():
         out["raw"] = run_iter(raw, n)
         out["u8_device"] = run_iter(raw, n, shape=(3, 256, 256),
                                     device_augment=True)
+        # same-geometry pair for the stage breakdown: float center-crop
+        # 224 vs uint8 center-crop 224 isolates the host float
+        # augment+normalize pass (u8_device above uses the production
+        # 256 storage shape, which would conflate crop/byte deltas)
+        out["raw_center224"] = run_iter(raw, n, center=True)
+        out["u8_center224"] = run_iter(raw, n, shape=(3, 224, 224),
+                                       device_augment=True)
         # big sources are where reduced-DCT decode actually triggers
         # (720p: shorter 720 -> 1/2 scale still covers resize=256)
         big = make_rec(tmpd, n // 2, ".jpg", hw=(720, 960), quality=85)
@@ -103,16 +111,20 @@ def main():
             os.sync()
         out["jpeg_big_full"] = run_iter(big, n // 2, scaled_decode=False)
         out["jpeg_big_scaled"] = run_iter(big, n // 2, scaled_decode=True)
-    # per-stage ms/img, derived from mode differences:
-    #   decode      = jpeg_full - raw        (JPEG decode + downscale)
-    #   augment+norm= raw - u8_device        (crop/mirror rng + float pass)
-    #   collate     = everything left in u8_device (memcpy, batching, IO)
+    # per-stage ms/img, derived from SAME-GEOMETRY mode differences:
+    #   decode      = jpeg_full - raw          (both 224 float rand-crop)
+    #   augment+norm= raw_center224 - u8_center224  (same 224 center
+    #                 crop; only the float normalize pass + 4x output
+    #                 bytes differ)
+    #   collate     = everything left in u8_center224 (record IO,
+    #                 resize, memcpy, batching)
     ms = {k: 1000.0 / v for k, v in out.items()}
     out["stage_ms"] = {
         "decode_full": round(ms["jpeg_full"] - ms["raw"], 3),
         "decode_scaled": round(ms["jpeg_scaled"] - ms["raw"], 3),
-        "augment_normalize": round(ms["raw"] - ms["u8_device"], 3),
-        "collate_io": round(ms["u8_device"], 3),
+        "augment_normalize": round(ms["raw_center224"]
+                                   - ms["u8_center224"], 3),
+        "collate_io": round(ms["u8_center224"], 3),
     }
     print(json.dumps(out))
 

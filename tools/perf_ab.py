@@ -25,10 +25,16 @@ def main():
         shapes = {"data": (batch, 3, 224, 224), "softmax_label": (batch,)}
         n_classes, int_data = 1000, False
     elif model == "transformer_lm":
+        import os
         from mxnet_tpu.models import get_transformer_lm
+        heads = int(os.environ.get("AB_HEADS", 12))
+        impl = os.environ.get("AB_IMPL", "flash")
+        layout = os.environ.get("AB_LOSS_LAYOUT", "reference")
+        seq = int(os.environ.get("AB_SEQ", 1024))
         sym = get_transformer_lm(32000, num_layers=12, embed_dim=768,
-                                 num_heads=12, impl="flash")
-        shapes = {"data": (batch, 1024), "softmax_label": (batch, 1024)}
+                                 num_heads=heads, impl=impl,
+                                 loss_layout=layout)
+        shapes = {"data": (batch, seq), "softmax_label": (batch, seq)}
         n_classes, int_data = 32000, True
     else:
         raise SystemExit("unknown model " + model)
