@@ -91,6 +91,9 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
     """
     from ..attribute import AttrScope
 
+    if loss_layout not in ("reference", "flat"):
+        raise ValueError("loss_layout must be 'reference' or 'flat', "
+                         "got %r" % (loss_layout,))
     if ffn_hidden is None:
         ffn_hidden = 4 * embed_dim
 

@@ -568,6 +568,13 @@ class Pooling(OpSpec):
         # NB: init values must be concrete (np) scalars — a traced jnp scalar
         # stops JAX pattern-matching the monoid, losing the autodiff rule.
         if p["pool_type"] == "max":
+            # NB a closed-form mshadow-style backward (dx = sum over
+            # offsets of (x==out_up)*g_up) was built and REJECTED in
+            # round 4: the python-loop form blew HBM (9 simultaneous
+            # x-sized slices, 17.8G) and the lax.scan form ran the
+            # ResNet-50 step 2.3x SLOWER (lane-misaligned dynamic
+            # slices + broken fusion). XLA's SelectAndScatter autodiff
+            # rule stays (doc/performance.md round-4 notes).
             init = -np.inf if jnp.issubdtype(x.dtype, jnp.floating) \
                 else np.iinfo(np.dtype(x.dtype)).min
             out = lax.reduce_window(x, np.array(init, x.dtype), lax.max,

@@ -42,6 +42,17 @@ def _round_up(x, m):
     return (x + m - 1) // m * m
 
 
+def default_attn_blocks(head_dim):
+    """(block_q, block_k) default for the flash/ring kernels: 512
+    tiles measured -33% on the 124M-LM step for head_dim <= 128
+    (doc/performance.md round 4); large head dims overflow VMEM at 512.
+    MXNET_FLASH_BLOCK_Q/K override."""
+    import os
+    d = 512 if head_dim <= 128 else 128
+    return (int(os.environ.get("MXNET_FLASH_BLOCK_Q", d)),
+            int(os.environ.get("MXNET_FLASH_BLOCK_K", d)))
+
+
 # ---------------------------------------------------------------------------
 # flash attention
 
@@ -293,18 +304,16 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=None,
     Pads T to block multiples internally (padded keys masked out, padded
     queries dropped). Use inside jit; differentiable.
 
-    Block sizes default from MXNET_FLASH_BLOCK_Q/K, else 512 for
-    head_dim <= 128 and 128 above (bigger tiles amortize the streaming
-    loop: measured -33% on the 124M-LM train step vs the round-3
-    128-blocks, doc/performance.md; large head_dims overflow VMEM at
-    512).
+    Block sizes default from ``default_attn_blocks`` (512 for
+    head_dim <= 128: bigger tiles amortize the streaming loop, measured
+    -33% on the 124M-LM train step vs the round-3 128-blocks,
+    doc/performance.md; large head_dims overflow VMEM at 512).
     """
-    import os
-    d_default = 512 if q.shape[-1] <= 128 else 128
+    dq, dk = default_attn_blocks(q.shape[-1])
     if block_q is None:
-        block_q = int(os.environ.get("MXNET_FLASH_BLOCK_Q", d_default))
+        block_q = dq
     if block_k is None:
-        block_k = int(os.environ.get("MXNET_FLASH_BLOCK_K", d_default))
+        block_k = dk
     if interpret is None:
         interpret = _use_interpret()
     b, tq, h, d = q.shape

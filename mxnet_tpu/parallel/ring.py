@@ -216,7 +216,7 @@ def _striped_ring_local(q, k, v, *, axis_name, scale, block_q, block_k):
 
 
 def striped_ring_attention(q, k, v, mesh, *, axis_name="sp", scale=None,
-                           batch_axis=None, block_q=128, block_k=128):
+                           batch_axis=None, block_q=None, block_k=None):
     """Causal ring attention with the STRIPED token layout (striped
     attention): balanced per-hop FLOPs via the half-block Pallas pair
     kernel — see the module docstring for the balance math.
@@ -232,6 +232,14 @@ def striped_ring_attention(q, k, v, mesh, *, axis_name="sp", scale=None,
         raise ValueError("striped ring: T=%d not divisible by ring "
                          "size %d" % (T, n))
     C = T // n
+    # same block heuristic as flash_attention (shared helper); the
+    # pair kernel clamps to the local chunk length
+    from ..ops.pallas_kernels import default_attn_blocks
+    dq, dk = default_attn_blocks(D)
+    if block_q is None:
+        block_q = dq
+    if block_k is None:
+        block_k = dk
 
     def stripe(x):
         # natural [B, T] -> striped [B, T']: chunk j holds {a*n + j}
