@@ -142,6 +142,57 @@ int MXTPredCreate(const char *symbol_json_str, const void *param_bytes,
   return 0;
 }
 
+int MXTPredCreatePartialOut(const char *symbol_json_str,
+                            const void *param_bytes, int param_size,
+                            int dev_type, int dev_id,
+                            mx_uint num_input_nodes,
+                            const char **input_keys,
+                            const mx_uint *input_shape_indptr,
+                            const mx_uint *input_shape_data,
+                            mx_uint num_output_nodes,
+                            const char **output_keys,
+                            PredictorHandle *out) {
+  EnsureRuntime();
+  Gil gil;
+  if (!EnsureModule()) return -1;
+  PyObject *names = PyList_New(num_input_nodes);
+  PyObject *shapes = PyList_New(num_input_nodes);
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(input_keys[i]));
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *shp = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyTuple_SetItem(shp, j - lo, PyLong_FromUnsignedLong(
+                                       input_shape_data[j]));
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject *outs = PyList_New(num_output_nodes);
+  for (mx_uint i = 0; i < num_output_nodes; ++i)
+    PyList_SetItem(outs, i, PyUnicode_FromString(output_keys[i]));
+  const char *dev = (dev_type == 2) ? "tpu" : "cpu";
+  PyObject *args = Py_BuildValue(
+      "(sy#OOsiO)", symbol_json_str,
+      static_cast<const char *>(param_bytes),
+      static_cast<Py_ssize_t>(param_size), names, shapes, dev, dev_id,
+      outs);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  Py_DECREF(outs);
+  if (!args) {
+    SetErrorFromPython();
+    return -1;
+  }
+  PyObject *h = Call("create_partial_out", args);
+  Py_DECREF(args);
+  if (!h) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Predictor *p = new Predictor{h, {}};
+  *out = p;
+  return 0;
+}
+
 int MXTPredSetInput(PredictorHandle handle, const char *key,
                     const mx_float *data, mx_uint size) {
   Gil gil;

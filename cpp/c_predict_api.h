@@ -51,6 +51,24 @@ int MXTPredCreate(const char *symbol_json_str,
                   const mx_uint *input_shape_data,
                   PredictorHandle *out);
 
+/*!
+ * \brief create a predictor re-headed at internal outputs (reference
+ * MXPredCreatePartialOut) — feature extraction from intermediate
+ * layers. output_keys accept node names ("fc1") or explicit output
+ * names ("fc1_output").
+ */
+int MXTPredCreatePartialOut(const char *symbol_json_str,
+                            const void *param_bytes,
+                            int param_size,
+                            int dev_type, int dev_id,
+                            mx_uint num_input_nodes,
+                            const char **input_keys,
+                            const mx_uint *input_shape_indptr,
+                            const mx_uint *input_shape_data,
+                            mx_uint num_output_nodes,
+                            const char **output_keys,
+                            PredictorHandle *out);
+
 /*! \brief stage a float32 input by name (reference MXPredSetInput) */
 int MXTPredSetInput(PredictorHandle handle,
                     const char *key,

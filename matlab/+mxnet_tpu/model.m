@@ -12,6 +12,7 @@ properties (Access = private)
   symbol_json = '';
   param_bytes = [];
   prev_shape = [];
+  out_layers = {};  % partial-out heads ({} = the symbol's own outputs)
   dev_type = 1;   % 1 = cpu, 2+ = accelerator (advisory; XLA places)
   dev_id = 0;
 end
@@ -39,10 +40,20 @@ methods
 
   function out = forward(obj, img, varargin)
   %FORWARD run inference. img: single [H W C N] (or [H W C]).
-  %   Name-value: 'device', {'cpu'|'tpu'}, 'id', n.
+  %   Options (reference matlab/+mxnet/model.m forward):
+  %     'cpu' | 'tpu'/'gpu' [, id]   device placement (advisory)
+  %     {'layer1', 'layer2', ...}    PARTIAL OUTPUT: return features
+  %                                  from the named internal layers
+  %                                  (MXTPredCreatePartialOut); with a
+  %                                  cell option, out is a cell array.
     assert(~isempty(obj.symbol_json), 'call load() first');
+    want_outputs = {};
     i = 1;
     while i <= numel(varargin)
+      if iscell(varargin{i})
+        want_outputs = varargin{i}; i = i + 1;
+        continue;
+      end
       switch lower(varargin{i})
         case {'cpu'}
           obj.dev_type = 1; i = i + 1;
@@ -55,14 +66,27 @@ methods
           error('unknown option %s', varargin{i});
       end
     end
-    if ndims(img) == 3
-      img = reshape(img, [size(img) 1]);
+    if ~isequal(want_outputs, obj.out_layers)
+      obj.out_layers = want_outputs;
+      obj.prev_shape = [];  % force predictor rebuild with new heads
     end
-    % MATLAB [H W C N] col-major == framework [N C W H] row-major;
-    % permute to [W H C N] so the framework sees [N C H W]
-    img = permute(single(img), [2 1 3 4]);
-    sz = size(img);
-    shape = uint32([sz(4) sz(3) sz(2) sz(1)]);  % framework N C H W
+    if ndims(img) <= 2
+      % feature-vector input [K] or [K N]: MATLAB col-major [K N] is
+      % already the framework's row-major [N K] — no permute needed
+      if isvector(img); img = img(:); end
+      img = single(img);
+      sz = size(img);
+      shape = uint32([sz(2) sz(1)]);  % framework N K
+    else
+      if ndims(img) == 3
+        img = reshape(img, [size(img) 1]);
+      end
+      % MATLAB [H W C N] col-major == framework [N C W H] row-major;
+      % permute to [W H C N] so the framework sees [N C H W]
+      img = permute(single(img), [2 1 3 4]);
+      sz = size(img);
+      shape = uint32([sz(4) sz(3) sz(2) sz(1)]);  % framework N C H W
+    end
     if isempty(obj.prev_shape) || ~isequal(obj.prev_shape, shape) ...
         || isNull(obj.predictor)
       obj.make_predictor(shape);
@@ -72,21 +96,27 @@ methods
         obj.predictor, 'data', single(img(:)), uint32(numel(img))));
     obj.check(calllib('libmxnet_tpu_predict', 'MXTPredForward', ...
         obj.predictor));
-    % output 0 shape
-    ndimPtr = libpointer('uint32Ptr', 0);
-    shapePtr = libpointer('uint32PtrPtr');
-    obj.check(calllib('libmxnet_tpu_predict', ...
-        'MXTPredGetOutputShape', obj.predictor, uint32(0), ...
-        shapePtr, ndimPtr));
-    nd = double(ndimPtr.Value);
-    setdatatype(shapePtr.Value, 'uint32Ptr', nd);
-    oshape = double(shapePtr.Value);
-    n = prod(oshape);
-    buf = libpointer('singlePtr', zeros(n, 1, 'single'));
-    obj.check(calllib('libmxnet_tpu_predict', 'MXTPredGetOutput', ...
-        obj.predictor, uint32(0), buf, uint32(n)));
-    % framework [N K] row-major == MATLAB [K N] col-major: done
-    out = reshape(buf.Value, fliplr(oshape));
+    nout = max(1, numel(obj.out_layers));
+    outs = cell(1, nout);
+    for oi = 1 : nout
+      outs{oi} = obj.fetch_output(oi - 1);
+    end
+    if isempty(obj.out_layers)
+      out = outs{1};
+    else
+      out = outs;
+    end
+  end
+
+  function sym = parse_symbol(obj)
+  %PARSE_SYMBOL decode the loaded symbol JSON into a struct with
+  %   .nodes{i}.op/.name etc. (reference model.parse_symbol; the
+  %   checkpoint JSON format is shared by every binding).
+    assert(~isempty(obj.symbol_json), 'call load() first');
+    sym = jsondecode(obj.symbol_json);
+    if isstruct(sym.nodes)
+      sym.nodes = num2cell(sym.nodes);  % normalize to cell array
+    end
   end
 
   function delete(obj)
@@ -99,11 +129,37 @@ methods (Access = private)
     obj.free_predictor();
     p = libpointer('voidPtrPtr');
     csr = uint32([0 numel(shape)]);
-    obj.check(calllib('libmxnet_tpu_predict', 'MXTPredCreate', ...
-        obj.symbol_json, obj.param_bytes, ...
-        int32(numel(obj.param_bytes)), int32(obj.dev_type), ...
-        int32(obj.dev_id), uint32(1), {'data'}, csr, shape, p));
+    if isempty(obj.out_layers)
+      obj.check(calllib('libmxnet_tpu_predict', 'MXTPredCreate', ...
+          obj.symbol_json, obj.param_bytes, ...
+          int32(numel(obj.param_bytes)), int32(obj.dev_type), ...
+          int32(obj.dev_id), uint32(1), {'data'}, csr, shape, p));
+    else
+      obj.check(calllib('libmxnet_tpu_predict', ...
+          'MXTPredCreatePartialOut', ...
+          obj.symbol_json, obj.param_bytes, ...
+          int32(numel(obj.param_bytes)), int32(obj.dev_type), ...
+          int32(obj.dev_id), uint32(1), {'data'}, csr, shape, ...
+          uint32(numel(obj.out_layers)), obj.out_layers, p));
+    end
     obj.predictor = p.Value;
+  end
+
+  function out = fetch_output(obj, index)
+    ndimPtr = libpointer('uint32Ptr', 0);
+    shapePtr = libpointer('uint32PtrPtr');
+    obj.check(calllib('libmxnet_tpu_predict', ...
+        'MXTPredGetOutputShape', obj.predictor, uint32(index), ...
+        shapePtr, ndimPtr));
+    nd = double(ndimPtr.Value);
+    setdatatype(shapePtr.Value, 'uint32Ptr', nd);
+    oshape = double(shapePtr.Value);
+    n = prod(oshape);
+    buf = libpointer('singlePtr', zeros(n, 1, 'single'));
+    obj.check(calllib('libmxnet_tpu_predict', 'MXTPredGetOutput', ...
+        obj.predictor, uint32(index), buf, uint32(n)));
+    % framework row-major == MATLAB col-major with dims flipped
+    out = reshape(buf.Value, [fliplr(oshape) 1]);
   end
 
   function free_predictor(obj)

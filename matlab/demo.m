@@ -1,14 +1,44 @@
-%% mxnet_tpu MATLAB demo (reference matlab/demo.m).
-% Train and checkpoint a model with the Python package first, e.g.
-%   model.save_checkpoint('model/mlp', 10)
-% then run inference from MATLAB:
+%% mxnet_tpu MATLAB demo (reference: matlab/demo.m)
+% Loads a checkpoint trained by the Python/TPU framework and runs
+% inference through the native predict ABI — no MEX compilation.
+%
+% Produce a demo checkpoint first (any FeedForward model works):
+%   cd <repo>; python - <<'PY'
+%   import numpy as np, mxnet_tpu as mx
+%   net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+%       mx.sym.Variable("data"), num_hidden=10, name="fc"),
+%       name="softmax")
+%   X = np.random.rand(256, 64).astype("float32")
+%   y = (X.sum(1) % 10 // 1).astype("float32")
+%   m = mx.model.FeedForward(net, num_epoch=2, learning_rate=0.1)
+%   m.fit(X, y)
+%   m.save("model/demo")
+%   PY
 
+%% Load the model
+clear model
 model = mxnet_tpu.model;
-model.load('model/mlp', 10);
+model.load('model/demo', 2);
 
-% fake batch: 28x28 grayscale, batch of 2
-img = single(rand(28, 28, 1, 2));
+%% Run prediction on a random batch
+img = single(rand(64, 1));            % one 64-feature row
 pred = model.forward(img);
-fprintf('output: %d classes x %d images\n', size(pred, 1), size(pred, 2));
-[~, cls] = max(pred, [], 1);
-disp(cls - 1);  % zero-based class ids
+[p, i] = max(pred);
+fprintf('predicted class %d with probability %f\n', i - 1, p);
+
+%% Inspect the graph (shared checkpoint JSON format)
+sym = model.parse_symbol();
+layers = {};
+for k = 1 : length(sym.nodes)
+  if ~strcmp(sym.nodes{k}.op, 'null')
+    layers{end+1} = sym.nodes{k}.name; %#ok<SAGROW>
+  end
+end
+fprintf('layer name: %s\n', layers{:});
+
+%% Extract features from an internal layer (partial output)
+feas = model.forward(img, {'fc'});
+size(feas{1})
+
+%% Device placement is advisory (XLA owns layout):
+% pred = model.forward(img, 'tpu', 0);

@@ -1,0 +1,21 @@
+%% Prediction smoke test (reference: matlab/tests/test_prediction.m)
+% Run prepare_data first. Asserts: outputs are a probability simplex,
+% partial-out returns the pre-softmax feature, parse_symbol sees the
+% graph.
+model = mxnet_tpu.model;
+model.load('matlab_test_model', 3);
+
+x = single(rand(16, 1));
+p = model.forward(x);
+assert(abs(sum(p) - 1) < 1e-4, 'softmax output must sum to 1');
+assert(all(p >= 0));
+
+feas = model.forward(x, {'fc'});
+assert(numel(feas) == 1);
+assert(numel(feas{1}) == numel(p), 'fc feature size == class count');
+
+sym = model.parse_symbol();
+ops = cellfun(@(n) n.op, sym.nodes, 'UniformOutput', false);
+assert(any(strcmp(ops, 'FullyConnected')));
+assert(any(strcmp(ops, 'SoftmaxOutput')));
+fprintf('MATLAB prediction test OK\n');

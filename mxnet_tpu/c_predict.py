@@ -17,8 +17,8 @@ import os
 
 import numpy as np
 
-__all__ = ["create", "set_input", "forward", "get_output_shape",
-           "get_output", "num_outputs"]
+__all__ = ["create", "create_partial_out", "set_input", "forward",
+           "get_output_shape", "get_output", "num_outputs"]
 
 
 def _predictor_cls():
@@ -37,11 +37,16 @@ def _predictor_cls():
 
 class _CPredictor:
     def __init__(self, symbol_json, param_bytes, names, shapes,
-                 dev_type, dev_id):
+                 dev_type, dev_id, output_names=None):
         input_shapes = {n: tuple(s) for n, s in zip(names, shapes)}
         self.input_shapes = input_shapes
-        self.pred = _predictor_cls()(symbol_json, param_bytes, input_shapes,
-                                     dev_type, dev_id)
+        cls = _predictor_cls()
+        if output_names:
+            self.pred = cls(symbol_json, param_bytes, input_shapes,
+                            dev_type, dev_id, output_names=output_names)
+        else:
+            self.pred = cls(symbol_json, param_bytes, input_shapes,
+                            dev_type, dev_id)
         self.inputs = {}
         self.outputs = []
 
@@ -52,6 +57,16 @@ def create(symbol_json: str, param_bytes: bytes, names, shapes,
     return _CPredictor(symbol_json, param_bytes, list(names),
                        [tuple(int(x) for x in s) for s in shapes],
                        dev_type, dev_id)
+
+
+def create_partial_out(symbol_json: str, param_bytes: bytes, names,
+                       shapes, dev_type: str, dev_id: int, output_names):
+    """→ predictor re-headed at internal outputs
+    (MXPredCreatePartialOut)."""
+    return _CPredictor(symbol_json, param_bytes, list(names),
+                       [tuple(int(x) for x in s) for s in shapes],
+                       dev_type, dev_id,
+                       output_names=[str(n) for n in output_names])
 
 
 def set_input(h, key: str, data: bytes):

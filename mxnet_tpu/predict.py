@@ -34,14 +34,32 @@ class Predictor:
         already-loaded {'arg:name'/'aux:name' -> NDArray} dict
     input_shapes : dict name -> shape
     dev_type/dev_id : accepted for API parity (XLA owns placement)
+    output_names : optional list of internal output names — re-heads
+        the graph there (reference MXPredCreatePartialOut; feature
+        extraction from intermediate layers)
     """
 
     def __init__(self, symbol_json, param_data, input_shapes,
-                 dev_type="cpu", dev_id=0):
+                 dev_type="cpu", dev_id=0, output_names=None):
         if "{" not in symbol_json:  # path, not JSON text
             with open(symbol_json) as f:
                 symbol_json = f.read()
         self._symbol = sym_mod.load_json(symbol_json)
+        if output_names:
+            # partial-out (reference MXPredCreatePartialOut): re-head the
+            # graph at the named internal outputs; bare node names accept
+            # the conventional "_output" suffix implicitly
+            internals = self._symbol.get_internals()
+            avail = internals.list_outputs()
+            heads = []
+            for key in output_names:
+                name = key if key in avail else key + "_output"
+                if name not in avail:
+                    raise MXNetError(
+                        "Predictor: unknown output %r (internals: "
+                        "%s...)" % (key, ", ".join(avail[:8])))
+                heads.append(internals[name])
+            self._symbol = sym_mod.Group(heads)
 
         if isinstance(param_data, dict):
             save_dict = param_data

@@ -151,3 +151,29 @@ def test_generators_are_idempotent(tmp_path):
 def _have(tool):
     from shutil import which
     return which(tool) is not None
+
+
+def test_matlab_calllib_names_match_header():
+    """Every predict-ABI entry point the MATLAB sources name in
+    calllib(...) must exist in the REAL header — the no-MATLAB-in-image
+    analogue of loadlibrary failing at runtime on a bad name."""
+    import glob
+    import re
+
+    header = open(os.path.join(ROOT, "cpp", "c_predict_api.h")).read()
+    declared = set(re.findall(r"\b(MXTPred\w+|MXNDList\w+)\s*\(", header))
+    assert declared, "no declarations parsed from c_predict_api.h"
+    used = set()
+    for m_file in glob.glob(os.path.join(ROOT, "matlab", "**", "*.m"),
+                            recursive=True):
+        src = open(m_file).read()
+        # \.{0,3} also covers the line-wrapped ", ..." continuation
+        used |= set(re.findall(
+            r"calllib\('libmxnet_tpu_predict',\s*\.{0,3}\s*'(\w+)'",
+            src, re.S))
+    assert used, "no calllib uses found in matlab/"
+    missing = used - declared
+    assert not missing, "matlab calls undeclared ABI functions: %s" \
+        % sorted(missing)
+    # the partial-out path must actually be wired
+    assert "MXTPredCreatePartialOut" in used

@@ -67,3 +67,85 @@ def test_c_predict_end_to_end(tmp_path):
     got = np.array([float(t) for t in r.stdout.split()],
                    dtype=np.float32).reshape(2, 3)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.skipif(not (os.path.exists(LIB) or _build()),
+                    reason="native predict library not built")
+def test_c_predict_partial_out(tmp_path):
+    """MXTPredCreatePartialOut through ctypes: re-head the compiled
+    graph at an internal layer (the call sequence the MATLAB binding's
+    partial-output forward makes) and check the feature values against
+    the Python-side executor."""
+    import ctypes
+
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=8)
+    act = mx.symbol.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.symbol.FullyConnected(data=act, name="fc2", num_hidden=3)
+    sym = mx.symbol.SoftmaxOutput(data=fc2, name="softmax")
+
+    shapes = {"data": (2, 6), "softmax_label": (2,)}
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    rng = np.random.RandomState(7)
+    arg_params = {}
+    for name, arr in exe.arg_dict.items():
+        if name not in shapes:
+            v = rng.uniform(-0.5, 0.5, arr.shape).astype(np.float32)
+            arr[:] = v
+            arg_params[name] = mx.nd.array(v)
+    x = rng.randn(2, 6).astype(np.float32)
+
+    # python oracle for the INTERNAL layer (relu1 output)
+    internals = sym.get_internals()
+    feat_sym = internals["relu1_output"]
+    fexe = feat_sym.bind(mx.cpu(), dict(
+        {"data": mx.nd.array(x)},
+        **{k: v for k, v in arg_params.items()
+           if k in feat_sym.list_arguments()}))
+    fexe.forward(is_train=False)
+    want = fexe.outputs[0].asnumpy()
+
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 1, sym, arg_params, {})
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read().encode()
+    with open(prefix + "-0001.params", "rb") as f:
+        params = f.read()
+
+    lib = ctypes.CDLL(LIB)
+    lib.MXTPredGetLastError.restype = ctypes.c_char_p
+    handle = ctypes.c_void_p()
+    in_keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    shape = (ctypes.c_uint * 2)(2, 6)
+    out_keys = (ctypes.c_char_p * 1)(b"relu1")  # bare name: _output added
+    rc = lib.MXTPredCreatePartialOut(
+        sym_json, params, ctypes.c_int(len(params)),
+        ctypes.c_int(1), ctypes.c_int(0),
+        ctypes.c_uint(1), in_keys, indptr, shape,
+        ctypes.c_uint(1), out_keys, ctypes.byref(handle))
+    assert rc == 0, lib.MXTPredGetLastError()
+
+    xin = np.ascontiguousarray(x, np.float32)
+    rc = lib.MXTPredSetInput(
+        handle, b"data",
+        xin.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint(xin.size))
+    assert rc == 0, lib.MXTPredGetLastError()
+    assert lib.MXTPredForward(handle) == 0
+
+    ndim = ctypes.c_uint()
+    shp = ctypes.POINTER(ctypes.c_uint)()
+    rc = lib.MXTPredGetOutputShape(handle, ctypes.c_uint(0),
+                                   ctypes.byref(shp), ctypes.byref(ndim))
+    assert rc == 0, lib.MXTPredGetLastError()
+    oshape = tuple(shp[i] for i in range(ndim.value))
+    assert oshape == (2, 8), oshape
+    buf = np.empty(oshape, np.float32)
+    rc = lib.MXTPredGetOutput(
+        handle, ctypes.c_uint(0),
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_uint(buf.size))
+    assert rc == 0, lib.MXTPredGetLastError()
+    np.testing.assert_allclose(buf, want, rtol=1e-4, atol=1e-5)
+    lib.MXTPredFree(handle)
