@@ -22,11 +22,25 @@ def get_iterator(args, kv):
     train_rec = os.path.join(args.data_dir, "train.rec")
     val_rec = os.path.join(args.data_dir, "val.rec")
     if os.path.exists(train_rec):
-        train = mx.ImageRecordIter(
-            path_imgrec=train_rec, data_shape=data_shape,
-            batch_size=args.batch_size, rand_crop=True, rand_mirror=True,
-            mean_r=123.68, mean_g=116.779, mean_b=103.939,
-            num_parts=kv.num_workers, part_index=kv.rank)
+        if args.device_augment:
+            # production TPU recipe: uint8 infeed (4x less h2d traffic,
+            # no host float pass); random crop/flip + normalize run on
+            # device (doc/performance.md "Input pipeline")
+            base = mx.ImageRecordIter(
+                path_imgrec=train_rec, data_shape=(3, 256, 256),
+                resize=256, batch_size=args.batch_size,
+                device_augment=True,
+                num_parts=kv.num_workers, part_index=kv.rank)
+            train = mx.DeviceAugmentIter(
+                base, crop_shape=data_shape[1:], rand_crop=True,
+                rand_mirror=True, mean=(123.68, 116.779, 103.939))
+        else:
+            train = mx.ImageRecordIter(
+                path_imgrec=train_rec, data_shape=data_shape,
+                batch_size=args.batch_size, rand_crop=True,
+                rand_mirror=True,
+                mean_r=123.68, mean_g=116.779, mean_b=103.939,
+                num_parts=kv.num_workers, part_index=kv.rank)
         val = mx.ImageRecordIter(
             path_imgrec=val_rec, data_shape=data_shape,
             batch_size=args.batch_size,
@@ -76,6 +90,9 @@ def parse_args():
     parser.add_argument('--kv-store', type=str, default='local')
     parser.add_argument('--num-examples', type=int, default=1281167)
     parser.add_argument('--num-classes', type=int, default=1000)
+    parser.add_argument('--device-augment', action='store_true',
+                        help='uint8 infeed + on-device crop/flip/'
+                             'normalize (DeviceAugmentIter)')
     return parser.parse_args()
 
 

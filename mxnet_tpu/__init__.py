@@ -57,7 +57,7 @@ from . import parallel  # noqa: E402
 from .parallel import ParallelTrainer  # noqa: E402
 from . import recordio  # noqa: E402
 from . import image_io  # noqa: E402
-from .image_io import ImageRecordIter  # noqa: E402
+from .image_io import ImageRecordIter, DeviceAugmentIter  # noqa: E402
 from . import distributed  # noqa: E402
 from . import visualization  # noqa: E402
 from . import rtc  # noqa: E402

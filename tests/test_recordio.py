@@ -377,3 +377,47 @@ def test_device_augment_random_ops():
     np.testing.assert_array_equal(
         np.asarray(full),
         batch.astype(np.float32).transpose(0, 3, 1, 2))
+
+
+def test_device_augment_iter_wrapper(tmp_path, engine):
+    """DeviceAugmentIter: uint8 infeed + on-device augment behind the
+    plain DataIter protocol. Deterministic (center) mode must equal the
+    host float pipeline exactly; random mode obeys shapes/determinism
+    and trains through FeedForward unchanged."""
+    import mxnet_tpu as mx
+
+    path = _make_rec(tmp_path, n=16, hw=32)
+    mean = (10.0, 5.0, 2.0)
+    kw = dict(batch_size=8, shuffle=False, resize=28,
+              mean_r=mean[0], mean_g=mean[1], mean_b=mean[2], scale=0.25)
+    host = mx.ImageRecordIter(path, (3, 24, 24), **kw)
+    base = mx.ImageRecordIter(path, (3, 28, 28), device_augment=True,
+                              **kw)
+    dev = mx.DeviceAugmentIter(base, crop_shape=(24, 24),
+                               rand_crop=False, rand_mirror=False,
+                               mean=mean, scale=0.25)
+    assert dev.provide_data[0][1] == (8, 3, 24, 24)
+    hb = next(iter(host))
+    db = next(iter(dev))
+    np.testing.assert_allclose(db.data[0].asnumpy(),
+                               hb.data[0].asnumpy(), atol=1e-5)
+    np.testing.assert_array_equal(db.label[0].asnumpy(),
+                                  hb.label[0].asnumpy())
+
+    # random mode: shapes right, two epochs differ, fit() consumes it
+    base2 = mx.ImageRecordIter(path, (3, 28, 28), device_augment=True,
+                               **kw)
+    dev2 = mx.DeviceAugmentIter(base2, crop_shape=(24, 24), mean=mean,
+                                scale=0.25, seed=3)
+    b1 = next(iter(dev2)).data[0].asnumpy()
+    dev2.reset()
+    b2 = next(iter(dev2)).data[0].asnumpy()
+    assert b1.shape == (8, 3, 24, 24)
+    assert not np.array_equal(b1, b2)  # fresh crops per epoch
+
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Flatten(mx.sym.Variable("data")), num_hidden=10),
+        name="softmax")
+    m = mx.model.FeedForward(symbol=net, num_epoch=2, learning_rate=0.01)
+    dev2.reset()
+    m.fit(X=dev2)  # protocol-compatible end to end
