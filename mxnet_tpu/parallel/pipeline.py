@@ -18,11 +18,16 @@ attribute:
   different ops, different parameter counts — because each is its own
   switch branch), and activations advance one stage per tick via
   ``lax.ppermute`` over ICI neighbours.
-* Microbatches stream through to fill the pipe: the schedule is GPipe
-  with bubble fraction (S-1)/(M+S-1) — documented, not hidden; the
+* Microbatches stream through to fill the pipe: the default schedule is
+  GPipe with bubble fraction (S-1)/(M+S-1) — documented, not hidden; the
   backward pass is ``jax.vjp`` THROUGH the schedule (the transpose of
   ``ppermute`` is the reverse rotation), so gradients drain the pipe in
-  reverse order — the same wave 1F1B exploits, scheduled by XLA.
+  reverse order. ``schedule="1f1b"`` instead interleaves forward and
+  backward EXPLICITLY (no vjp-through-the-loop): activation memory is
+  bounded by the schedule depth (2S-1 in-flight microbatches per
+  device) independent of M, so microbatch count can grow to amortize
+  the bubble without growing memory — see
+  ``_build_step_staged_1f1b``.
 
 Parameter placement (``param_placement``):
 
@@ -231,12 +236,21 @@ class PipelineTrainer:
                  optimizer="sgd", optimizer_params=None, initializer=None,
                  seed=0, label_name="softmax_label",
                  param_placement="stage", remat=None,
-                 pp_shard_min_size="auto"):
+                 pp_shard_min_size="auto", schedule="gpipe"):
         if "pp" not in mesh.shape:
             raise MXNetError("PipelineTrainer: mesh needs a 'pp' axis")
         if param_placement not in ("stage", "replicated"):
             raise MXNetError("param_placement must be 'stage' or "
                              "'replicated', got %r" % (param_placement,))
+        if schedule not in ("gpipe", "1f1b"):
+            raise MXNetError("schedule must be 'gpipe' or '1f1b', got %r"
+                             % (schedule,))
+        if schedule == "1f1b" and param_placement != "stage":
+            raise MXNetError("schedule='1f1b' requires "
+                             "param_placement='stage' (the activation-"
+                             "bounded schedule accumulates per-stage "
+                             "row gradients)")
+        self.schedule = schedule
         self.param_placement = param_placement
         # remat=True checkpoints each stage branch: the backward
         # recomputes stage activations from the carried boundary instead
@@ -544,8 +558,59 @@ class PipelineTrainer:
                 "big": {k: self._opt_init(v)
                         for k, v in params["big"].items()}}
 
+    def _staged_specs(self):
+        """shard_map in/out specs for the staged param/opt pytrees."""
+        S = self.S
+        row_spec = P("pp")
+        param_struct = {
+            "rows": jax.ShapeDtypeStruct((S, self._pmax), jnp.float32),
+            "big": {name: jax.ShapeDtypeStruct((S, padded // S),
+                                               jnp.float32)
+                    for name, _sh, _sz, padded, _s in self._big_meta}}
+        param_specs = jax.tree.map(lambda _: row_spec, param_struct)
+        opt_specs = jax.tree.map(
+            lambda _: row_spec,
+            jax.eval_shape(self._opt_init_tree, param_struct))
+        return param_specs, opt_specs
+
+    def _staged_update(self, row, big_local, g_row, g_big, opt_state,
+                       lr, t_opt, opt_rng):
+        """Shared optimizer epilogue for the staged builders: update the
+        local flat row and each pp-sharded big-param chunk, re-lifted to
+        the leading length-1 shard dim shard_map expects."""
+        local_opt = jax.tree.map(lambda a: a[0], opt_state)
+        new_row, new_opt_rows = self._opt_update(
+            row, g_row, local_opt["rows"], lr, t_opt, opt_rng)
+        new_big, new_opt_big = {}, {}
+        for ki, k in enumerate(sorted(big_local)):
+            # stable per-param stream: fold by sorted index, NOT
+            # hash(str) (PYTHONHASHSEED varies across processes)
+            new_big[k], new_opt_big[k] = self._opt_update(
+                big_local[k], g_big[k], local_opt["big"][k], lr,
+                t_opt, jax.random.fold_in(opt_rng, 1 + ki))
+        lift = lambda t: jax.tree.map(lambda a: a[None], t)
+        return ({"rows": new_row[None],
+                 "big": {k: v[None] for k, v in new_big.items()}},
+                {"rows": lift(new_opt_rows),
+                 "big": {k: lift(v) for k, v in new_opt_big.items()}})
+
+    def _wrap_step(self, mapped):
+        """Microbatch-reshape + jit wrapper shared by every builder."""
+        def step(params, opt_state, data_dict, label, lr, t):
+            t = t + 1  # 1-based update count (Adam bias correction)
+            rng = jax.random.fold_in(self._rng, t)
+            row = self.dp * self.mb
+            data_mb = {k: v.reshape((self.M, row) + v.shape[1:])
+                       for k, v in data_dict.items()}
+            label_mb = label.reshape((self.M, row) + label.shape[1:])
+            return mapped(params, opt_state, data_mb, label_mb, lr, t,
+                          rng)
+        return jax.jit(step, donate_argnums=(0, 1))
+
     def _build_step(self):
         if self.param_placement == "stage":
+            if self.schedule == "1f1b":
+                return self._build_step_staged_1f1b()
             return self._build_step_staged()
         S, M = self.S, self.M
         perm = [(i, (i + 1) % S) for i in range(S)]
@@ -629,19 +694,8 @@ class PipelineTrainer:
             out_specs=(param_specs, param_specs,
                        tuple(batch_spec for _ in self.out_shapes)),
             check_vma=False)
-
-        def step(params, opt_state, data_dict, label, lr, t):
-            t = t + 1  # 1-based update count (Adam bias correction)
-            rng = jax.random.fold_in(self._rng, t)
-            # [B, ...] -> [M, dp*mb, ...]; dim 1 shards over dp
-            row = self.dp * self.mb
-            data_mb = {k: v.reshape((self.M, row) + v.shape[1:])
-                       for k, v in data_dict.items()}
-            label_mb = label.reshape((self.M, row) + label.shape[1:])
-            return mapped(params, opt_state, data_mb, label_mb, lr, t,
-                          rng)
-
-        return jax.jit(step, donate_argnums=(0, 1))
+        # [B, ...] -> [M, dp*mb, ...]; dim 1 shards over dp
+        return self._wrap_step(mapped)
 
     def _build_step_staged(self):
         """Per-stage placement: row-packed params/opt state are
@@ -661,15 +715,7 @@ class PipelineTrainer:
                       if k != self.label_name]
         has_dp = "dp" in self.mesh.shape
         batch_spec = P(None, "dp") if has_dp else P()
-        row_spec = P("pp")
-        param_struct = {
-            "rows": jax.ShapeDtypeStruct((S, self._pmax), jnp.float32),
-            "big": {name: jax.ShapeDtypeStruct((S, padded // S),
-                                               jnp.float32)
-                    for name, _sh, _sz, padded, _s in self._big_meta}}
-        param_specs = jax.tree.map(lambda _: row_spec, param_struct)
-        opt_struct = jax.eval_shape(self._opt_init_tree, param_struct)
-        opt_specs = jax.tree.map(lambda _: row_spec, opt_struct)
+        param_specs, opt_specs = self._staged_specs()
 
         def local_step(params, opt_state, data_mb, label_mb, lr, t_opt,
                        rng):
@@ -729,22 +775,10 @@ class PipelineTrainer:
             if has_dp:
                 g_row = lax.psum(g_row, "dp")
                 g_big = jax.tree.map(lambda g: lax.psum(g, "dp"), g_big)
-            local_opt = jax.tree.map(lambda a: a[0], opt_state)
-            new_row, new_opt_rows = self._opt_update(
-                row, g_row, local_opt["rows"], lr, t_opt, opt_rng)
-            new_big, new_opt_big = {}, {}
-            for ki, k in enumerate(sorted(big_local)):
-                # stable per-param stream: fold by sorted index, NOT
-                # hash(str) (PYTHONHASHSEED varies across processes)
-                new_big[k], new_opt_big[k] = self._opt_update(
-                    big_local[k], g_big[k], local_opt["big"][k], lr,
-                    t_opt, jax.random.fold_in(opt_rng, 1 + ki))
-            lift = lambda t: jax.tree.map(lambda a: a[None], t)
-            return ({"rows": new_row[None],
-                     "big": {k: v[None] for k, v in new_big.items()}},
-                    {"rows": lift(new_opt_rows),
-                     "big": {k: lift(v) for k, v in new_opt_big.items()}},
-                    out)
+            new_params, new_opt = self._staged_update(
+                row, big_local, g_row, g_big, opt_state, lr, t_opt,
+                opt_rng)
+            return new_params, new_opt, out
 
         mapped = shard_map(
             local_step, mesh=self.mesh,
@@ -754,18 +788,201 @@ class PipelineTrainer:
             out_specs=(param_specs, opt_specs,
                        tuple(batch_spec for _ in self.out_shapes)),
             check_vma=False)
+        return self._wrap_step(mapped)
 
-        def step(params, opt_state, data_dict, label, lr, t):
-            t = t + 1
-            rng = jax.random.fold_in(self._rng, t)
-            row = self.dp * self.mb
-            data_mb = {k: v.reshape((self.M, row) + v.shape[1:])
-                       for k, v in data_dict.items()}
-            label_mb = label.reshape((self.M, row) + label.shape[1:])
-            return mapped(params, opt_state, data_mb, label_mb, lr, t,
-                          rng)
+    def _build_step_staged_1f1b(self):
+        """Activation-bounded interleaved schedule (1F1B class,
+        PipeDream-flush family — the reference has no pipeline at all,
+        so this is a beat-the-reference feature; see GPipe docstring for
+        the baseline schedule).
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        GPipe differentiates through the whole ``lax.scan``, so the
+        scan's reverse pass keeps one residual per TICK: O(M) live
+        boundary activations per device — microbatch count buys bubble
+        amortization at the price of activation memory. Here forward
+        and backward are scheduled EXPLICITLY and nothing is ever
+        differentiated through a loop:
+
+        * tick ``t``: stage ``s`` runs the forward of microbatch
+          ``t - s`` and then the backward of microbatch
+          ``t - (2S-2-s)`` (cotangents arrive via the reverse
+          ``ppermute`` ring exactly one stage per tick, the transposed
+          wave of the forward schedule).
+        * each device keeps only a ``[2S-1, boundary]`` ring buffer of
+          its stage INPUTS; the backward re-runs the stage forward from
+          the saved input under ``jax.vjp`` (per-stage recompute — the
+          same trade GPipe-with-remat makes) with the SAME per-tick RNG
+          folding, so dropout masks match the forward bit-for-bit.
+        * per-stage gradients accumulate into the local flat row (and
+          the full-size cotangent of each pp-sharded big param, handed
+          back as this device's chunk by a final ``psum_scatter`` — the
+          manual transpose of the gather in ``_build_step_staged``).
+
+        In-flight activations per device are <= 2S-1 INDEPENDENT OF M
+        (GPipe: M+S-1), so M — and with it the bubble fraction
+        (S-1)/(M+S-1) — can grow without growing activation memory.
+        Wall-clock pays (S-1) extra pipe ticks versus GPipe's unified
+        reverse wave (M+2S-2 fwd+bwd ticks vs M+S-1 of each); the
+        schedule is split into fwd-only / fwd+bwd / bwd-only phases so
+        warmup and drain ticks don't execute the other half.
+        ``remat`` is ignored: per-stage recompute is inherent.
+        Exact-gradient equivalence with the GPipe path is pinned by
+        ``test_parallel.py::test_pipeline_1f1b_matches_gpipe``."""
+        S, M = self.S, self.M
+        W = 2 * S - 1
+        perm_f = [(i, (i + 1) % S) for i in range(S)]
+        perm_b = [(i, (i - 1) % S) for i in range(S)]
+        data_names = [k for k in self.input_shapes
+                      if k != self.label_name]
+        has_dp = "dp" in self.mesh.shape
+        batch_spec = P(None, "dp") if has_dp else P()
+        param_specs, opt_specs = self._staged_specs()
+
+        def local_step(params, opt_state, data_mb, label_mb, lr, t_opt,
+                       rng):
+            idx = lax.axis_index("pp")
+            opt_rng = jax.random.fold_in(rng, idx)
+            if has_dp:
+                rng = jax.random.fold_in(rng, lax.axis_index("dp"))
+            row = params["rows"][0]
+            big_local = {k: v[0] for k, v in params["big"].items()}
+            big_full = {k: lax.all_gather(v, "pp", tiled=True)
+                        for k, v in big_local.items()}
+
+            def stage_f(s, r, bf, state, t):
+                branch = self._make_branch(
+                    s, data_mb, label_mb,
+                    self._stage_param_dict(s, r, bf), rng, True)
+                return branch(state, t)
+
+            fwd_tick = [
+                (lambda st, tt, s=s: stage_f(s, row, big_full, st, tt))
+                for s in range(S)]
+
+            def make_bwd(s):
+                def bwd(saved_x, g_in, tt):
+                    # tt is the tick this microbatch's FORWARD ran at
+                    # (tt = mb + s), so the per-node RNG folding —
+                    # dropout masks — replays identically
+                    def f(r, bf, x):
+                        return stage_f(s, r, bf, x, tt)
+                    (y, outs), vjp_fn = jax.vjp(f, row, big_full,
+                                                saved_x)
+                    # loss heads ignore their cotangent (reference
+                    # contract) and non-last stages emit constant-zero
+                    # head slots, so ones is correct everywhere; the
+                    # boundary cotangent rides the reverse ring
+                    ct = (g_in.astype(y.dtype),
+                          tuple(jnp.ones_like(o) for o in outs))
+                    g_r, g_bf, g_x = vjp_fn(ct)
+                    return g_x, g_r, g_bf
+                return bwd
+
+            bwd_tick = [make_bwd(s) for s in range(S)]
+
+            def do_fwd(state_f, saved, outs, t):
+                y, out_vals = lax.switch(idx, fwd_tick, state_f, t)
+                # ring-buffer the input consumed this tick; mb index
+                # t-idx < 0 / >= M writes garbage into a slot that is
+                # provably re-written before any valid backward reads it
+                slot = jnp.mod(t - idx, W)
+                saved = lax.dynamic_update_index_in_dim(
+                    saved, state_f.astype(saved.dtype), slot, 0)
+                w = t - (S - 1)
+                valid = (idx == S - 1) & (w >= 0) & (w < M)
+                wc = jnp.clip(w, 0, M - 1)
+                outs = tuple(
+                    jnp.where(valid,
+                              lax.dynamic_update_index_in_dim(
+                                  o, v, wc, 0), o)
+                    for o, v in zip(outs, out_vals))
+                return lax.ppermute(y, "pp", perm_f), saved, outs
+
+            def do_bwd(state_b, saved, g_row, g_big, t):
+                b = t - (2 * S - 2 - idx)
+                saved_x = lax.dynamic_index_in_dim(
+                    saved, jnp.mod(b, W), 0, keepdims=False)
+                g_x, g_r, g_bf = lax.switch(idx, bwd_tick, saved_x,
+                                            state_b, b + idx)
+                validb = (b >= 0) & (b < M)
+                # where, not multiply: garbage ticks may produce inf
+                g_row = g_row + jnp.where(validb, g_r,
+                                          jnp.zeros_like(g_r))
+                g_big = {k: g_big[k] + jnp.where(validb, g_bf[k],
+                                                 jnp.zeros_like(g_bf[k]))
+                         for k in g_big}
+                return lax.ppermute(g_x, "pp", perm_b), g_row, g_big
+
+            saved0 = jnp.zeros((W,) + self._boundary_shape,
+                               self._boundary_dtype)
+            state_f0 = jnp.zeros(self._boundary_shape,
+                                 self._boundary_dtype)
+            state_b0 = jnp.zeros(self._boundary_shape,
+                                 self._boundary_dtype)
+            g_row0 = jnp.zeros_like(row)
+            g_big0 = {k: jnp.zeros_like(v) for k, v in big_full.items()}
+            out0 = tuple(jnp.zeros((M,) + os_, jnp.float32)
+                         for os_ in self.out_shapes)
+
+            def bodyA(carry, t):  # warmup: forward only
+                state_f, saved, outs = carry
+                return do_fwd(state_f, saved, outs, t), None
+
+            (state_f, saved, outs), _ = lax.scan(
+                bodyA, (state_f0, saved0, out0), jnp.arange(S - 1))
+
+            def bodyB(carry, t):  # steady state: one fwd then one bwd
+                state_f, state_b, saved, g_row, g_big, outs = carry
+                # fwd first: the LAST stage backwards the microbatch it
+                # just forwarded in the same tick (classic 1F1B)
+                state_f, saved, outs = do_fwd(state_f, saved, outs, t)
+                state_b, g_row, g_big = do_bwd(state_b, saved, g_row,
+                                               g_big, t)
+                return (state_f, state_b, saved, g_row, g_big,
+                        outs), None
+
+            (state_f, state_b, saved, g_row, g_big, outs), _ = lax.scan(
+                bodyB, (state_f, state_b0, saved, g_row0, g_big0, outs),
+                jnp.arange(S - 1, M + S - 1))
+
+            def bodyC(carry, t):  # drain: backward only
+                state_b, saved, g_row, g_big = carry
+                state_b, g_row, g_big = do_bwd(state_b, saved, g_row,
+                                               g_big, t)
+                return (state_b, saved, g_row, g_big), None
+
+            (state_b, saved, g_row, g_big), _ = lax.scan(
+                bodyC, (state_b, saved, g_row, g_big),
+                jnp.arange(M + S - 1, M + 2 * S - 2))
+
+            outs = tuple(lax.psum(o, "pp") for o in outs)
+            # manual transpose of the big-param all_gather: sum the
+            # full-size cotangents across pp and keep this device's
+            # tile. Scatter BEFORE the dp reduction so the dp collective
+            # moves 1/S of the bytes (the axes act on disjoint data, so
+            # the order is mathematically free)
+            g_big_local = {
+                k: lax.psum_scatter(v, "pp", scatter_dimension=0,
+                                    tiled=True)
+                for k, v in g_big.items()}
+            if has_dp:
+                g_row = lax.psum(g_row, "dp")
+                g_big_local = {k: lax.psum(v, "dp")
+                               for k, v in g_big_local.items()}
+            new_params, new_opt = self._staged_update(
+                row, big_local, g_row, g_big_local, opt_state, lr,
+                t_opt, opt_rng)
+            return new_params, new_opt, outs
+
+        mapped = shard_map(
+            local_step, mesh=self.mesh,
+            in_specs=(param_specs, opt_specs,
+                      {k: batch_spec for k in data_names}, batch_spec,
+                      P(), P(), P()),
+            out_specs=(param_specs, opt_specs,
+                       tuple(batch_spec for _ in self.out_shapes)),
+            check_vma=False)
+        return self._wrap_step(mapped)
 
     # ------------------------------------------------------------------
     def step(self, batch):

@@ -542,6 +542,24 @@ class ParallelTrainer:
                             self._data_sh[label_names[0]], lab)
                     with self.mesh:
                         acc_state = _acc_update(acc_state, outs[0], lab)
+                    if dm_kind == "ce" and epoch == 0 and nbatch == 0 \
+                            and jax.process_count() == 1:
+                        # the CE accumulator assumes the monitored output
+                        # is a probability distribution (the reference
+                        # CrossEntropy metric's contract); a logits-
+                        # output symbol silently yields garbage. One
+                        # cheap first-batch host check catches that.
+                        row = np.asarray(
+                            outs[0][(0,) * (outs[0].ndim - 1)],
+                            dtype=np.float64)
+                        if not 0.9 <= float(row.sum()) <= 1.1:
+                            logger.warning(
+                                "device_metric cross-entropy expects "
+                                "probability outputs (rows summing to "
+                                "1); the first output row sums to %.4g "
+                                "- the reported CE will be meaningless "
+                                "if the symbol emits raw logits.",
+                                float(row.sum()))
                 else:
                     out_nds = [nd.array(np.asarray(o)) for o in outs]
                     eval_metric.update(dbatch.label, out_nds)
