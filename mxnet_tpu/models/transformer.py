@@ -87,13 +87,18 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
     (output [B,V,T]). "flat" reshapes to [B*T,V] and applies the plain
     softmax along the LAST (lane-aligned) axis — identical loss and
     gradients without transposing the vocab-sized logits tensor
-    (output [B*T,V]).
+    (output [B*T,V]). "ce" ends in the fused ``SoftmaxCELoss`` head:
+    the output is the per-token LOSS [B*T] (f32) and the vocab-sized
+    probability tensor is never materialized — identical parameter
+    updates (the loss gradient is SoftmaxOutput's), but consumers that
+    need probabilities (accuracy metrics, predict) should use the other
+    layouts.
     """
     from ..attribute import AttrScope
 
-    if loss_layout not in ("reference", "flat"):
-        raise ValueError("loss_layout must be 'reference' or 'flat', "
-                         "got %r" % (loss_layout,))
+    if loss_layout not in ("reference", "flat", "ce"):
+        raise ValueError("loss_layout must be 'reference', 'flat' or "
+                         "'ce', got %r" % (loss_layout,))
     if ffn_hidden is None:
         ffn_hidden = 4 * embed_dim
 
@@ -132,12 +137,15 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
                              beta=sym.Variable("lnf_beta"), name="lnf")
         logits = sym.FullyConnected(data=ln_f, num_hidden=vocab_size,
                                     name="lm_head", flatten=False)
-        if loss_layout == "flat":
+        if loss_layout in ("flat", "ce"):
             flat = sym.Reshape(data=logits, shape=(-1, vocab_size),
                                name="logits_flat")
             flat_label = sym.Reshape(
                 data=sym.Variable("softmax_label"), shape=(-1,),
                 name="label_flat")
+            if loss_layout == "ce":
+                return sym.SoftmaxCELoss(data=flat, label=flat_label,
+                                         name="softmax")
             return sym.SoftmaxOutput(data=flat, label=flat_label,
                                      name="softmax")
         # per-position softmax: label [B, T]

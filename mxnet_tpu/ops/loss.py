@@ -134,6 +134,69 @@ _regression("MAERegressionOutput", lambda d: d, lambda o, l: jnp.sign(o - l))
 
 
 @register
+class SoftmaxCELoss(OpSpec):
+    """Fused softmax + cross-entropy loss head: per-example loss out.
+
+    No reference counterpart — the reference's SoftmaxOutput
+    materializes the full probability tensor as the executor output;
+    for a [B*T, V] LM head that is a vocab-sized buffer written every
+    step. Output is the
+    per-example loss ``lse(logits) - logits[label]`` (f32, class axis
+    reduced away): the probabilities are never formed in the forward
+    pass, and the backward builds ``(softmax - onehot) * grad_scale``
+    in one fused pass from the logits residual. Gradient is exactly
+    SoftmaxOutput's (``softmax_output-inl.h`` contract: head cotangent
+    ignored, batch-summed), so training through either head updates
+    parameters identically — pinned by
+    ``test_operator.py::test_softmax_ce_loss``."""
+
+    name = "SoftmaxCELoss"
+    params = {"grad_scale": Param("float", 1.0)}
+
+    def arguments(self, p):
+        return ["data", "label"]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return list(in_shapes), [None], []
+        lshape = tuple(d[:-1])
+        ins = [d, shape_assign(in_shapes[1], lshape, "SoftmaxCELoss label")]
+        return ins, [lshape], []
+
+    def forward(self, p, ins, aux, is_train, rng):
+        scale = p["grad_scale"]
+
+        def fwd_fn(d, l):
+            z = d.astype(jnp.float32)
+            lse = jax.nn.logsumexp(z, axis=-1)
+            ll = jnp.take_along_axis(
+                z, l.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+            return lse - ll
+
+        # _loss_vjp keeps (out, label) as residuals, but this op's
+        # gradient needs the LOGITS, so carry them explicitly
+        @jax.custom_vjp
+        def f(data, label):
+            return fwd_fn(data, label)
+
+        def f_fwd(data, label):
+            return fwd_fn(data, label), (data, label)
+
+        def f_bwd(res, g):
+            data, label = res
+            del g  # reference loss-layer contract: cotangent ignored
+            prob = jax.nn.softmax(data.astype(jnp.float32), axis=-1)
+            onehot = jax.nn.one_hot(label.astype(jnp.int32),
+                                    data.shape[-1], dtype=prob.dtype)
+            grad = ((prob - onehot) * scale).astype(data.dtype)
+            return grad, jnp.zeros_like(label)
+
+        f.defvjp(f_fwd, f_bwd)
+        return [f(*ins)], []
+
+
+@register
 class IdentityAttachKLSparseReg(OpSpec):
     """Identity forward that attaches a KL sparsity penalty gradient
     (``identity_attach_KL_sparse_reg-inl.h``, sparse autoencoders). The

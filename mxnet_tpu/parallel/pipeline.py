@@ -265,6 +265,12 @@ class PipelineTrainer:
             import os
             remat = os.environ.get("MXNET_BACKWARD_DO_MIRROR",
                                    "0") == "1"
+        elif remat and schedule == "1f1b":
+            import warnings
+            warnings.warn("PipelineTrainer: remat is inherent to "
+                          "schedule='1f1b' (the backward re-runs each "
+                          "stage from its saved input); the flag has "
+                          "no additional effect")
         self.remat = bool(remat)
         if symbol.list_auxiliary_states():
             raise MXNetError("PipelineTrainer: aux states unsupported "

@@ -254,6 +254,18 @@ def test_transformer_lm_flat_loss_layout_equivalent():
         np.testing.assert_allclose(g_flat[n], g_ref[n], rtol=1e-5,
                                    atol=1e-7, err_msg=n)
 
+    # loss_layout='ce': the fused SoftmaxCELoss head emits per-token
+    # losses instead of probabilities — same gradients exactly
+    out_c, g_ce = grads("ce")
+    assert out_c.shape == (B * T,)
+    pick = np.take_along_axis(out_f, label.reshape(-1, 1).astype(int),
+                              axis=1)[:, 0]
+    np.testing.assert_allclose(out_c, -np.log(np.maximum(pick, 1e-30)),
+                               rtol=1e-5, atol=1e-6)
+    for n in g_ref:
+        np.testing.assert_allclose(g_ce[n], g_ref[n], rtol=1e-5,
+                                   atol=1e-7, err_msg=n)
+
 
 def test_reshape_full_shape_param():
     """Reshape's successor-API ``shape`` param: whole-tensor reshape,

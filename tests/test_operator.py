@@ -169,6 +169,31 @@ def test_softmax():
     assert reldiff(grad.asnumpy(), p - onehot) < 1e-5
 
 
+def test_softmax_ce_loss():
+    """SoftmaxCELoss: per-example loss forward (probabilities never
+    materialized), SoftmaxOutput's exact gradient ((p - onehot) *
+    grad_scale, head cotangent ignored), zero label gradient."""
+    shape = (6, 9)
+    X = mx.symbol.Variable("X")
+    L = mx.symbol.Variable("L")
+    Y = mx.symbol.SoftmaxCELoss(data=X, label=L, grad_scale=0.5)
+    x = mx.random.uniform(-3, 3, shape)
+    lbl = np.random.randint(0, shape[1], (shape[0],)).astype(np.float32)
+    grad = mx.nd.empty(shape)
+    exe = Y.bind(mx.cpu(), args=[x, mx.nd.array(lbl)],
+                 args_grad={"X": grad})
+    exe.forward(is_train=True)
+    out = exe.outputs[0].asnumpy()
+    assert out.shape == (shape[0],)
+    z = x.asnumpy() - x.asnumpy().max(axis=1, keepdims=True)
+    p = np.exp(z) / np.exp(z).sum(axis=1, keepdims=True)
+    want = -np.log(p[np.arange(shape[0]), lbl.astype(int)])
+    assert reldiff(out, want) < 1e-5
+    exe.backward()
+    onehot = np.eye(shape[1])[lbl.astype(int)]
+    assert reldiff(grad.asnumpy(), 0.5 * (p - onehot)) < 1e-5
+
+
 def test_python_op():
     X = mx.symbol.Variable("X")
     op = mx.operator.NumpyOp()

@@ -31,11 +31,12 @@ def main():
         impl = os.environ.get("AB_IMPL", "flash")
         layout = os.environ.get("AB_LOSS_LAYOUT", "reference")
         seq = int(os.environ.get("AB_SEQ", 1024))
-        sym = get_transformer_lm(32000, num_layers=12, embed_dim=768,
+        vocab = int(os.environ.get("AB_VOCAB", 32000))
+        sym = get_transformer_lm(vocab, num_layers=12, embed_dim=768,
                                  num_heads=heads, impl=impl,
                                  loss_layout=layout)
         shapes = {"data": (batch, seq), "softmax_label": (batch, seq)}
-        n_classes, int_data = 32000, True
+        n_classes, int_data = vocab, True
     else:
         raise SystemExit("unknown model " + model)
 
