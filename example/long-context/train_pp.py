@@ -58,6 +58,13 @@ def main():
     parser.add_argument('--heads', type=int, default=4)
     parser.add_argument('--steps', type=int, default=25)
     parser.add_argument('--lr', type=float, default=0.3)
+    parser.add_argument('--schedule', choices=['gpipe', '1f1b'],
+                        default='gpipe',
+                        help="'1f1b' bounds activation memory by the "
+                             "schedule depth (2S-1 in-flight "
+                             "microbatches) instead of M, so "
+                             "--microbatches can grow to amortize the "
+                             "bubble for free")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -71,6 +78,7 @@ def main():
         sym, {"data": (args.batch_size, args.seq_len),
               "softmax_label": (args.batch_size, args.seq_len)},
         mesh, num_microbatches=args.microbatches, optimizer="sgd",
+        schedule=args.schedule,
         optimizer_params={
             "learning_rate": args.lr, "momentum": 0.9,
             # multi_output LM loss sums over batch AND positions:

@@ -12,7 +12,8 @@ from .base import MXNetError
 from .ndarray import NDArray
 
 __all__ = ["Torch", "check_label_shapes", "EvalMetric", "Accuracy", "F1", "MAE", "MSE", "RMSE",
-           "CrossEntropy", "CustomMetric", "create", "np"]
+           "CrossEntropy", "TopKAccuracy", "Loss", "CustomMetric",
+           "create", "np"]
 
 
 def _as_numpy(x):
@@ -165,6 +166,23 @@ class CrossEntropy(EvalMetric):
             self.num_inst += label.shape[0]
 
 
+class Loss(EvalMetric):
+    """Mean of the monitored outputs themselves — for loss-emitting
+    heads (``SoftmaxCELoss``, MakeLoss-style outputs) whose executor
+    output IS the per-example loss, so probability-based metrics don't
+    apply. Beyond the reference's metric set (its heads all emit
+    predictions), added alongside the fused loss head."""
+
+    def __init__(self):
+        super().__init__("loss")
+
+    def update(self, labels, preds):
+        for pred in preds:
+            pred = _as_numpy(pred)
+            self.sum_metric += pred.sum()
+            self.num_inst += pred.size
+
+
 class CustomMetric(EvalMetric):
     """Wrap a feval(label, pred) -> float (reference CustomMetric)."""
 
@@ -198,7 +216,7 @@ def create(metric):
         return metric
     metrics = {"acc": Accuracy, "accuracy": Accuracy, "f1": F1, "mae": MAE,
                "mse": MSE, "rmse": RMSE, "ce": CrossEntropy,
-               "cross-entropy": CrossEntropy,
+               "cross-entropy": CrossEntropy, "loss": Loss,
                "top_k_accuracy": TopKAccuracy, "top_k_acc": TopKAccuracy,
                "torch": lambda: Torch()}
     try:

@@ -151,7 +151,9 @@ class SoftmaxCELoss(OpSpec):
     ``test_operator.py::test_softmax_ce_loss``."""
 
     name = "SoftmaxCELoss"
-    params = {"grad_scale": Param("float", 1.0)}
+    params = {"grad_scale": Param("float", 1.0),
+              "ignore_label": Param("float", -1.0),
+              "use_ignore": Param("bool", False)}
 
     def arguments(self, p):
         return ["data", "label"]
@@ -166,13 +168,21 @@ class SoftmaxCELoss(OpSpec):
 
     def forward(self, p, ins, aux, is_train, rng):
         scale = p["grad_scale"]
+        use_ignore = p["use_ignore"]
+        ignore = p["ignore_label"]
 
         def fwd_fn(d, l):
             z = d.astype(jnp.float32)
             lse = jax.nn.logsumexp(z, axis=-1)
             ll = jnp.take_along_axis(
-                z, l.astype(jnp.int32)[..., None], axis=-1)[..., 0]
-            return lse - ll
+                z, jnp.clip(l.astype(jnp.int32), 0, d.shape[-1] - 1)
+                [..., None], axis=-1)[..., 0]
+            loss = lse - ll
+            if use_ignore:
+                # ignored positions (label padding) report zero loss,
+                # matching SoftmaxOutput's use_ignore gradient gating
+                loss = jnp.where(l == ignore, 0.0, loss)
+            return loss
 
         # _loss_vjp keeps (out, label) as residuals, but this op's
         # gradient needs the LOGITS, so carry them explicitly
@@ -189,8 +199,10 @@ class SoftmaxCELoss(OpSpec):
             prob = jax.nn.softmax(data.astype(jnp.float32), axis=-1)
             onehot = jax.nn.one_hot(label.astype(jnp.int32),
                                     data.shape[-1], dtype=prob.dtype)
-            grad = ((prob - onehot) * scale).astype(data.dtype)
-            return grad, jnp.zeros_like(label)
+            grad = (prob - onehot) * scale
+            if use_ignore:
+                grad = grad * (label != ignore)[..., None]
+            return grad.astype(data.dtype), jnp.zeros_like(label)
 
         f.defvjp(f_fwd, f_bwd)
         return [f(*ins)], []

@@ -435,10 +435,12 @@ class ParallelTrainer:
         accumulator — compiled once per (kind, k), not per fit() call.
 
         ``kind``: "acc" (argmax match), "topk" (label within top-k
-        scores), or "ce" (summed -log p[label]; assumes the monitored
+        scores), "ce" (summed -log p[label]; assumes the monitored
         output is a probability distribution, as the reference's
-        CrossEntropy metric does). State is a replicated (sum, count)
-        pair; value = sum / count for all three."""
+        CrossEntropy metric does), or "loss" (sum of the outputs
+        themselves, for loss-emitting heads like SoftmaxCELoss; label
+        unused, count = output size). State is a replicated
+        (sum, count) pair; value = sum / count in every kind."""
         cache = getattr(self, "_jit_metric", None)
         if cache is None:
             cache = self._jit_metric = {}
@@ -468,6 +470,11 @@ class ParallelTrainer:
                     axis=-1)[..., 0]
                 ok = jnp.sum(-jnp.log(jnp.maximum(
                     prob.astype(jnp.float32), 1e-30)))
+            elif kind == "loss":
+                # loss-emitting heads (SoftmaxCELoss): the output IS
+                # the per-example loss; label unused
+                return (state[0] + jnp.sum(out.astype(jnp.float32)),
+                        state[1] + jnp.float32(out.size))
             else:  # pragma: no cover
                 raise MXNetError("unknown device metric %r" % (kind,))
             return state[0] + ok, state[1] + jnp.float32(label.size)
@@ -506,10 +513,12 @@ class ParallelTrainer:
                 dm_kind, dm_k = "acc", 1
             elif isinstance(eval_metric, metric_mod.CrossEntropy):
                 dm_kind, dm_k = "ce", 1
+            elif isinstance(eval_metric, metric_mod.Loss):
+                dm_kind, dm_k = "loss", 1
             else:
                 raise MXNetError(
                     "device_metric=True supports accuracy, top-k "
-                    "accuracy and cross-entropy; got %r"
+                    "accuracy, cross-entropy and loss; got %r"
                     % (eval_metric.name,))
         data_names = [x[0] for x in train_data.provide_data]
         label_names = [x[0] for x in train_data.provide_label]

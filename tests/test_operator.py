@@ -193,6 +193,22 @@ def test_softmax_ce_loss():
     onehot = np.eye(shape[1])[lbl.astype(int)]
     assert reldiff(grad.asnumpy(), 0.5 * (p - onehot)) < 1e-5
 
+    # use_ignore: padded labels (-1) report zero loss and zero gradient
+    lbl_pad = lbl.copy()
+    lbl_pad[::2] = -1
+    Yi = mx.symbol.SoftmaxCELoss(data=X, label=L, use_ignore=True)
+    grad_i = mx.nd.empty(shape)
+    exe_i = Yi.bind(mx.cpu(), args=[x, mx.nd.array(lbl_pad)],
+                    args_grad={"X": grad_i})
+    exe_i.forward(is_train=True)
+    out_i = exe_i.outputs[0].asnumpy()
+    assert (out_i[::2] == 0).all()
+    assert reldiff(out_i[1::2], want[1::2]) < 1e-5
+    exe_i.backward()
+    gi = grad_i.asnumpy()
+    assert (gi[::2] == 0).all()
+    assert reldiff(gi[1::2], (p - onehot)[1::2]) < 1e-5
+
 
 def test_python_op():
     X = mx.symbol.Variable("X")

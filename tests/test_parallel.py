@@ -1052,6 +1052,29 @@ def test_fit_device_metric_topk_and_ce_match_host():
     with pytest.raises(mx.base.MXNetError):
         run(mx.metric.MSE(), True)
 
+    # loss-emitting head (SoftmaxCELoss) + Loss metric: device and host
+    # accumulators agree
+    sym_ce = mx.symbol.SoftmaxCELoss(data=fc, name="softmax")
+
+    def run_ce(device_metric):
+        it = mx.io.NDArrayIter(x, y, batch_size=64, shuffle=False)
+        tr = par.ParallelTrainer(
+            sym_ce, {"data": (64, 16), "softmax_label": (64,)},
+            optimizer="sgd", mesh=par.data_parallel_mesh(),
+            optimizer_params={"learning_rate": 0.5})
+        prng = np.random.RandomState(5)
+        tr.init_params({"fc_weight": mx.nd.array(
+            prng.uniform(-0.1, 0.1, (nclass, 16)).astype("f")),
+            "fc_bias": mx.nd.zeros((nclass,))})
+        tr.fit(it, num_epoch=2, eval_metric=mx.metric.Loss(),
+               device_metric=device_metric)
+        return tr.last_train_metric
+
+    name_d, val_d = run_ce(True)
+    name_h, val_h = run_ce(False)
+    assert name_d == name_h == "loss"
+    assert abs(val_d - val_h) < 1e-5, (val_d, val_h)
+
 
 def test_fit_device_metric_ce_warns_on_logits_output(caplog):
     """device_metric cross-entropy assumes probability outputs; a symbol

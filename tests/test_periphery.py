@@ -233,6 +233,18 @@ def test_topk_accuracy_metric():
     assert mx.metric.create("top_k_accuracy").top_k == 5
 
 
+def test_loss_metric():
+    """Loss metric: mean of the monitored outputs (the fit-compatible
+    metric for loss-emitting heads like SoftmaxCELoss)."""
+    import numpy as np
+    losses = mx.nd.array(np.array([1.0, 3.0, 5.0], np.float32))
+    m = mx.metric.create("loss")
+    m.update([None], [losses])
+    assert m.get() == ("loss", 3.0)
+    m.update([None], [mx.nd.array(np.array([7.0], np.float32))])
+    assert m.get()[1] == 4.0
+
+
 def test_profiler_benchmark_chain():
     """The honest-timing utility (doc/performance.md methodology as a
     library API): measures a dependent jitted chain, returns sane
