@@ -56,6 +56,9 @@ class SoftmaxOutput(OpSpec):
     def arguments(self, p):
         return ["data", "label"]
 
+    def integer_arguments(self, p):
+        return ("label",)  # class ids — bf16 casts would corrupt >256
+
     def infer_shape(self, p, in_shapes):
         d = in_shapes[0]
         if d is None:
@@ -157,6 +160,9 @@ class SoftmaxCELoss(OpSpec):
 
     def arguments(self, p):
         return ["data", "label"]
+
+    def integer_arguments(self, p):
+        return ("label",)  # class ids — bf16 casts would corrupt >256
 
     def infer_shape(self, p, in_shapes):
         d = in_shapes[0]

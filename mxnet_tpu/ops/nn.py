@@ -651,6 +651,9 @@ class Embedding(OpSpec):
     def arguments(self, p):
         return ["data", "weight"]
 
+    def integer_arguments(self, p):
+        return ("data",)  # token ids — bf16 casts would corrupt >256
+
     def infer_shape(self, p, in_shapes):
         ins = list(in_shapes)
         ins[1] = shape_assign(ins[1], (p["input_dim"], p["output_dim"]),

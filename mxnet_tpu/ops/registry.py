@@ -86,6 +86,15 @@ class OpSpec:
         """Output names (ListOutputs); visible ones only."""
         return ["output"]
 
+    def integer_arguments(self, p):
+        """Argument names whose values are INDICES (class ids, token
+        ids). Mixed-precision compute casts must skip them: bfloat16
+        represents integers exactly only up to 256, so casting a label
+        or token tensor silently corrupts ids above that
+        (``ParallelTrainer`` consults this via
+        ``parallel.graph.integer_semantic_inputs``)."""
+        return ()
+
     def aux_states(self, p):
         """Auxiliary (non-differentiable, op-mutated) state names."""
         return []

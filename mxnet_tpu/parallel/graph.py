@@ -11,7 +11,54 @@ from __future__ import annotations
 
 from ..ops.fusion import FusionPlan, eval_graph
 
-__all__ = ["make_graph_fn"]
+__all__ = ["make_graph_fn", "integer_semantic_inputs"]
+
+# ops that forward their input VALUES unchanged (layout/flow only), so
+# integer-semantics propagate backwards through them — a label reshaped
+# before reaching SoftmaxOutput is still a label
+_VALUE_PRESERVING = {"Reshape", "Flatten", "SwapAxis", "BlockGrad"}
+
+
+def integer_semantic_inputs(symbol):
+    """Names of input variables whose values are INDICES (labels, token
+    ids) in every use — mixed-precision trainers must not cast them:
+    bfloat16 spaces integers 4 apart near 1000, so casting a label or
+    token tensor silently retargets every id above 256 (class 999
+    becomes 1000). A variable qualifies when every consumption path,
+    traced through value-preserving ops, ends in an argument the op
+    declares via ``OpSpec.integer_arguments`` (Embedding data, loss
+    labels)."""
+    topo = symbol._topo()
+    heads = {(id(h), i) for h, i in symbol._heads}
+    uses = {}  # id(node) -> [(consumer, argname)]
+    for n in topo:
+        if n.is_var:
+            continue
+        argnames = n.spec.arguments(n.params)
+        for (inp, idx), aname in zip(n.inputs, argnames):
+            uses.setdefault(id(inp), []).append((n, aname))
+
+    int_out = {}  # id(node) -> all uses of its output are index-semantic
+
+    def node_is_int(n):
+        if (id(n), 0) in heads:
+            return False
+        use_list = uses.get(id(n), [])
+        if not use_list:
+            return False
+        for consumer, aname in use_list:
+            if aname in consumer.spec.integer_arguments(consumer.params):
+                continue
+            if consumer.spec.name in _VALUE_PRESERVING \
+                    and int_out.get(id(consumer), False):
+                continue
+            return False
+        return True
+
+    for n in reversed(topo):
+        if not n.is_var:
+            int_out[id(n)] = node_is_int(n)
+    return {n.name for n in topo if n.is_var and node_is_int(n)}
 
 
 def make_graph_fn(symbol, allow_fusion=True):

@@ -35,7 +35,7 @@ from ..ndarray import NDArray
 from .. import optimizer as opt_mod
 from .. import metric as metric_mod
 from ..initializer import Uniform
-from .graph import make_graph_fn
+from .graph import make_graph_fn, integer_semantic_inputs
 from .mesh import local_mesh
 from .shard import ShardingRules, P
 from .optim import make_functional
@@ -179,6 +179,12 @@ class ParallelTrainer:
         # MXNET_PALLAS_FUSION=1 still forces it on for measurement
         self._graph_fn = make_graph_fn(
             symbol, allow_fusion=self.mesh.devices.size == 1)
+        # index-valued inputs (labels, embedding tokens) are exempt from
+        # the compute_dtype cast: bf16 spaces integers 4 apart near
+        # 1000, so casting them silently retargets ids above 256
+        self._no_cast = (
+            integer_semantic_inputs(symbol) & set(self.input_shapes)
+            if self.compute_dtype is not None else set())
         self.params = None
         self.opt_state = None
         self.aux = None
@@ -256,8 +262,10 @@ class ParallelTrainer:
 
         def fwd(p):
             # cast INSIDE the differentiated fn: the cast's vjp upcasts
-            # gradients back to the f32 master params
-            vals = [cast(p[n]) if n in p else cast(batch[n])
+            # gradients back to the f32 master params. Index-valued
+            # inputs (self._no_cast) keep their exact dtype.
+            vals = [cast(p[n]) if n in p else
+                    (batch[n] if n in self._no_cast else cast(batch[n]))
                     for n in self.arg_names]
             outs, new_aux = self._graph_fn(vals, list(aux), True, rng)
             return tuple(outs), tuple(new_aux)
@@ -451,6 +459,11 @@ class ParallelTrainer:
 
         @functools.partial(jax.jit, out_shardings=repl)
         def _update(state, out, label):
+            if kind == "loss":
+                # loss-emitting heads (SoftmaxCELoss): the output IS
+                # the per-example loss; label unused (may be a dummy)
+                return (state[0] + jnp.sum(out.astype(jnp.float32)),
+                        state[1] + jnp.float32(out.size))
             lab = label.astype(jnp.int32)
             if kind == "acc":
                 ok = jnp.sum((jnp.argmax(out, axis=-1) == lab)
@@ -470,11 +483,6 @@ class ParallelTrainer:
                     axis=-1)[..., 0]
                 ok = jnp.sum(-jnp.log(jnp.maximum(
                     prob.astype(jnp.float32), 1e-30)))
-            elif kind == "loss":
-                # loss-emitting heads (SoftmaxCELoss): the output IS
-                # the per-example loss; label unused
-                return (state[0] + jnp.sum(out.astype(jnp.float32)),
-                        state[1] + jnp.float32(out.size))
             else:  # pragma: no cover
                 raise MXNetError("unknown device metric %r" % (kind,))
             return state[0] + ok, state[1] + jnp.float32(label.size)
@@ -537,18 +545,23 @@ class ParallelTrainer:
                 batch.update(zip(label_names, dbatch.label))
                 outs = self.step(batch)
                 if device_metric:
-                    # single-process: uncommitted host numpy, jit places
-                    # it with the other operands. Multi-process: each
-                    # process holds only its local label slice, so build
-                    # the GLOBAL sharded array the same way step() does
-                    # for data (_shard_batch assembles across processes)
-                    lab = dbatch.label[0]
-                    if isinstance(lab, NDArray):
-                        lab = lab._val
-                    lab = np.asarray(lab)
-                    if jax.process_count() > 1:
-                        lab = jax.make_array_from_process_local_data(
-                            self._data_sh[label_names[0]], lab)
+                    if dm_kind == "loss":
+                        # label unused by the accumulator — works for
+                        # label-free loss heads (MakeLoss-style) too
+                        lab = np.float32(0)
+                    else:
+                        # single-process: uncommitted host numpy, jit
+                        # places it with the other operands. Multi-
+                        # process: each process holds only its local
+                        # label slice, so build the GLOBAL sharded array
+                        # the same way step() does for data
+                        lab = dbatch.label[0]
+                        if isinstance(lab, NDArray):
+                            lab = lab._val
+                        lab = np.asarray(lab)
+                        if jax.process_count() > 1:
+                            lab = jax.make_array_from_process_local_data(
+                                self._data_sh[label_names[0]], lab)
                     with self.mesh:
                         acc_state = _acc_update(acc_state, outs[0], lab)
                     if dm_kind == "ce" and epoch == 0 and nbatch == 0 \

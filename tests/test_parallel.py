@@ -1076,6 +1076,57 @@ def test_fit_device_metric_topk_and_ce_match_host():
     assert abs(val_d - val_h) < 1e-5, (val_d, val_h)
 
 
+def test_bf16_compute_preserves_integer_inputs():
+    """compute_dtype='bfloat16' must not cast index-valued inputs:
+    bfloat16 spaces integers 4 apart near 1000, so casting labels or
+    embedding token ids silently retargets every id above 256 (999
+    becomes 1000). Pin: with class/token id 999, the updated bias row
+    and embedding row are EXACTLY row 999."""
+    nclass = 1024
+    # label path: FC logits over 1024 classes, every sample labelled 999
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    y = np.full((8,), 999.0, np.float32)
+    data = mx.symbol.Variable("data")
+    fc = mx.symbol.FullyConnected(data=data, name="fc",
+                                  num_hidden=nclass)
+    sym = mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+    tr = par.ParallelTrainer(
+        sym, {"data": (8, 16), "softmax_label": (8,)},
+        optimizer="sgd", mesh=par.data_parallel_mesh(),
+        compute_dtype="bfloat16",
+        optimizer_params={"learning_rate": 1.0})
+    tr.init_params({"fc_weight": mx.nd.zeros((nclass, 16)),
+                    "fc_bias": mx.nd.zeros((nclass,))})
+    tr.step({"data": x, "softmax_label": y})
+    bias = np.asarray(tr.params["fc_bias"])
+    assert int(np.argmax(bias)) == 999, int(np.argmax(bias))
+
+    # embedding path: token id 999 must update embedding row 999
+    vocab, E = 1024, 8
+    toks = np.full((4, 3), 999.0, np.float32)
+    lab = np.zeros((4, 3), np.float32)
+    d2 = mx.symbol.Variable("data")
+    emb = mx.symbol.Embedding(data=d2, input_dim=vocab, output_dim=E,
+                              name="embed")
+    fc2 = mx.symbol.FullyConnected(data=emb, num_hidden=4, name="fc2",
+                                   flatten=False)
+    flat = mx.symbol.Reshape(data=fc2, shape=(-1, 4), name="flat")
+    flab = mx.symbol.Reshape(data=mx.symbol.Variable("softmax_label"),
+                             shape=(-1,), name="flab")
+    sym2 = mx.symbol.SoftmaxOutput(data=flat, label=flab, name="softmax")
+    tr2 = par.ParallelTrainer(
+        sym2, {"data": (4, 3), "softmax_label": (4, 3)},
+        optimizer="sgd", mesh=par.data_parallel_mesh(),
+        compute_dtype="bfloat16",
+        optimizer_params={"learning_rate": 1.0})
+    tr2.init_params()
+    before = np.asarray(tr2.params["embed_weight"]).copy()
+    tr2.step({"data": toks, "softmax_label": lab})
+    after = np.asarray(tr2.params["embed_weight"])
+    changed = np.where(np.abs(after - before).sum(axis=1) > 1e-6)[0]
+    assert changed.tolist() == [999], changed.tolist()
+
+
 def test_fit_device_metric_ce_warns_on_logits_output(caplog):
     """device_metric cross-entropy assumes probability outputs; a symbol
     whose monitored output is raw scores (here LinearRegressionOutput,
