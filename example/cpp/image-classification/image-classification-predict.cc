@@ -1,0 +1,148 @@
+/* C++ image-classification deployment client over the native predict
+ * ABI — parity port of the reference example
+ * (/root/reference/example/cpp/image-classification/
+ *  image-classification-predict.cc): load a checkpoint
+ * (prefix-symbol.json + prefix-NNNN.params), read an image with OpenCV,
+ * forward it through libmxnet_tpu_predict.so, print the top-5 classes.
+ *
+ * Unlike the reference (hard-coded model paths), everything is a CLI
+ * argument:
+ *
+ *   ./image-classification-predict <symbol.json> <model.params> <image>
+ *                                  [synset.txt] [H W]
+ */
+#include <stdio.h>
+
+#include <algorithm>
+#include <fstream>
+#include <iostream>
+#include <numeric>
+#include <string>
+#include <vector>
+
+#include <opencv2/imgcodecs.hpp>
+#include <opencv2/imgproc.hpp>
+
+#include "../../../cpp/c_predict_api.h"
+
+namespace {
+
+// Read a whole file into memory (reference BufferFile equivalent).
+std::string ReadFile(const std::string &path) {
+  std::ifstream ifs(path, std::ios::in | std::ios::binary);
+  if (!ifs) {
+    std::cerr << "cannot open " << path << "\n";
+    exit(1);
+  }
+  return std::string(std::istreambuf_iterator<char>(ifs),
+                     std::istreambuf_iterator<char>());
+}
+
+// Optional label names, one per line (reference LoadSynset equivalent).
+std::vector<std::string> LoadSynset(const std::string &path) {
+  std::vector<std::string> out;
+  std::ifstream ifs(path);
+  if (!ifs) {
+    std::cerr << "cannot open synset " << path << " (pass '-' to skip)\n";
+    exit(1);
+  }
+  for (std::string line; std::getline(ifs, line);) out.push_back(line);
+  return out;
+}
+
+// image file -> float CHW in [0,255] RGB order, resized to (h, w)
+// (reference GetImageFile: BGR mean-subtract; here the Python-side
+// augmenter convention is RGB with normalization folded into the model
+// or applied by the caller).
+std::vector<float> LoadImageCHW(const std::string &path, int channels,
+                                int h, int w) {
+  cv::Mat im = cv::imread(path, channels == 1 ? cv::IMREAD_GRAYSCALE
+                                              : cv::IMREAD_COLOR);
+  if (im.empty()) {
+    std::cerr << "cannot read image " << path << "\n";
+    exit(1);
+  }
+  if (im.rows != h || im.cols != w)
+    cv::resize(im, im, cv::Size(w, h), 0, 0, cv::INTER_LINEAR);
+  if (channels == 3) cv::cvtColor(im, im, cv::COLOR_BGR2RGB);
+  std::vector<float> data(static_cast<size_t>(channels) * h * w);
+  for (int c = 0; c < channels; ++c)
+    for (int y = 0; y < h; ++y)
+      for (int x = 0; x < w; ++x)
+        data[(static_cast<size_t>(c) * h + y) * w + x] =
+            channels == 1 ? im.at<uchar>(y, x)
+                          : im.at<cv::Vec3b>(y, x)[c];
+  return data;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    std::cerr << "usage: " << argv[0]
+              << " symbol.json model.params image [synset.txt] [H W]\n";
+    return 2;
+  }
+  std::string sym_json = ReadFile(argv[1]);
+  std::string params = ReadFile(argv[2]);
+  std::vector<std::string> synset;
+  int h = 224, w = 224;
+  if (argc >= 5 && std::string(argv[4]) != "-") synset = LoadSynset(argv[4]);
+  if (argc >= 7) {
+    h = atoi(argv[5]);
+    w = atoi(argv[6]);
+  }
+  const int channels = 3;
+
+  // batch-1 NCHW input named "data" (the reference example's contract)
+  mx_uint shape[4] = {1, static_cast<mx_uint>(channels),
+                      static_cast<mx_uint>(h), static_cast<mx_uint>(w)};
+  const char *keys[] = {"data"};
+  mx_uint indptr[] = {0, 4};
+  PredictorHandle pred = nullptr;
+  if (MXTPredCreate(sym_json.c_str(), params.data(),
+                    static_cast<int>(params.size()), 1, 0, 1, keys, indptr,
+                    shape, &pred) != 0) {
+    std::cerr << "create failed: " << MXTPredGetLastError() << "\n";
+    return 1;
+  }
+
+  std::vector<float> image = LoadImageCHW(argv[3], channels, h, w);
+  if (MXTPredSetInput(pred, "data", image.data(),
+                      static_cast<mx_uint>(image.size())) != 0 ||
+      MXTPredForward(pred) != 0) {
+    std::cerr << "forward failed: " << MXTPredGetLastError() << "\n";
+    return 1;
+  }
+
+  mx_uint *oshape = nullptr, ondim = 0;
+  if (MXTPredGetOutputShape(pred, 0, &oshape, &ondim) != 0) {
+    std::cerr << "shape failed: " << MXTPredGetLastError() << "\n";
+    return 1;
+  }
+  size_t osize = 1;
+  for (mx_uint i = 0; i < ondim; ++i) osize *= oshape[i];
+  std::vector<float> out(osize);
+  if (MXTPredGetOutput(pred, 0, out.data(),
+                       static_cast<mx_uint>(osize)) != 0) {
+    std::cerr << "output failed: " << MXTPredGetLastError() << "\n";
+    return 1;
+  }
+  MXTPredFree(pred);
+
+  // top-5 (reference PrintOutputResult equivalent)
+  std::vector<int> idx(out.size());
+  std::iota(idx.begin(), idx.end(), 0);
+  std::partial_sort(idx.begin(),
+                    idx.begin() + std::min<size_t>(5, idx.size()),
+                    idx.end(),
+                    [&](int a, int b) { return out[a] > out[b]; });
+  for (size_t k = 0; k < std::min<size_t>(5, idx.size()); ++k) {
+    int i = idx[k];
+    std::cout << "top" << k + 1 << ": class=" << i << " prob=" << out[i];
+    if (i < static_cast<int>(synset.size()))
+      std::cout << " label=" << synset[i];
+    std::cout << "\n";
+  }
+  return 0;
+}

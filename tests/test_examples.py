@@ -186,3 +186,69 @@ def test_kaggle_ndsb1_gen_img_list(tmp_path):
     assert len(lst) + len(val) == 15
     classes = (tmp_path / "plk_classes.txt").read_text().splitlines()
     assert len(classes) == 3
+
+
+def test_cpp_image_classification_predict(tmp_path):
+    """The C++ deployment example (example/cpp/image-classification,
+    reference parity): build it, feed it a Python-trained checkpoint and
+    an OpenCV-written image, and check its top-1 against the Python
+    executor's prediction."""
+    import shutil
+
+    cv2 = pytest.importorskip("cv2")
+    np = pytest.importorskip("numpy")
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("no native toolchain")
+    import mxnet_tpu as mx
+
+    exdir = os.path.join(EX, "cpp", "image-classification")
+    r = subprocess.run(["make", "-C", exdir], capture_output=True,
+                       text=True, timeout=600)
+    if r.returncode != 0:
+        pytest.skip("cannot build example: " + r.stderr[-500:])
+
+    # tiny conv classifier with deterministic weights
+    data = mx.symbol.Variable("data")
+    conv = mx.symbol.Convolution(data=data, name="conv", num_filter=4,
+                                 kernel=(3, 3), stride=(2, 2))
+    act = mx.symbol.Activation(data=conv, name="relu", act_type="relu")
+    fl = mx.symbol.Flatten(data=act)
+    fc = mx.symbol.FullyConnected(data=fl, name="fc", num_hidden=3)
+    sym = mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+    h = w = 16
+    shapes = {"data": (1, 3, h, w), "softmax_label": (1,)}
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    rng = np.random.RandomState(3)
+    arg_params = {}
+    for name, arr in exe.arg_dict.items():
+        if name not in shapes:
+            v = rng.uniform(-0.5, 0.5, arr.shape).astype(np.float32)
+            arr[:] = v
+            arg_params[name] = mx.nd.array(v)
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 1, sym, arg_params, {})
+
+    # image on disk -> the exact float CHW the C++ client reconstructs
+    img_hwc = (rng.rand(h, w, 3) * 255).astype(np.uint8)
+    img_path = str(tmp_path / "in.png")  # png: lossless round trip
+    cv2.imwrite(img_path, cv2.cvtColor(img_hwc, cv2.COLOR_RGB2BGR))
+    x = img_hwc.astype(np.float32).transpose(2, 0, 1)[None]
+    exe.forward(is_train=False, data=x)
+    want_cls = int(np.argmax(exe.outputs[0].asnumpy()[0]))
+
+    synset = str(tmp_path / "synset.txt")
+    with open(synset, "w") as f:
+        f.write("cat\ndog\nfish\n")
+    env = dict(os.environ, MXNET_TPU_PREDICT_NUMPY="1",
+               PYTHONPATH=ROOT + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run(
+        [os.path.join(exdir, "image-classification-predict"),
+         prefix + "-symbol.json", prefix + "-0001.params", img_path,
+         synset, str(h), str(w)],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    top1 = [ln for ln in r.stdout.splitlines() if ln.startswith("top1:")]
+    assert top1, r.stdout
+    assert "class=%d" % want_cls in top1[0], (r.stdout, want_cls)
+    assert "label=" + ["cat", "dog", "fish"][want_cls] in top1[0]
