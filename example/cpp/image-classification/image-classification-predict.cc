@@ -88,9 +88,17 @@ int main(int argc, char **argv) {
   std::vector<std::string> synset;
   int h = 224, w = 224;
   if (argc >= 5 && std::string(argv[4]) != "-") synset = LoadSynset(argv[4]);
+  if (argc == 6) {
+    std::cerr << "H given without W (pass both, e.g. 224 224)\n";
+    return 2;
+  }
   if (argc >= 7) {
     h = atoi(argv[5]);
     w = atoi(argv[6]);
+    if (h <= 0 || w <= 0) {
+      std::cerr << "bad input size " << argv[5] << "x" << argv[6] << "\n";
+      return 2;
+    }
   }
   const int channels = 3;
 

@@ -32,9 +32,11 @@ def main():
         layout = os.environ.get("AB_LOSS_LAYOUT", "reference")
         seq = int(os.environ.get("AB_SEQ", 1024))
         vocab = int(os.environ.get("AB_VOCAB", 32000))
-        sym = get_transformer_lm(vocab, num_layers=12, embed_dim=768,
-                                 num_heads=heads, impl=impl,
-                                 loss_layout=layout)
+        layers = int(os.environ.get("AB_LAYERS", 12))
+        embed = int(os.environ.get("AB_EMBED", 768))
+        sym = get_transformer_lm(vocab, num_layers=layers,
+                                 embed_dim=embed, num_heads=heads,
+                                 impl=impl, loss_layout=layout)
         shapes = {"data": (batch, seq), "softmax_label": (batch, seq)}
         n_classes, int_data = vocab, True
     else:
