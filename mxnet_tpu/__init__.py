@@ -60,8 +60,15 @@ from . import image_io  # noqa: E402
 from .image_io import ImageRecordIter, DeviceAugmentIter  # noqa: E402
 from . import distributed  # noqa: E402
 from . import visualization  # noqa: E402
+# reference short aliases (/root/reference/python/mxnet/__init__.py):
+# mx.init, mx.viz, mx.mon, mx.rnd, mx.th
+from . import initializer as init  # noqa: E402
+from . import visualization as viz  # noqa: E402
+from . import monitor as mon  # noqa: E402
+from . import random as rnd  # noqa: E402
 from . import rtc  # noqa: E402
 from . import torch  # noqa: E402
+from . import torch as th  # noqa: E402
 from . import predict  # noqa: E402
 from .predict import Predictor  # noqa: E402
 

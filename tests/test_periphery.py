@@ -264,3 +264,15 @@ def test_profiler_benchmark_chain():
 
     with pytest.raises(TypeError):
         mx.profiler.benchmark_chain(step, x0, 8)  # steps is kw-only
+
+
+def test_reference_module_aliases():
+    """The reference package exposes short aliases (mx.init, mx.viz,
+    mx.mon, mx.rnd, mx.th, mx.nd, mx.sym, mx.kv —
+    /root/reference/python/mxnet/__init__.py); scripts using them port
+    unchanged."""
+    for alias, mod in [("init", "initializer"), ("viz", "visualization"),
+                       ("mon", "monitor"), ("rnd", "random"),
+                       ("th", "torch"), ("nd", "ndarray"),
+                       ("sym", "symbol"), ("kv", "kvstore")]:
+        assert getattr(mx, alias) is getattr(mx, mod), alias
