@@ -203,6 +203,47 @@ def bench_transformer_lm(batch=8, seq=1024, layers=12, embed=768,
     return tps, mfu
 
 
+def bench_decode(batch=8, prompt=64, steps=64, layers=12, embed=768,
+                 heads=12, vocab=32000, max_len=1024):
+    """KV-cache autoregressive decode (parallel/decode.py): per-token
+    latency of the 124M LM generating with donated caches, the whole
+    loop one compiled lax.scan program. Timed as the N-vs-2N-steps
+    difference (prefill and dispatch cancel)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.models import get_transformer_lm
+    from mxnet_tpu.parallel import Decoder
+
+    sym = get_transformer_lm(vocab, num_layers=layers, embed_dim=embed,
+                             num_heads=heads, impl="flash")
+    rng = np.random.RandomState(0)
+    shapes = {"data": (batch, max_len), "softmax_label": (batch, max_len)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    params = {n: jnp.asarray(rng.uniform(-0.05, 0.05, s)
+                             .astype(np.float32))
+              for n, s in zip(sym.list_arguments(), arg_shapes)
+              if n not in shapes}
+    dec = Decoder(sym, params, max_len=max_len,
+                  compute_dtype="bfloat16")
+    ptoks = rng.randint(0, vocab, (batch, prompt))
+
+    def run(n):
+        tic = time.perf_counter()
+        np.asarray(dec.generate(ptoks, n))
+        return time.perf_counter() - tic
+
+    run(steps)
+    run(2 * steps)  # compile both programs
+    best = None
+    for _ in range(3):
+        t1, t2 = run(steps), run(2 * steps)
+        if t2 - t1 > 0.02 * t1:
+            per_tok = (t2 - t1) / steps
+            best = per_tok if best is None else min(best, per_tok)
+    if best is None:
+        return None, None
+    return batch / best, best * 1e3
+
+
 def bench_recordio_io():
     """C++ ImageRecordIOIter: run tools/bench_io.py in a CLEAN
     subprocess (no jax): on this 1-core container the jax/axon runtime
@@ -327,6 +368,7 @@ def main():
     # MXU — not framework-limited)
     lm350_tps, lm350_mfu = bench_transformer_lm(layers=24, embed=1024,
                                                 heads=16, steps=6)
+    dec_tps, dec_ms = bench_decode()
     io_modes, io_contended = bench_recordio_io()
 
     def vs_ceiling(nominal_mfu):
@@ -347,6 +389,13 @@ def main():
         "transformer_lm_mfu_vs_measured_ceiling": vs_ceiling(lm_mfu),
         "transformer_lm_350M_T1024_tokens_per_sec": round(lm350_tps, 0),
         "transformer_lm_350M_mfu_nominal": round(lm350_mfu, 3),
+        "decode_124M_kvcache_b8": None if dec_tps is None else {
+            "tokens_per_sec": round(dec_tps, 0),
+            "ms_per_token": round(dec_ms, 2),
+            "caveat": "HBM-bound (reads all params per token); "
+                      "KV-cache greedy decode, whole loop one "
+                      "compiled lax.scan program, bf16",
+        },
         "calibration": {
             "gemm_8192_bf16_tflops":
                 None if ceiling is None else round(ceiling / 1e12, 1),

@@ -29,3 +29,4 @@ from .ring import (ring_attention, blockwise_attention,  # noqa: F401
                    ring_self_attention, striped_ring_attention)
 from .pipeline import (pipeline_spmd, partition_stages,  # noqa: F401
                        PipelineTrainer)
+from .decode import Decoder  # noqa: F401

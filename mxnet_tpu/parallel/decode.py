@@ -1,0 +1,307 @@
+"""KV-cache autoregressive decoding for Symbol-built transformer LMs.
+
+The training graph computes all T positions at once; generation needs one
+position at a time against everything decoded so far. Rather than asking
+users to write a second, incremental model (and keep it in sync with the
+training symbol), ``Decoder`` DERIVES the incremental program from the
+same Symbol graph the trainer compiled: the topological walk of
+``parallel.graph.make_graph_fn`` re-runs with every ``MultiHeadAttention``
+node swapped for a cached variant (new tokens' K/V written into a
+[B, max_len, H, D] ring of buffers with ``lax.dynamic_update_slice``;
+queries attend to the cache under the mask ``key_pos <= query_pos``) and
+``PositionalEmbedding`` sliced at the current position. Every other LM op
+(Embedding, LayerNorm, FullyConnected, activations, elementwise
+arithmetic, MoEFFN, BatchNorm-with-moving-stats) is position-wise and
+runs its ordinary ``OpSpec.forward`` unchanged, so there is no duplicated
+model math to drift.
+
+TPU-native shape discipline: cache buffers are statically ``max_len``
+long (no growing shapes — one compiled program serves every step),
+prefill processes the whole prompt as one chunk, and ``generate`` runs
+the entire decode loop as a single ``lax.scan`` program with donated
+caches — one dispatch for N tokens, which matters through a
+high-latency link (doc/performance.md).
+
+No reference counterpart: the reference's generation story is the
+explicitly unrolled LSTM sampler (/root/reference/example/rnn/lstm.py,
+char-rnn inference); attention-era decoding is a TPU-build extension.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+
+__all__ = ["Decoder"]
+
+# ops whose forward acts independently per position on [B, C, ...] data
+# (safe to run unchanged on a chunk of C new tokens)
+_POSITIONWISE = {
+    "Embedding", "LayerNorm", "FullyConnected", "Activation", "LeakyReLU",
+    "MoEFFN", "Dropout", "BlockGrad", "Cast", "ElementWiseSum",
+    "BatchNorm",
+    "_Plus", "_Minus", "_Mul", "_Div", "_PlusScalar", "_MinusScalar",
+    "_MulScalar", "_DivScalar", "_RMinusScalar", "_RDivScalar",
+}
+# handled specially
+_TEMPORAL = {"MultiHeadAttention", "PositionalEmbedding"}
+
+_LOSS_HEADS = {"SoftmaxOutput", "SoftmaxCELoss"}
+
+
+def _logits_symbol(symbol):
+    """Re-head a loss-ended LM at its [B, T, V] logits: strip the loss
+    node, then the layout ops the loss variants insert between the head
+    GEMM and the loss (SwapAxis for the reference's multi_output [B,V,T]
+    layout; Reshape for the flat/ce [B*T,V] layouts)."""
+    heads = symbol._heads
+    if len(heads) == 1 and not heads[0][0].is_var \
+            and heads[0][0].spec.name in _LOSS_HEADS:
+        node = heads[0][0].inputs[0][0]
+        while not node.is_var \
+                and node.spec.name in ("SwapAxis", "Reshape", "Flatten"):
+            node = node.inputs[0][0]
+        return symbol.get_internals()[node.name + "_output"]
+    return symbol
+
+
+class Decoder:
+    """Autoregressive KV-cache decoder over a Symbol LM.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        The LM graph — either logits-headed or ending in
+        SoftmaxOutput/SoftmaxCELoss (the loss head is stripped
+        automatically, like ``Predictor`` does for deployment).
+    params : dict[str, array]
+        Parameter values by name (e.g. ``trainer.params`` or the
+        ``arg_params`` of a loaded checkpoint).
+    max_len : int
+        Static cache length: prompt length + generated tokens must stay
+        within it (and within the trained ``pos_embed`` table).
+    aux_params : dict[str, array], optional
+        Auxiliary states (BatchNorm moving stats) for graphs that carry
+        them; evaluated frozen, as in inference.
+    compute_dtype : str, optional
+        Cast floating parameters (and caches) for the decode math, e.g.
+        ``"bfloat16"``; token ids are integer-semantic and never cast.
+    """
+
+    def __init__(self, symbol, params, max_len, aux_params=None,
+                 compute_dtype=None):
+        symbol = _logits_symbol(symbol)
+        self._topo = symbol._topo()
+        self._heads = symbol._heads
+        if len(self._heads) != 1:
+            raise MXNetError("Decoder needs a single-output symbol, got %d"
+                             % len(self._heads))
+        self.max_len = int(max_len)
+
+        self._mha = []
+        for n in self._topo:
+            if n.is_var:
+                continue
+            name = n.spec.name
+            if name == "MultiHeadAttention":
+                if not n.params["causal"]:
+                    raise MXNetError(
+                        "Decoder: attention node %r is non-causal — "
+                        "autoregressive decoding is defined only for "
+                        "causal attention" % n.name)
+                self._mha.append(n)
+            elif name in _TEMPORAL or name in _POSITIONWISE:
+                pass
+            else:
+                raise MXNetError(
+                    "Decoder: op %s (node %r) is not known to be "
+                    "position-wise; the decode transform supports the "
+                    "standard LM ops (%s)"
+                    % (name, n.name, ", ".join(sorted(_POSITIONWISE))))
+
+        arg_names = [n.name for n in self._topo if n.is_var]
+        self._data_name = "data" if "data" in arg_names else arg_names[0]
+        missing = [a for a in arg_names
+                   if a != self._data_name and a not in params]
+        if missing:
+            raise MXNetError("Decoder: missing parameter values for %s"
+                             % missing)
+        cast = (lambda v: v) if compute_dtype is None else (
+            lambda v: v.astype(compute_dtype)
+            if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating) else v)
+        self._params = {a: cast(jnp.asarray(params[a]))
+                        for a in arg_names if a != self._data_name}
+        self._aux = [cast(jnp.asarray((aux_params or {})[a]))
+                     for a in symbol.list_auxiliary_states()] \
+            if symbol.list_auxiliary_states() else []
+        self._cache_dtype = compute_dtype or "float32"
+
+        # pos_embed bounds the decodable length
+        for n in self._topo:
+            if not n.is_var and n.spec.name == "PositionalEmbedding":
+                pos_param = n.inputs[1][0].name
+                rows = self._params[pos_param].shape[0]
+                if rows < self.max_len:
+                    raise MXNetError(
+                        "Decoder: max_len=%d exceeds the %d trained "
+                        "positions of %r" % (self.max_len, rows,
+                                             pos_param))
+
+        # params/aux pass as explicit jit arguments: closed-over
+        # arrays would be baked into the HLO as literal constants
+        # (program bloat + slow compiles at 100M+ params)
+        self._step_jit = jax.jit(self._run, donate_argnums=(2,))
+        self._gen_jit = {}
+
+    # -- cache ----------------------------------------------------------
+    def init_cache(self, batch_size):
+        """Zeroed K/V buffers, [B, max_len, H, D] per attention node."""
+        caches = []
+        for n in self._mha:
+            e = self._params[n.inputs[1][0].name].shape[1]  # qkv [3E, E]
+            h = n.params["num_heads"]
+            shape = (batch_size, self.max_len, h, e // h)
+            caches.append((jnp.zeros(shape, self._cache_dtype),
+                           jnp.zeros(shape, self._cache_dtype)))
+        return caches
+
+    # -- the derived incremental walk -----------------------------------
+    def _cached_mha(self, node, ins, ck, cv, pos):
+        x, wqkv, bqkv, wo, bo = ins
+        b, c, e = x.shape
+        h = node.params["num_heads"]
+        d = e // h
+        qkv = jnp.einsum("bte,fe->btf", x, wqkv) + bqkv
+        q, k, v = [z.reshape(b, c, h, d)
+                   for z in jnp.split(qkv, 3, axis=-1)]
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                      (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                      (0, pos, 0, 0))
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / float(np.sqrt(d))
+        kpos = jnp.arange(self.max_len)[None, None, None, :]
+        qpos = pos + jnp.arange(c)[None, None, :, None]
+        s = jnp.where(kpos <= qpos, s,
+                      jnp.float32(-1e30).astype(s.dtype))
+        o = jnp.einsum("bhqk,bkhd->bqhd",
+                       jax.nn.softmax(s, axis=-1), cv)
+        return jnp.einsum("bte,fe->btf", o.reshape(b, c, e), wo) + bo, \
+            ck, cv
+
+    def _run(self, params, aux, caches, pos, tokens):
+        """One chunk: tokens [B, C] at positions [pos, pos+C) →
+        (logits [B, C, V], updated caches)."""
+        env = {}
+        new_caches = list(caches)
+        mha_i = 0
+        aux_cursor = 0
+        rng = jax.random.PRNGKey(0)
+        for i, n in enumerate(self._topo):
+            if n.is_var:
+                env[(id(n), 0)] = tokens if n.name == self._data_name \
+                    else params[n.name]
+                continue
+            ins = [env[(id(inp), idx)] for inp, idx in n.inputs]
+            name = n.spec.name
+            if name == "MultiHeadAttention":
+                ck, cv = new_caches[mha_i]
+                out, ck, cv = self._cached_mha(n, ins, ck, cv, pos)
+                new_caches[mha_i] = (ck, cv)
+                mha_i += 1
+                env[(id(n), 0)] = out
+                continue
+            if name == "PositionalEmbedding":
+                x, posp = ins
+                rows = lax.dynamic_slice(
+                    posp, (pos, 0), (x.shape[1], posp.shape[1]))
+                env[(id(n), 0)] = x + rows[None]
+                continue
+            n_aux = len(n.spec.aux_states(n.params))
+            aux_in = aux[aux_cursor:aux_cursor + n_aux]
+            aux_cursor += n_aux
+            outs, _ = n.spec.forward(n.params, ins, aux_in, False,
+                                     jax.random.fold_in(rng, i))
+            for j, o in enumerate(outs):
+                env[(id(n), j)] = o
+        head, idx = self._heads[0]
+        return env[(id(head), idx)], new_caches
+
+    # -- user API -------------------------------------------------------
+    def prefill(self, caches, tokens):
+        """Process a [B, P] prompt chunk from position 0; returns
+        (logits [B, P, V], caches)."""
+        return self._step_jit(self._params, self._aux, caches, 0,
+                              jnp.asarray(tokens).astype(jnp.int32))
+
+    def step(self, caches, pos, token):
+        """One token per sequence: token [B] at position ``pos`` →
+        (logits [B, V], caches)."""
+        logits, caches = self._step_jit(
+            self._params, self._aux, caches, pos,
+            jnp.asarray(token).astype(jnp.int32)[:, None])
+        return logits[:, 0], caches
+
+    def generate(self, prompt, num_steps, rng=None, temperature=0.0,
+                 return_cache=False):
+        """Greedy (``temperature=0``) or sampled continuation.
+
+        prompt: [B, P] token ids. Returns [B, P + num_steps] int32 —
+        prompt followed by generated ids — or ``(tokens, caches)`` with
+        ``return_cache=True``. The returned caches hold K/V through
+        position ``P + num_steps - 1`` (the last returned token's slot);
+        to continue, RE-step that last token at its own position —
+
+            logits, caches = dec.step(caches, P + num_steps - 1,
+                                      tokens[:, -1])
+
+        — which rewrites its K/V slot with identical values (idempotent)
+        and yields the logits for the next position; from there loop
+        ``step`` forward as usual (pinned by
+        ``tests/test_decode.py::test_generate_resume``). The decode loop
+        is ONE compiled ``lax.scan`` program (per (B, P, num_steps)
+        shape); cache buffers are donated through it.
+        """
+        prompt = jnp.asarray(prompt).astype(jnp.int32)
+        b, p = prompt.shape
+        if p + num_steps > self.max_len:
+            raise MXNetError(
+                "Decoder: prompt %d + steps %d exceeds max_len %d"
+                % (p, num_steps, self.max_len))
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        key = (b, p, int(num_steps), float(temperature))
+        if key not in self._gen_jit:
+            self._gen_jit[key] = self._build_generate(
+                p, int(num_steps), float(temperature))
+        toks, caches = self._gen_jit[key](self._params, self._aux,
+                                          self.init_cache(b), prompt, rng)
+        return (toks, caches) if return_cache else toks
+
+    def _build_generate(self, p, num_steps, temperature):
+        def pick(logits, rng):
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                rng, logits.astype(jnp.float32) / temperature,
+                axis=-1).astype(jnp.int32)
+
+        def gen(params, aux, caches, prompt, rng):
+            logits, caches = self._run(params, aux, caches, 0, prompt)
+            tok = pick(logits[:, -1], jax.random.fold_in(rng, 0))
+
+            def body(carry, i):
+                caches, tok = carry
+                logits, caches = self._run(params, aux, caches,
+                                           p + i, tok[:, None])
+                nxt = pick(logits[:, 0],
+                           jax.random.fold_in(rng, i + 1))
+                return (caches, nxt), tok
+
+            (caches, _), toks = lax.scan(body, (caches, tok),
+                                         jnp.arange(num_steps))
+            return jnp.concatenate([prompt, toks.T], axis=1), caches
+
+        return jax.jit(gen, donate_argnums=(2,))

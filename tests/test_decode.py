@@ -1,0 +1,221 @@
+"""KV-cache decoder (parallel/decode.py): the incremental program derived
+from the Symbol graph must match the full dense forward bit-for-bit in
+what it argmaxes — the oracle is the ordinary training graph itself
+(make_graph_fn), so any drift between cached and full attention math
+fails here."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_transformer_lm
+from mxnet_tpu.parallel import Decoder, make_graph_fn
+
+VOCAB, LAYERS, EMBED, HEADS = 17, 2, 16, 2
+
+
+def _lm(impl="dense", **kw):
+    return get_transformer_lm(VOCAB, num_layers=LAYERS, embed_dim=EMBED,
+                              num_heads=HEADS, impl=impl, **kw)
+
+
+def _init_params(sym, seq_len, batch, rng):
+    shapes = {"data": (batch, seq_len)}
+    if "softmax_label" in sym.list_arguments():
+        shapes["softmax_label"] = (batch, seq_len)
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    return {n: jnp.asarray(rng.uniform(-0.3, 0.3, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in shapes}
+
+
+def _full_logits(sym, params, tokens):
+    """Oracle: full forward of the logits head on the whole sequence."""
+    logits_sym = sym.get_internals()["lm_head_output"]
+    fn = make_graph_fn(logits_sym)
+    args = [params[n] if n != "data" else jnp.asarray(tokens, jnp.float32)
+            for n in logits_sym.list_arguments()]
+    outs, _ = fn(args, [], False, jax.random.PRNGKey(0))
+    return np.asarray(outs[0])  # [B, T, V]
+
+
+def test_decode_matches_full_forward():
+    """Greedy generate == iterated full-forward argmax, and the cached
+    logits equal the full-forward logits at every decoded position."""
+    rng = np.random.RandomState(0)
+    T = 12
+    sym = _lm()
+    params = _init_params(sym, T, 2, rng)
+    dec = Decoder(sym, params, max_len=T)
+
+    prompt = rng.randint(0, VOCAB, (2, 4))
+    out = np.asarray(dec.generate(prompt, num_steps=6))
+    assert out.shape == (2, 10)
+    np.testing.assert_array_equal(out[:, :4], prompt)
+
+    # oracle: grow the sequence one token at a time with FULL forwards
+    seq = prompt.copy()
+    for _ in range(6):
+        logits = _full_logits(sym, params, np.pad(
+            seq, ((0, 0), (0, T - seq.shape[1]))))
+        nxt = logits[:, seq.shape[1] - 1].argmax(-1)
+        seq = np.concatenate([seq, nxt[:, None].astype(seq.dtype)], 1)
+    np.testing.assert_array_equal(out, seq)
+
+
+def test_decode_logits_close_to_full():
+    """prefill+step logits agree numerically with the full forward."""
+    rng = np.random.RandomState(1)
+    T = 10
+    sym = _lm()
+    params = _init_params(sym, T, 3, rng)
+    dec = Decoder(sym, params, max_len=T)
+
+    toks = rng.randint(0, VOCAB, (3, T))
+    want = _full_logits(sym, params, toks)
+
+    caches = dec.init_cache(3)
+    got_pre, caches = dec.prefill(caches, toks[:, :6])
+    np.testing.assert_allclose(np.asarray(got_pre), want[:, :6],
+                               rtol=1e-5, atol=1e-5)
+    pos = 6
+    for t in range(6, T):
+        logits, caches = dec.step(caches, pos, toks[:, t])
+        np.testing.assert_allclose(np.asarray(logits), want[:, t],
+                                   rtol=1e-5, atol=1e-5)
+        pos += 1
+
+
+def test_decode_loss_headed_and_flash_symbol():
+    """Loss-headed symbols re-head at the logits automatically, and the
+    decoder is impl-agnostic (flash trains, cached-dense decodes)."""
+    rng = np.random.RandomState(2)
+    T = 8
+    plain = _lm()
+    for kw in (dict(), dict(loss_layout="ce")):
+        sym = get_transformer_lm(VOCAB, num_layers=LAYERS,
+                                 embed_dim=EMBED, num_heads=HEADS,
+                                 impl="flash", **kw)
+        params = _init_params(sym, T, 2, rng)
+        dec = Decoder(sym, params, max_len=T)
+        prompt = rng.randint(0, VOCAB, (2, 3))
+        out = np.asarray(dec.generate(prompt, num_steps=4))
+        # same params through the plain dense graph give the same tokens
+        oracle = Decoder(plain, params, max_len=T)
+        np.testing.assert_array_equal(
+            out, np.asarray(oracle.generate(prompt, num_steps=4)))
+
+
+def test_decode_sampling_and_determinism():
+    rng = np.random.RandomState(3)
+    T = 8
+    sym = _lm()
+    params = _init_params(sym, T, 2, rng)
+    dec = Decoder(sym, params, max_len=T)
+    prompt = rng.randint(0, VOCAB, (2, 2))
+    k = jax.random.PRNGKey(7)
+    a = np.asarray(dec.generate(prompt, 5, rng=k, temperature=1.0))
+    b = np.asarray(dec.generate(prompt, 5, rng=k, temperature=1.0))
+    np.testing.assert_array_equal(a, b)  # same key, same draw
+    c = np.asarray(dec.generate(prompt, 5, rng=jax.random.PRNGKey(8),
+                                temperature=1.0))
+    assert a.shape == c.shape == (2, 7)
+    assert (a >= 0).all() and (a < VOCAB).all()
+
+
+def test_decode_errors():
+    rng = np.random.RandomState(4)
+    sym = _lm()
+    params = _init_params(sym, 8, 1, rng)
+
+    # max_len beyond the trained positional table
+    with pytest.raises(mx.MXNetError, match="max_len"):
+        Decoder(sym, params, max_len=64)
+
+    # prompt + steps beyond max_len
+    dec = Decoder(sym, params, max_len=8)
+    with pytest.raises(mx.MXNetError, match="exceeds max_len"):
+        dec.generate(np.zeros((1, 5), np.int64), num_steps=4)
+
+    # non-causal attention refuses to decode
+    import mxnet_tpu.symbol as S
+    d = S.Variable("data")
+    e = S.Embedding(data=d, input_dim=VOCAB, output_dim=EMBED,
+                    name="embed")
+    att = S.MultiHeadAttention(
+        data=e, qkv_weight=S.Variable("a_qkv_weight"),
+        qkv_bias=S.Variable("a_qkv_bias"),
+        out_weight=S.Variable("a_proj_weight"),
+        out_bias=S.Variable("a_proj_bias"),
+        num_heads=HEADS, causal=False, impl="dense", name="a")
+    head = S.FullyConnected(data=att, num_hidden=VOCAB, flatten=False,
+                            name="lm_head")
+    ncp = {"embed_weight": jnp.zeros((VOCAB, EMBED)),
+           "a_qkv_weight": jnp.zeros((3 * EMBED, EMBED)),
+           "a_qkv_bias": jnp.zeros((3 * EMBED,)),
+           "a_proj_weight": jnp.zeros((EMBED, EMBED)),
+           "a_proj_bias": jnp.zeros((EMBED,)),
+           "lm_head_weight": jnp.zeros((VOCAB, EMBED)),
+           "lm_head_bias": jnp.zeros((VOCAB,))}
+    with pytest.raises(mx.MXNetError, match="non-causal"):
+        Decoder(head, ncp, max_len=4)
+
+    # unsupported (non-positionwise) op refuses loudly
+    conv = S.Convolution(data=S.Variable("data"), num_filter=2,
+                         kernel=(1, 1), name="c",
+                         weight=S.Variable("c_weight"),
+                         bias=S.Variable("c_bias"))
+    with pytest.raises(mx.MXNetError, match="position-wise"):
+        Decoder(conv, {"c_weight": jnp.zeros((2, 1, 1, 1)),
+                       "c_bias": jnp.zeros((2,))}, max_len=4)
+
+
+def test_decode_moe_lm():
+    """MoE blocks decode too (MoEFFN is position-wise)."""
+    rng = np.random.RandomState(5)
+    T = 8
+    sym = get_transformer_lm(VOCAB, num_layers=1, embed_dim=EMBED,
+                             num_heads=HEADS, impl="dense",
+                             num_experts=2, moe_top_k=1)
+    params = _init_params(sym, T, 2, rng)
+    dec = Decoder(sym, params, max_len=T)
+    prompt = rng.randint(0, VOCAB, (2, 3))
+    out = np.asarray(dec.generate(prompt, num_steps=4))
+
+    seq = prompt.copy()
+    for _ in range(4):
+        logits = _full_logits(sym, params, np.pad(
+            seq, ((0, 0), (0, T - seq.shape[1]))))
+        nxt = logits[:, seq.shape[1] - 1].argmax(-1)
+        seq = np.concatenate([seq, nxt[:, None].astype(seq.dtype)], 1)
+    np.testing.assert_array_equal(out, seq)
+
+
+def test_generate_resume():
+    """return_cache=True resumption recipe (docstring): re-step the last
+    returned token at its own position, then continue — the resumed
+    continuation must equal one longer uninterrupted generate."""
+    rng = np.random.RandomState(6)
+    T = 14
+    sym = _lm()
+    params = _init_params(sym, T, 2, rng)
+    dec = Decoder(sym, params, max_len=T)
+    prompt = rng.randint(0, VOCAB, (2, 3))
+    P = prompt.shape[1]
+
+    full = np.asarray(dec.generate(prompt, num_steps=8))
+
+    short, caches = dec.generate(prompt, num_steps=4, return_cache=True)
+    short = np.asarray(short)
+    np.testing.assert_array_equal(short, full[:, :P + 4])
+    seq = short
+    pos = P + 4 - 1
+    logits, caches = dec.step(caches, pos, seq[:, -1])
+    for _ in range(4):
+        nxt = np.asarray(jnp.argmax(logits, -1)).astype(np.int32)
+        seq = np.concatenate([seq, nxt[:, None]], 1)
+        pos += 1
+        logits, caches = dec.step(caches, pos, nxt)
+    np.testing.assert_array_equal(seq, full)

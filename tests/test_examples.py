@@ -252,3 +252,14 @@ def test_cpp_image_classification_predict(tmp_path):
     assert top1, r.stdout
     assert "class=%d" % want_cls in top1[0], (r.stdout, want_cls)
     assert "label=" + ["cat", "dog", "fish"][want_cls] in top1[0]
+
+
+def test_long_context_generate():
+    """KV-cache decoding example: train the cycle LM, generate, and the
+    greedy continuation must reproduce the pattern."""
+    r = _run("long-context", "generate.py", "--batches", "60")
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = r.stderr + r.stdout
+    acc = [ln for ln in out.splitlines() if "pattern accuracy" in ln]
+    assert acc, out[-1000:]
+    assert float(acc[-1].split()[-1]) >= 0.9, acc[-1]
