@@ -196,6 +196,33 @@ class Adam(Optimizer):
 
 
 @register
+class AdamW(Adam):
+    """Adam with DECOUPLED weight decay (Loshchilov & Hutter 2017) — the
+    transformer-training standard. No reference counterpart (2015): the
+    reference's Adam folds wd into the gradient (L2), which interacts
+    with the adaptive denominator; AdamW applies decay directly to the
+    weight, scaled by the schedule lr but not by lr_t's bias correction.
+    """
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        self._update_count(index)
+        mean, var = state
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        g = self._clip_rescale(grad._val)  # NO wd coupling
+        new_mean = self.beta1 * mean._val + (1 - self.beta1) * g
+        new_var = self.beta2 * var._val + (1 - self.beta2) * g * g
+        weight._set(weight._val
+                    - lr_t * new_mean / (jnp.sqrt(new_var) + self.epsilon)
+                    - lr * self.wd * weight._val)
+        mean._set(new_mean)
+        var._set(new_var)
+
+
+@register
 class AdaGrad(Optimizer):
     """AdaGrad (reference optimizer.py:550; Duchi et al. 2011)."""
 

@@ -134,9 +134,13 @@ class Decoder:
             if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating) else v)
         self._params = {a: cast(jnp.asarray(params[a]))
                         for a in arg_names if a != self._data_name}
-        self._aux = [cast(jnp.asarray((aux_params or {})[a]))
-                     for a in symbol.list_auxiliary_states()] \
-            if symbol.list_auxiliary_states() else []
+        aux_names = symbol.list_auxiliary_states()
+        missing_aux = [a for a in aux_names if a not in (aux_params or {})]
+        if missing_aux:
+            raise MXNetError("Decoder: missing aux_params values for %s "
+                             "(pass the checkpoint's aux_params, e.g. "
+                             "BatchNorm moving stats)" % missing_aux)
+        self._aux = [cast(jnp.asarray(aux_params[a])) for a in aux_names]
         self._cache_dtype = compute_dtype or "float32"
 
         # pos_embed bounds the decodable length
@@ -155,6 +159,26 @@ class Decoder:
         # (program bloat + slow compiles at 100M+ params)
         self._step_jit = jax.jit(self._run, donate_argnums=(2,))
         self._gen_jit = {}
+        self._auto_key = 0  # advances per sampled generate(rng=None)
+
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, max_len, **kwargs):
+        """Build a decoder straight from a saved checkpoint
+        (``prefix-symbol.json`` + ``prefix-NNNN.params``, the reference
+        format — so a FeedForward/ParallelTrainer-trained LM decodes
+        without re-describing the model)."""
+        from ..model import load_checkpoint
+
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+
+        def to_np(v):
+            return v.asnumpy() if hasattr(v, "asnumpy") else v
+
+        return cls(symbol, {k: to_np(v) for k, v in arg_params.items()},
+                   max_len,
+                   aux_params={k: to_np(v)
+                               for k, v in aux_params.items()},
+                   **kwargs)
 
     # -- cache ----------------------------------------------------------
     def init_cache(self, batch_size):
@@ -230,15 +254,27 @@ class Decoder:
         return env[(id(head), idx)], new_caches
 
     # -- user API -------------------------------------------------------
+    @staticmethod
+    def clone_cache(caches):
+        """Deep-copy cache buffers — needed to BRANCH from one prefix,
+        because prefill/step DONATE their cache argument (see below)."""
+        return jax.tree_util.tree_map(jnp.copy, caches)
+
     def prefill(self, caches, tokens):
         """Process a [B, P] prompt chunk from position 0; returns
-        (logits [B, P, V], caches)."""
+        (logits [B, P, V], caches).
+
+        The input ``caches`` are DONATED to the compiled step (the
+        per-token update writes in place — no cache-sized copy per
+        step) and are invalid afterwards; always continue with the
+        RETURNED caches, and ``clone_cache`` first to keep a branch
+        point alive."""
         return self._step_jit(self._params, self._aux, caches, 0,
                               jnp.asarray(tokens).astype(jnp.int32))
 
     def step(self, caches, pos, token):
         """One token per sequence: token [B] at position ``pos`` →
-        (logits [B, V], caches)."""
+        (logits [B, V], caches). Donates ``caches`` like ``prefill``."""
         logits, caches = self._step_jit(
             self._params, self._aux, caches, pos,
             jnp.asarray(token).astype(jnp.int32)[:, None])
@@ -271,7 +307,11 @@ class Decoder:
                 "Decoder: prompt %d + steps %d exceeds max_len %d"
                 % (p, num_steps, self.max_len))
         if rng is None:
-            rng = jax.random.PRNGKey(0)
+            # advance an internal counter so repeated sampled calls
+            # draw DIFFERENT continuations (pass rng explicitly for
+            # reproducibility); greedy decoding ignores the key
+            rng = jax.random.PRNGKey(self._auto_key)
+            self._auto_key += 1
         key = (b, p, int(num_steps), float(temperature))
         if key not in self._gen_jit:
             self._gen_jit[key] = self._build_generate(

@@ -75,6 +75,25 @@ def _adam(opt):
     return init, update
 
 
+def _adamw(opt):
+    def init(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def update(w, g, state, lr, t, rng):
+        mean, var = state
+        t = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        coef1 = 1.0 - opt.beta1 ** t
+        coef2 = 1.0 - opt.beta2 ** t
+        lr_t = lr * jnp.sqrt(coef2) / coef1
+        g = _clip_rescale(opt, g)  # decoupled: no wd in the moments
+        new_mean = opt.beta1 * mean + (1 - opt.beta1) * g
+        new_var = opt.beta2 * var + (1 - opt.beta2) * g * g
+        new_w = w - lr_t * new_mean / (jnp.sqrt(new_var) + opt.epsilon) \
+            - lr * opt.wd * w
+        return new_w, (new_mean, new_var)
+    return init, update
+
+
 def _adagrad(opt):
     def init(w):
         return jnp.zeros_like(w)
@@ -130,6 +149,7 @@ def _test(opt):
 _FACTORIES = {
     opt_mod.SGD: _sgd,          # ccSGD is a subclass; dispatch walks MRO
     opt_mod.SGLD: _sgld,
+    opt_mod.AdamW: _adamw,
     opt_mod.Adam: _adam,
     opt_mod.AdaGrad: _adagrad,
     opt_mod.RMSProp: _rmsprop,

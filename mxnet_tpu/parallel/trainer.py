@@ -70,11 +70,17 @@ class ParallelTrainer:
         Split each step's batch into this many sequentially-scanned
         microbatches with one update on the summed gradients
         (activation memory of one microbatch).
+    clip_grad_norm : float, optional
+        Clip the GLOBAL gradient norm (over all parameters together, the
+        transformer-training standard) to this value before the update,
+        inside the compiled step. Distinct from the per-element
+        ``clip_gradient`` the reference optimizers apply per weight.
     """
 
     def __init__(self, symbol, input_shapes, optimizer="sgd", mesh=None,
                  rules=None, initializer=None, seed=None, optimizer_params=None,
-                 compute_dtype=None, remat=None, zero1=False, grad_accum=1):
+                 compute_dtype=None, remat=None, zero1=False, grad_accum=1,
+                 clip_grad_norm=None):
         self.symbol = symbol
         # Mixed precision: forward/backward in compute_dtype (bfloat16 —
         # native MXU input width, halves HBM traffic for activations),
@@ -122,6 +128,11 @@ class ParallelTrainer:
         # see MICROBATCH statistics (the standard accumulation caveat).
         # The reference has no analogue; on TPU this is how memory-bound
         # models reach large effective batches.
+        self.clip_grad_norm = (None if clip_grad_norm is None
+                               else float(clip_grad_norm))
+        if self.clip_grad_norm is not None and self.clip_grad_norm <= 0:
+            raise MXNetError("clip_grad_norm must be positive, got %g"
+                             % self.clip_grad_norm)
         self.grad_accum = int(grad_accum)
         if self.grad_accum < 1 or batch_size % self.grad_accum:
             raise MXNetError("grad_accum=%d must divide batch %d"
@@ -312,6 +323,21 @@ class ParallelTrainer:
             # [A, mb, ...] -> [batch, ...] per head (batch-major order)
             outs = [o.reshape((o.shape[0] * o.shape[1],) + o.shape[2:])
                     for o in outs_stacked]
+        if self.clip_grad_norm is not None:
+            # global-norm clip across ALL params, inside the program:
+            # f32 accumulation; psum-free (grads here are already the
+            # full-batch gradient under dp sharding). The norm is
+            # measured on the RESCALED gradient (rescale_grad = 1/batch
+            # on the string path), so the threshold means "norm of the
+            # mean gradient" as in standard transformer recipes.
+            sq = sum(jnp.sum(jnp.square(grads[n].astype(jnp.float32)))
+                     for n in self.param_names)
+            gnorm = jnp.sqrt(sq) * self.optimizer.rescale_grad
+            scale = jnp.minimum(1.0, self.clip_grad_norm
+                                / jnp.maximum(gnorm, 1e-12))
+            grads = {n: (grads[n].astype(jnp.float32)
+                         * scale).astype(grads[n].dtype)
+                     for n in self.param_names}
         new_params, new_state = {}, {}
         for name in self.param_names:
             w, s = self._opt_update(params[name], grads[name],

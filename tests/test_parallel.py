@@ -1709,3 +1709,102 @@ def test_pipeline_imbalanced_memory_and_warning():
     bytes_padded = _per_device_param_bytes(pp_pad)
     assert bytes_sharded < 0.7 * bytes_padded, (bytes_sharded,
                                                 bytes_padded)
+
+
+def test_fused_step_adamw():
+    """Functional AdamW (decoupled wd) matches eager AdamW, and differs
+    from Adam-with-L2 on the same stream (the decoupling is real)."""
+    sym = _mlp_symbol()
+    rng = np.random.RandomState(11)
+    data = rng.randn(8, 32).astype(np.float32)
+    label = rng.randint(0, 10, (8,)).astype(np.float32)
+
+    ctx = mx.cpu()
+    shapes = {"data": data.shape, "softmax_label": label.shape}
+    arg_names = sym.list_arguments()
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    init = np.random.RandomState(5)
+    params0 = {n: init.uniform(-0.1, 0.1, s).astype("f")
+               for n, s in zip(arg_names, arg_shapes) if n not in shapes}
+    args = {n: mx.nd.array(params0[n]) if n in params0 else mx.nd.zeros(s)
+            for n, s in zip(arg_names, arg_shapes)}
+    grads = {n: mx.nd.zeros(params0[n].shape) for n in params0}
+    exe = sym.bind(ctx, args, args_grad=grads)
+    opt = mx.optimizer.create("adamw", rescale_grad=1.0 / 8, wd=0.05)
+    updater = mx.optimizer.get_updater(opt)
+    args["data"][:] = data
+    args["softmax_label"][:] = label
+    pnames = [n for n in arg_names if n in params0]
+    for _ in range(2):
+        exe.forward(is_train=True)
+        exe.backward()
+        for i, n in enumerate(pnames):
+            updater(i, grads[n], args[n])
+
+    trainer = par.ParallelTrainer(
+        sym, shapes, optimizer="adamw", mesh=par.data_parallel_mesh(),
+        optimizer_params={"wd": 0.05})
+    trainer.init_params({n: mx.nd.array(v) for n, v in params0.items()})
+    for _ in range(2):
+        trainer.step({"data": data, "softmax_label": label})
+    got, _ = trainer.get_params()
+    for n in pnames:
+        np.testing.assert_allclose(got[n].asnumpy(), args[n].asnumpy(),
+                                   rtol=2e-6, atol=2e-6, err_msg=n)
+
+    # decoupling sanity: plain adam with the same wd lands elsewhere
+    t2 = par.ParallelTrainer(
+        sym, shapes, optimizer="adam", mesh=par.data_parallel_mesh(),
+        optimizer_params={"wd": 0.05})
+    t2.init_params({n: mx.nd.array(v) for n, v in params0.items()})
+    for _ in range(2):
+        t2.step({"data": data, "softmax_label": label})
+    g2, _ = t2.get_params()
+    assert any(not np.allclose(g2[n].asnumpy(), got[n].asnumpy())
+               for n in pnames)
+
+
+def test_clip_grad_norm():
+    """Global-norm clipping: with SGD lr=1/wd=0/momentum=0 the update
+    IS the (rescaled) gradient, so the clipped trainer's delta must be
+    the unclipped delta scaled by min(1, c/||g||) — one shared factor
+    across ALL parameters."""
+    sym = _mlp_symbol()
+    rng = np.random.RandomState(12)
+    data = rng.randn(8, 32).astype(np.float32)
+    label = rng.randint(0, 10, (8,)).astype(np.float32)
+    shapes = {"data": data.shape, "softmax_label": label.shape}
+    arg_names = sym.list_arguments()
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    init = np.random.RandomState(6)
+    params0 = {n: init.uniform(-0.1, 0.1, s).astype("f")
+               for n, s in zip(arg_names, arg_shapes) if n not in shapes}
+
+    def run(clip):
+        tr = par.ParallelTrainer(
+            sym, shapes, optimizer="sgd", mesh=par.data_parallel_mesh(),
+            clip_grad_norm=clip,
+            optimizer_params={"learning_rate": 1.0, "wd": 0.0,
+                              "momentum": 0.0})
+        tr.init_params({n: mx.nd.array(v) for n, v in params0.items()})
+        tr.step({"data": data, "softmax_label": label})
+        got, _ = tr.get_params()
+        return {n: params0[n] - got[n].asnumpy() for n in params0}
+
+    g = run(None)           # delta == rescaled gradient
+    gnorm = np.sqrt(sum(np.sum(v.astype(np.float64) ** 2)
+                        for v in g.values()))
+    c = gnorm / 3.0         # force clipping by 1/3
+    clipped = run(c)
+    for n in g:
+        np.testing.assert_allclose(clipped[n], g[n] * (c / gnorm),
+                                   rtol=1e-4, atol=1e-7, err_msg=n)
+    # a generous threshold must be a no-op
+    loose = run(gnorm * 10)
+    for n in g:
+        np.testing.assert_allclose(loose[n], g[n], rtol=1e-6, atol=1e-8)
+
+    with pytest.raises(mx.MXNetError, match="positive"):
+        par.ParallelTrainer(sym, shapes, optimizer="sgd",
+                            mesh=par.data_parallel_mesh(),
+                            clip_grad_norm=-1.0)
