@@ -150,11 +150,19 @@ REGISTRY: dict[str, OpSpec] = {}
 
 def register(cls):
     """Class decorator: instantiate and register an OpSpec."""
+    import sys
+
     spec = cls()
     assert spec.name, cls
     REGISTRY[spec.name] = spec
     for alias in spec.aliases:
         REGISTRY[alias] = spec
+    # late registration (user op defined AFTER import): install the
+    # mx.symbol.<Name> constructor now — at first import the symbol
+    # module does this itself once all built-in ops are in
+    m = sys.modules.get(__name__.rsplit(".", 2)[0] + ".symbol")
+    if m is not None and hasattr(m, "_init_symbol_module"):
+        m._init_symbol_module()
     return cls
 
 

@@ -557,7 +557,11 @@ def _sym_or_scalar_binop(sym_op, scalar_op, name):
         if rsym:
             # max/min are symmetric; pow gets its own function below
             return _create(scalar_op, [rhs], {"scalar": float(lhs)})
-        raise TypeError("%s needs at least one Symbol" % name)
+        # two plain numbers: the reference computes the value directly
+        # (symbol.py:1077-1078)
+        if name == "maximum":
+            return lhs if lhs > rhs else rhs
+        return lhs if lhs < rhs else rhs
     func.__name__ = name
     return func
 

@@ -414,6 +414,15 @@ def test_maximum_minimum():
     assert reldiff(arr_grad2.asnumpy(), npout_grad2) < 1e-6
 
 
+def test_maximum_minimum_number_number():
+    """Two plain numbers compute the value directly (reference
+    symbol.py:1077-1078)."""
+    assert mx.sym.maximum(2, 3) == 3
+    assert mx.sym.minimum(2, 3) == 2
+    assert mx.sym.maximum(3.5, -1) == 3.5
+    assert mx.sym.minimum(3.5, -1) == -1
+
+
 def test_maximum_minimum_scalar():
     data1 = mx.symbol.Variable("data")
     shape = (3, 4)

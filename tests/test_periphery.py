@@ -276,3 +276,40 @@ def test_reference_module_aliases():
                        ("th", "torch"), ("nd", "ndarray"),
                        ("sym", "symbol"), ("kv", "kvstore")]:
         assert getattr(mx, alias) is getattr(mx, mod), alias
+
+
+def test_user_opspec_late_registration():
+    """An OpSpec registered AFTER import (the doc/tutorial/new_op_howto
+    path) gets its mx.symbol constructor installed immediately."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import OpSpec, Param, register
+
+    from mxnet_tpu.ops.registry import REGISTRY
+
+    try:
+        @register
+        class _TutorialScaledTanh(OpSpec):
+            name = "_TutorialScaledTanh"
+            params = {"alpha": Param("float", 1.0)}
+
+            def arguments(self, p):
+                return ["data"]
+
+            def infer_shape(self, p, in_shapes):
+                return list(in_shapes), [in_shapes[0]], []
+
+            def forward(self, p, ins, aux, is_train, rng):
+                return [p["alpha"] * jnp.tanh(ins[0])], []
+
+        y = mx.symbol._TutorialScaledTanh(data=mx.symbol.Variable("data"),
+                                          alpha=2.0)
+        exe = y.simple_bind(mx.cpu(), grad_req="write", data=(2, 3))
+        x = np.random.RandomState(0).randn(2, 3).astype("f")
+        exe.forward(is_train=False, data=x)
+        np.testing.assert_allclose(exe.outputs[0].asnumpy(),
+                                   2.0 * np.tanh(x), rtol=1e-6)
+    finally:
+        # the global registry outlives this test: later tests gate the
+        # live op enumeration against doc/api_manifest.json
+        REGISTRY.pop("_TutorialScaledTanh", None)
+        mx.symbol.__dict__.pop("_TutorialScaledTanh", None)
