@@ -345,3 +345,119 @@ class Decoder:
             return jnp.concatenate([prompt, toks.T], axis=1), caches
 
         return jax.jit(gen, donate_argnums=(2,))
+
+    def beam_search(self, prompt, num_steps, beam_size, eos_id=None,
+                    length_penalty=0.0):
+        """Beam-search continuation: keep the ``beam_size`` highest
+        log-probability continuations at every step.
+
+        prompt: [B, P] token ids. Returns ``(sequences, scores)`` —
+        sequences [B, beam_size, P + num_steps] int32 and scores
+        [B, beam_size] f32 (sum of token log-probs; with
+        ``length_penalty`` > 0 the ranking divides by
+        length**length_penalty), both sorted best-first per batch row.
+
+        ``eos_id``: beams that emit it are FINISHED — they stop
+        expanding (their continuation slots fill with token 0 at no
+        score cost) but keep competing on their final score. The whole
+        search is ONE compiled ``lax.scan`` program; beams live as a
+        folded [B*K] batch and cache rows are re-gathered to follow
+        their parent beams each step.
+        """
+        prompt = jnp.asarray(prompt).astype(jnp.int32)
+        b, p = prompt.shape
+        k = int(beam_size)
+        if k < 1:
+            raise MXNetError("beam_size must be >= 1, got %d" % k)
+        if num_steps < 1:
+            raise MXNetError("beam_search needs num_steps >= 1")
+        if p + num_steps > self.max_len:
+            raise MXNetError(
+                "Decoder: prompt %d + steps %d exceeds max_len %d"
+                % (p, num_steps, self.max_len))
+        key = (b, p, int(num_steps), k,
+               -1 if eos_id is None else int(eos_id),
+               float(length_penalty))
+        if key not in self._gen_jit:
+            self._gen_jit[key] = self._build_beam(
+                p, int(num_steps), k,
+                None if eos_id is None else int(eos_id),
+                float(length_penalty))
+        return self._gen_jit[key](self._params, self._aux,
+                                  self.init_cache(b), prompt)
+
+    def _build_beam(self, p, num_steps, k, eos_id, length_penalty):
+        neg = jnp.float32(-1e30)
+
+        def expand_logp(logits, finished):
+            """[B*K] step logits -> [B, K, V] log-probs; finished beams
+            may only 'emit' token 0 at zero cost (score frozen)."""
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            bk, v = logp.shape
+            logp = logp.reshape(-1, k, v)
+            frozen = jnp.full((v,), neg).at[0].set(0.0)
+            return jnp.where(finished[:, :, None], frozen[None, None],
+                             logp)
+
+        def bs(params, aux, caches, prompt):
+            B = prompt.shape[0]
+            # prefill on [B], then expand every cache row into K beams
+            logits, caches = self._run(params, aux, caches, 0, prompt)
+            logp0 = jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32), -1)   # [B, V]
+            v = logp0.shape[-1]
+            kk = min(k, v)
+            scores, tok = lax.top_k(logp0, kk)           # [B, kk]
+            if kk < k:  # beam wider than vocab: pad with dead beams
+                pad = k - kk
+                scores = jnp.concatenate(
+                    [scores, jnp.full((B, pad), neg)], 1)
+                tok = jnp.concatenate(
+                    [tok, jnp.zeros((B, pad), tok.dtype)], 1)
+            caches = jax.tree_util.tree_map(
+                lambda c: jnp.repeat(c, k, axis=0), caches)
+            seqs = jnp.zeros((B, k, p + num_steps), jnp.int32)
+            seqs = seqs.at[:, :, :p].set(prompt[:, None, :])
+            seqs = seqs.at[:, :, p].set(tok)
+            finished = (tok == eos_id) if eos_id is not None \
+                else jnp.zeros((B, k), bool)
+            lengths = jnp.ones((B, k), jnp.float32)
+
+            def body(carry, i):
+                caches, seqs, scores, tok, finished, lengths = carry
+                logits, caches = self._run(
+                    params, aux, caches, p + i,
+                    tok.reshape(B * k)[:, None])
+                logp = expand_logp(logits[:, 0], finished)  # [B,K,V]
+                total = scores[:, :, None] + logp
+                scores2, idx = lax.top_k(total.reshape(B, k * v), k)
+                parent = idx // v                        # [B, K]
+                tok2 = (idx % v).astype(jnp.int32)
+                rows = (jnp.arange(B)[:, None] * k + parent).reshape(-1)
+                caches = jax.tree_util.tree_map(
+                    lambda c: jnp.take(c, rows, axis=0), caches)
+                seqs = jnp.take_along_axis(seqs, parent[..., None], 1)
+                fin_p = jnp.take_along_axis(finished, parent, 1)
+                len_p = jnp.take_along_axis(lengths, parent, 1)
+                seqs = seqs.at[:, :, p + 1 + i].set(
+                    jnp.where(fin_p, 0, tok2))
+                fin2 = fin_p | ((tok2 == eos_id) if eos_id is not None
+                                else False)
+                len2 = len_p + (~fin_p)
+                return (caches, seqs, scores2, tok2, fin2, len2), None
+
+            carry = (caches, seqs, scores, tok, finished, lengths)
+            if num_steps > 1:
+                carry, _ = lax.scan(body, carry,
+                                    jnp.arange(num_steps - 1))
+            _, seqs, scores, _, _, lengths = carry
+            rank = scores / jnp.power(lengths, length_penalty) \
+                if length_penalty > 0.0 else scores
+            order = jnp.argsort(-rank, axis=1)
+            seqs = jnp.take_along_axis(seqs, order[..., None], 1)
+            scores = jnp.take_along_axis(scores, order, 1)
+            return seqs, scores
+
+        # no donation: the [B]-row prefill caches are REPLACED by the
+        # [B*K] beam caches, so the input buffers cannot be aliased
+        return jax.jit(bs)

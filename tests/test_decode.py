@@ -306,3 +306,85 @@ def test_clone_cache_branching():
     want_b = _full_logits(sym, params,
                           np.pad(alt_seq, ((0, 0), (0, T - 4))))
     np.testing.assert_allclose(b, want_b[:, 3], rtol=1e-5, atol=1e-5)
+
+
+def _np_beam_search(sym, params, prompt, num_steps, k, T):
+    """Independent numpy beam search driven by FULL forwards — the
+    oracle for the incremental implementation's cache/bookkeeping."""
+    B, P = prompt.shape
+    beams = [[(prompt[b].tolist(), 0.0)] for b in range(B)]
+    for step in range(num_steps):
+        new = []
+        for b in range(B):
+            cand = []
+            for seq, score in beams[b]:
+                arr = np.zeros((1, T), np.int64)
+                arr[0, :len(seq)] = seq
+                logits = _full_logits(sym, params, arr)[0, len(seq) - 1]
+                logits = logits.astype(np.float64)
+                logp = logits - np.log(np.exp(
+                    logits - logits.max()).sum()) - logits.max()
+                for vtok in range(len(logp)):
+                    cand.append((seq + [vtok], score + logp[vtok]))
+            cand.sort(key=lambda c: -c[1])
+            new.append(cand[:k])
+        beams = new
+    seqs = np.array([[c[0] for c in row] for row in beams])
+    scores = np.array([[c[1] for c in row] for row in beams])
+    return seqs, scores
+
+
+def test_beam_search_matches_numpy_reference():
+    """Incremental beam search == an independent full-forward numpy
+    implementation (sequences exactly, scores numerically)."""
+    rng = np.random.RandomState(13)
+    T = 9
+    sym = _lm()
+    params = _init_params(sym, T, 2, rng)
+    dec = Decoder(sym, params, max_len=T)
+    prompt = rng.randint(0, VOCAB, (2, 3))
+
+    seqs, scores = dec.beam_search(prompt, num_steps=4, beam_size=3)
+    want_seqs, want_scores = _np_beam_search(sym, params, prompt, 4, 3, T)
+    np.testing.assert_array_equal(np.asarray(seqs), want_seqs)
+    np.testing.assert_allclose(np.asarray(scores), want_scores,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_beam_size_one_is_greedy():
+    rng = np.random.RandomState(14)
+    T = 10
+    sym = _lm()
+    params = _init_params(sym, T, 2, rng)
+    dec = Decoder(sym, params, max_len=T)
+    prompt = rng.randint(0, VOCAB, (2, 2))
+    greedy = np.asarray(dec.generate(prompt, num_steps=5))
+    seqs, scores = dec.beam_search(prompt, num_steps=5, beam_size=1)
+    np.testing.assert_array_equal(np.asarray(seqs)[:, 0], greedy)
+    assert np.isfinite(np.asarray(scores)).all()
+
+
+def test_beam_search_eos_freezes():
+    """Beams that emit eos stop expanding: their score freezes and the
+    remaining slots fill with token 0."""
+    rng = np.random.RandomState(15)
+    T = 10
+    sym = _lm()
+    params = _init_params(sym, T, 1, rng)
+    dec = Decoder(sym, params, max_len=T)
+    prompt = rng.randint(0, VOCAB, (1, 2))
+
+    base_seqs, base_scores = dec.beam_search(prompt, 5, beam_size=VOCAB)
+    # pick the eos id as the token the best beam emits at the first step
+    eos = int(np.asarray(base_seqs)[0, 0, 2])
+    seqs, scores = dec.beam_search(prompt, 5, beam_size=VOCAB,
+                                   eos_id=eos)
+    seqs, scores = np.asarray(seqs), np.asarray(scores)
+    # some beam ends with eos followed by only pad zeros
+    hit = [i for i in range(seqs.shape[1])
+           if eos in seqs[0, i, 2:]]
+    assert hit, seqs
+    i = hit[0]
+    e = list(seqs[0, i, 2:]).index(eos) + 2
+    assert (seqs[0, i, e + 1:] == 0).all()
+    assert np.isfinite(scores[0, i])
