@@ -15,7 +15,8 @@ __all__ = ["transformer_block", "moe_transformer_block",
            "get_transformer_lm", "tp_rules", "ep_rules"]
 
 
-def _attn_sublayer(data, num_heads, name, causal, impl, dropout):
+def _attn_sublayer(data, num_heads, name, causal, impl, dropout,
+                   rope=False):
     """x + MHA(LN(x)) then LN — the shared attention half of a block."""
     ln1 = sym.LayerNorm(data=data,
                         gamma=sym.Variable(name + "_ln1_gamma"),
@@ -28,7 +29,7 @@ def _attn_sublayer(data, num_heads, name, causal, impl, dropout):
         out_weight=sym.Variable(name + "_proj_weight"),
         out_bias=sym.Variable(name + "_proj_bias"),
         num_heads=num_heads, causal=causal, impl=impl, dropout=dropout,
-        name=name + "_attn")
+        rope=rope, name=name + "_attn")
     x = data + attn
     ln2 = sym.LayerNorm(data=x,
                         gamma=sym.Variable(name + "_ln2_gamma"),
@@ -38,9 +39,11 @@ def _attn_sublayer(data, num_heads, name, causal, impl, dropout):
 
 
 def transformer_block(data, num_heads, hidden, embed_dim, name,
-                      causal=True, impl="flash", dropout=0.0):
+                      causal=True, impl="flash", dropout=0.0,
+                      rope=False):
     """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x)). data: [B,T,E]."""
-    x, ln2 = _attn_sublayer(data, num_heads, name, causal, impl, dropout)
+    x, ln2 = _attn_sublayer(data, num_heads, name, causal, impl, dropout,
+                            rope=rope)
     f1 = sym.FullyConnected(data=ln2, num_hidden=hidden,
                             name=name + "_ffn1", flatten=False)
     act = sym.Activation(data=f1, act_type="relu", name=name + "_ffn_relu")
@@ -51,11 +54,12 @@ def transformer_block(data, num_heads, hidden, embed_dim, name,
 
 def moe_transformer_block(data, num_heads, hidden, embed_dim, num_experts,
                           name, causal=True, impl="flash", dropout=0.0,
-                          moe_top_k=0):
+                          moe_top_k=0, rope=False):
     """Transformer block whose FFN is a mixture of experts (MoEFFN):
     shard the expert dim over ``ep`` (ep_rules) for expert parallelism.
     ``moe_top_k>0`` enables static-shaped top-k hard routing."""
-    x, ln2 = _attn_sublayer(data, num_heads, name, causal, impl, dropout)
+    x, ln2 = _attn_sublayer(data, num_heads, name, causal, impl, dropout,
+                            rope=rope)
     moe = sym.MoEFFN(
         data=ln2,
         gate_weight=sym.Variable(name + "_gate_weight"),
@@ -71,7 +75,8 @@ def moe_transformer_block(data, num_heads, hidden, embed_dim, num_experts,
 def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
                        ffn_hidden=None, seq_len=None, impl="flash",
                        dropout=0.0, num_experts=0, pipeline_stages=None,
-                       moe_top_k=0, loss_layout="reference"):
+                       moe_top_k=0, loss_layout="reference",
+                       pos_encoding="learned"):
     """Decoder-only LM: Embedding -> N blocks -> tied-free FC -> softmax
     over vocab per position (multi_output SoftmaxOutput, the reference's
     per-position softmax mode, softmax_output-inl.h multi_output).
@@ -93,9 +98,17 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
     updates (the loss gradient is SoftmaxOutput's), but consumers that
     need probabilities (accuracy metrics, predict) should use the other
     layouts.
+
+    ``pos_encoding``: "learned" (default) adds the trained absolute
+    pos_embed table; "rope" rotates q/k inside every attention instead
+    (rotary/RoFormer — relative positions, no table, so decoding is not
+    bounded by a trained length).
     """
     from ..attribute import AttrScope
 
+    if pos_encoding not in ("learned", "rope"):
+        raise ValueError("pos_encoding must be 'learned' or 'rope', "
+                         "got %r" % (pos_encoding,))
     if loss_layout not in ("reference", "flat", "ce"):
         raise ValueError("loss_layout must be 'reference', 'flat' or "
                          "'ce', got %r" % (loss_layout,))
@@ -115,11 +128,13 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
         data = sym.Variable("data")  # [B, T] int tokens
         net = sym.Embedding(data=data, input_dim=vocab_size,
                             output_dim=embed_dim, name="embed")
-        # learned additive positional embedding, rows sharded with their
-        # positions under sequence parallelism
-        net = sym.PositionalEmbedding(data=net,
-                                      pos=sym.Variable("pos_embed"),
-                                      name="pos_add")
+        rope = pos_encoding == "rope"
+        if not rope:
+            # learned additive positional embedding, rows sharded with
+            # their positions under sequence parallelism
+            net = sym.PositionalEmbedding(data=net,
+                                          pos=sym.Variable("pos_embed"),
+                                          name="pos_add")
     for i in range(num_layers):
         with scope(i):
             if num_experts:
@@ -127,11 +142,13 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
                                             embed_dim, num_experts,
                                             "layer%d" % i, impl=impl,
                                             dropout=dropout,
-                                            moe_top_k=moe_top_k)
+                                            moe_top_k=moe_top_k,
+                                            rope=rope)
             else:
                 net = transformer_block(net, num_heads, ffn_hidden,
                                         embed_dim, "layer%d" % i,
-                                        impl=impl, dropout=dropout)
+                                        impl=impl, dropout=dropout,
+                                        rope=rope)
     with scope(last=True):
         ln_f = sym.LayerNorm(data=net, gamma=sym.Variable("lnf_gamma"),
                              beta=sym.Variable("lnf_beta"), name="lnf")

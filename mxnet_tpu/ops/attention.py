@@ -155,6 +155,22 @@ class MoEFFN(OpSpec):
         return [out], []
 
 
+def rope_rotate(x, positions, base=10000.0):
+    """Rotary position embedding (RoFormer / GPT-NeoX half-split form):
+    rotate the two halves of each head dim by position-dependent angles,
+    so q·k depends only on RELATIVE distance. x: [B, T, H, D] (D even);
+    positions: [T] absolute positions of these tokens."""
+    d = x.shape[-1]
+    half = d // 2
+    freq = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions[:, None].astype(jnp.float32) * freq[None, :]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1).astype(x.dtype)
+
+
 @register
 class MultiHeadAttention(OpSpec):
     """Multi-head self-attention with fused QKV projection.
@@ -167,6 +183,12 @@ class MultiHeadAttention(OpSpec):
     dense. Long sequences shard over the ``sp`` mesh axis via
     ``parallel.ring_attention`` at the trainer level; inside a single
     program this op is the per-shard compute.
+
+    ``rope=True`` applies rotary position embeddings to q/k before the
+    attention kernel (``rope_rotate``) — rotation attaches to each
+    token's absolute position, so it composes with every impl
+    (under shard_map the shard's global offset comes from
+    ``lax.axis_index``; striping re-deals already-rotated tokens).
     """
 
     name = "MultiHeadAttention"
@@ -174,6 +196,8 @@ class MultiHeadAttention(OpSpec):
               "causal": Param("bool", True),
               "impl": Param("str", "flash"),
               "dropout": Param("float", 0.0),
+              "rope": Param("bool", False),
+              "rope_base": Param("float", 10000.0),
               "axis_name": Param("str", "sp")}
 
     def arguments(self, p):
@@ -189,6 +213,9 @@ class MultiHeadAttention(OpSpec):
         if e % p["num_heads"] != 0:
             raise MXNetError("MultiHeadAttention: %d heads do not divide "
                              "embed dim %d" % (p["num_heads"], e))
+        if p["rope"] and (e // p["num_heads"]) % 2:
+            raise MXNetError("MultiHeadAttention: rope needs an even "
+                             "head dim, got %d" % (e // p["num_heads"]))
         ins = [d,
                shape_assign(in_shapes[1], (3 * e, e), "qkv_weight"),
                shape_assign(in_shapes[2], (3 * e,), "qkv_bias"),
@@ -208,6 +235,17 @@ class MultiHeadAttention(OpSpec):
             return z.reshape(b, t, h, d)
 
         q, k, v = heads(q), heads(k), heads(v)
+        if p["rope"]:
+            if d % 2:
+                raise MXNetError("MultiHeadAttention: rope needs an even "
+                                 "head dim, got %d" % d)
+            try:  # sequence-parallel shard: global offset of this shard
+                off = jax.lax.axis_index(p["axis_name"]) * t
+            except NameError:
+                off = 0
+            posv = off + jnp.arange(t)
+            q = rope_rotate(q, posv, p["rope_base"])
+            k = rope_rotate(k, posv, p["rope_base"])
         impl = p["impl"]
         if impl == "flash":
             from .pallas_kernels import flash_attention

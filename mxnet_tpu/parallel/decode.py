@@ -201,6 +201,13 @@ class Decoder:
         qkv = jnp.einsum("bte,fe->btf", x, wqkv) + bqkv
         q, k, v = [z.reshape(b, c, h, d)
                    for z in jnp.split(qkv, 3, axis=-1)]
+        if node.params.get("rope"):
+            # rotate with ABSOLUTE positions (pos is traced); the cache
+            # stores post-rotation K, matching the full forward exactly
+            from ..ops.attention import rope_rotate
+            posv = pos + jnp.arange(c)
+            q = rope_rotate(q, posv, node.params["rope_base"])
+            k = rope_rotate(k, posv, node.params["rope_base"])
         ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
                                       (0, pos, 0, 0))
         cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),

@@ -388,3 +388,36 @@ def test_beam_search_eos_freezes():
     e = list(seqs[0, i, 2:]).index(eos) + 2
     assert (seqs[0, i, e + 1:] == 0).all()
     assert np.isfinite(scores[0, i])
+
+
+def test_decode_rope_matches_full_forward():
+    """RoPE LM: the decoder's incremental rotation (cache stores
+    post-rotation K at traced positions) must match the full forward's
+    whole-sequence rotation exactly — greedy tokens AND logits."""
+    rng = np.random.RandomState(16)
+    T = 12
+    sym = _lm(pos_encoding="rope")
+    params = _init_params(sym, T, 2, rng)
+    dec = Decoder(sym, params, max_len=T)
+    assert "pos_embed" not in params  # rope has no table
+
+    prompt = rng.randint(0, VOCAB, (2, 4))
+    out = np.asarray(dec.generate(prompt, num_steps=6))
+    seq = prompt.copy()
+    for _ in range(6):
+        logits = _full_logits(sym, params, np.pad(
+            seq, ((0, 0), (0, T - seq.shape[1]))))
+        nxt = logits[:, seq.shape[1] - 1].argmax(-1)
+        seq = np.concatenate([seq, nxt[:, None].astype(seq.dtype)], 1)
+    np.testing.assert_array_equal(out, seq)
+
+    toks = rng.randint(0, VOCAB, (2, T))
+    want = _full_logits(sym, params, toks)
+    caches = dec.init_cache(2)
+    got, caches = dec.prefill(caches, toks[:, :5])
+    np.testing.assert_allclose(np.asarray(got), want[:, :5],
+                               rtol=1e-5, atol=1e-5)
+    for t in range(5, T):
+        logits, caches = dec.step(caches, t, toks[:, t])
+        np.testing.assert_allclose(np.asarray(logits), want[:, t],
+                                   rtol=1e-5, atol=1e-5)

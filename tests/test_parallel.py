@@ -1808,3 +1808,53 @@ def test_clip_grad_norm():
         par.ParallelTrainer(sym, shapes, optimizer="sgd",
                             mesh=par.data_parallel_mesh(),
                             clip_grad_norm=-1.0)
+
+
+def test_sequence_parallel_rope_matches_dense():
+    """RoPE under ring attention: each sp shard rotates its tokens with
+    the shard's GLOBAL offset (lax.axis_index), so trained parameters
+    must match the single-device dense rope LM exactly — the oracle for
+    position bookkeeping under sequence parallelism."""
+    from mxnet_tpu.models import get_transformer_lm
+
+    vocab, B, T, E = 12, 4, 16, 8
+    rng = np.random.RandomState(1)
+    data = rng.randint(0, vocab, (B, T)).astype(np.float32)
+    label = rng.randint(0, vocab, (B, T)).astype(np.float32)
+    shapes = {"data": (B, T), "softmax_label": (B, T)}
+
+    def init_for(sym):
+        arg_shapes, _, _ = sym.infer_shape(**shapes)
+        prng = np.random.RandomState(4)
+        return {n: mx.nd.array(prng.uniform(-0.1, 0.1, s).astype("f"))
+                for n, s in zip(sym.list_arguments(), arg_shapes)
+                if n not in shapes}
+
+    dense_sym = get_transformer_lm(vocab, num_layers=1, embed_dim=E,
+                                   num_heads=2, impl="dense",
+                                   pos_encoding="rope")
+    ref_tr = par.ParallelTrainer(
+        dense_sym, shapes, optimizer="sgd",
+        mesh=par.data_parallel_mesh(1),
+        optimizer_params={"learning_rate": 0.2, "momentum": 0.9})
+    init = init_for(dense_sym)
+    ref_tr.init_params({k: v.copy() for k, v in init.items()})
+    for _ in range(2):
+        ref_tr.step({"data": data, "softmax_label": label})
+    want, _ = ref_tr.get_params()
+
+    ring_sym = get_transformer_lm(vocab, num_layers=1, embed_dim=E,
+                                  num_heads=2, impl="ring",
+                                  pos_encoding="rope")
+    mesh = par.build_mesh({"dp": 2, "sp": 4})
+    sp_tr = par.SequenceParallelTrainer(
+        ring_sym, shapes, mesh, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.2, "momentum": 0.9,
+                          "rescale_grad": 1.0 / B})
+    sp_tr.init_params({k: v.copy() for k, v in init.items()})
+    for _ in range(2):
+        sp_tr.step({"data": data, "softmax_label": label})
+    got = sp_tr.get_params()
+    for n in want:
+        np.testing.assert_allclose(got[n].asnumpy(), want[n].asnumpy(),
+                                   rtol=2e-4, atol=2e-5, err_msg=n)
