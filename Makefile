@@ -14,6 +14,18 @@ examples: native
 test: native
 	python -m pytest tests/ -x -q
 
+# Regenerate every surface derived from the op registry. Run this in the
+# same change as ANY OpSpec edit — tests/test_bindings.py gates staleness.
+manifest:
+	python tools/gen_api_manifest.py
+	python scala-package/generate_ops.py
+	python R-package/generate_ops_r.py
+
+# Fast pre-commit gate (<2 min): generated-surface freshness + operator
+# registry sanity. Run before any end-of-round snapshot commit.
+check:
+	python -m pytest tests/test_bindings.py tests/test_attr.py tests/test_infer_shape.py -q
+
 bench:
 	python bench.py
 
@@ -23,4 +35,4 @@ lint:
 clean:
 	$(MAKE) -C cpp clean
 
-.PHONY: all native examples test bench lint clean
+.PHONY: all native examples test manifest check bench lint clean
