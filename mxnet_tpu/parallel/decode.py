@@ -11,9 +11,11 @@ node swapped for a cached variant (new tokens' K/V written into a
 queries attend to the cache under the mask ``key_pos <= query_pos``) and
 ``PositionalEmbedding`` sliced at the current position. Every other LM op
 (Embedding, LayerNorm, FullyConnected, activations, elementwise
-arithmetic, MoEFFN, BatchNorm-with-moving-stats) is position-wise and
-runs its ordinary ``OpSpec.forward`` unchanged, so there is no duplicated
-model math to drift.
+arithmetic, MoEFFN, BatchNorm-on-rank-2-data) is position-wise and runs
+its ordinary ``OpSpec.forward`` unchanged, so there is no duplicated
+model math to drift. BatchNorm normalizes axis 1 — the TIME axis of
+rank-3 [B, T, E] sequence data — so it is position-wise only on rank-2
+inputs; rank>=3 BatchNorm is rejected at trace time.
 
 TPU-native shape discipline: cache buffers are statically ``max_len``
 long (no growing shapes — one compiled program serves every step),
@@ -38,7 +40,8 @@ from ..base import MXNetError
 __all__ = ["Decoder"]
 
 # ops whose forward acts independently per position on [B, C, ...] data
-# (safe to run unchanged on a chunk of C new tokens)
+# (safe to run unchanged on a chunk of C new tokens); BatchNorm only
+# qualifies on rank-2 data — _run rejects it on rank>=3 (time axis)
 _POSITIONWISE = {
     "Embedding", "LayerNorm", "FullyConnected", "Activation", "LeakyReLU",
     "MoEFFN", "Dropout", "BlockGrad", "Cast", "ElementWiseSum",
@@ -250,6 +253,17 @@ class Decoder:
                     posp, (pos, 0), (x.shape[1], posp.shape[1]))
                 env[(id(n), 0)] = x + rows[None]
                 continue
+            if name == "BatchNorm" and ins[0].ndim >= 3:
+                # BatchNorm normalizes axis 1, which for rank>=3 LM data
+                # [B, T, E] is the TIME axis: a [B, 1, E] decode chunk
+                # would silently broadcast against length-T moving stats
+                # instead of behaving position-wise. Refuse loudly.
+                raise MXNetError(
+                    "Decoder: BatchNorm node %r normalizes axis 1 of its "
+                    "rank-%d input — the time axis under decoding, so it "
+                    "is not position-wise; use LayerNorm for sequence "
+                    "models (or BatchNorm on rank-2 [B, E] data only)"
+                    % (n.name, ins[0].ndim))
             n_aux = len(n.spec.aux_states(n.params))
             aux_in = aux[aux_cursor:aux_cursor + n_aux]
             aux_cursor += n_aux
@@ -276,12 +290,22 @@ class Decoder:
         step) and are invalid afterwards; always continue with the
         RETURNED caches, and ``clone_cache`` first to keep a branch
         point alive."""
-        return self._step_jit(self._params, self._aux, caches, 0,
-                              jnp.asarray(tokens).astype(jnp.int32))
+        tokens = jnp.asarray(tokens).astype(jnp.int32)
+        if tokens.shape[1] > self.max_len:
+            raise MXNetError(
+                "Decoder: prompt length %d exceeds max_len %d"
+                % (tokens.shape[1], self.max_len))
+        return self._step_jit(self._params, self._aux, caches, 0, tokens)
 
     def step(self, caches, pos, token):
         """One token per sequence: token [B] at position ``pos`` →
         (logits [B, V], caches). Donates ``caches`` like ``prefill``."""
+        if not 0 <= pos < self.max_len:
+            # dynamic_update_slice would silently clamp an out-of-range
+            # start, overwriting the LAST cache slot; fail loudly instead
+            raise MXNetError(
+                "Decoder: step position %d outside the cache [0, %d)"
+                % (pos, self.max_len))
         logits, caches = self._step_jit(
             self._params, self._aux, caches, pos,
             jnp.asarray(token).astype(jnp.int32)[:, None])

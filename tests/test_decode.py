@@ -172,6 +172,51 @@ def test_decode_errors():
                        "c_bias": jnp.zeros((2,))}, max_len=4)
 
 
+def test_decode_step_prefill_bounds():
+    """step()/prefill() refuse positions past the cache end —
+    dynamic_update_slice would silently clamp and overwrite the last
+    K/V slot otherwise."""
+    rng = np.random.RandomState(11)
+    T = 8
+    sym = _lm()
+    params = _init_params(sym, T, 1, rng)
+    dec = Decoder(sym, params, max_len=T)
+
+    with pytest.raises(mx.MXNetError, match="exceeds max_len"):
+        dec.prefill(dec.init_cache(1), np.zeros((1, T + 1), np.int64))
+
+    caches = dec.init_cache(1)
+    _, caches = dec.prefill(caches, np.zeros((1, T), np.int64))
+    with pytest.raises(mx.MXNetError, match="outside the cache"):
+        dec.step(caches, T, np.zeros((1,), np.int64))
+    with pytest.raises(mx.MXNetError, match="outside the cache"):
+        dec.step(caches, -1, np.zeros((1,), np.int64))
+
+
+def test_decode_rejects_rank3_batchnorm():
+    """BatchNorm normalizes axis 1 — the time axis for [B, T, E] LM
+    data — so it is NOT position-wise on rank-3 data; the decoder must
+    refuse instead of broadcasting length-T moving stats into garbage."""
+    import mxnet_tpu.symbol as S
+    d = S.Variable("data")
+    e = S.Embedding(data=d, input_dim=VOCAB, output_dim=EMBED,
+                    name="embed")
+    bn = S.BatchNorm(data=e, gamma=S.Variable("bn_gamma"),
+                     beta=S.Variable("bn_beta"), name="bn")
+    head = S.FullyConnected(data=bn, num_hidden=VOCAB, flatten=False,
+                            name="lm_head")
+    T = 6
+    params = {"embed_weight": jnp.zeros((VOCAB, EMBED)),
+              "bn_gamma": jnp.ones((T,)), "bn_beta": jnp.zeros((T,)),
+              "lm_head_weight": jnp.zeros((VOCAB, EMBED)),
+              "lm_head_bias": jnp.zeros((VOCAB,))}
+    dec = Decoder(head, params, max_len=T,
+                  aux_params={"bn_moving_mean": jnp.zeros((T,)),
+                              "bn_moving_var": jnp.ones((T,))})
+    with pytest.raises(mx.MXNetError, match="not position-wise"):
+        dec.prefill(dec.init_cache(1), np.zeros((1, 3), np.int64))
+
+
 def test_decode_moe_lm():
     """MoE blocks decode too (MoEFFN is position-wise)."""
     rng = np.random.RandomState(5)
