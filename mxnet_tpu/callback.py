@@ -72,7 +72,12 @@ def log_train_metric(period):
 
 
 class Speedometer:
-    """Log training speed every `frequent` batches (reference :49)."""
+    """Log training speed every `frequent` batches (reference :49).
+
+    A batch count lower than the previous call means a new epoch
+    started; the timer re-arms rather than reporting a bogus speed
+    across the epoch boundary.
+    """
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
@@ -81,27 +86,30 @@ class Speedometer:
         self.tic = 0
         self.last_count = 0
 
+    def _rearm(self):
+        self.init = True
+        self.tic = time.time()
+
     def __call__(self, param):
         count = param.nbatch
-        if self.last_count > count:
+        if count < self.last_count:
             self.init = False
         self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / \
-                    (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name, value = param.eval_metric.get()
-                    logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples"
-                                 "/sec\tTrain-%s=%f",
-                                 param.epoch, count, speed, name, value)
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples"
-                                 "/sec", param.epoch, count, speed)
-                self.tic = time.time()
+        if not self.init:
+            self._rearm()
+            return
+        if count % self.frequent:
+            return
+        speed = self.frequent * self.batch_size / (time.time() - self.tic)
+        if param.eval_metric is None:
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, count, speed)
         else:
-            self.init = True
-            self.tic = time.time()
+            name, value = param.eval_metric.get()
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
+                         "\tTrain-%s=%f",
+                         param.epoch, count, speed, name, value)
+        self._rearm()
 
 
 class ProgressBar:

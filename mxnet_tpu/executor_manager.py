@@ -28,22 +28,19 @@ __all__ = ["DataParallelExecutorManager", "DataParallelExecutorGroup",
 
 def _split_input_slice(batch_size, work_load_list):
     """Split batch into per-device slices proportional to work load
-    (reference executor_manager.py:11-43)."""
-    total_work_load = sum(work_load_list)
-    batch_num_list = [round(work_load * batch_size / total_work_load)
-                      for work_load in work_load_list]
-    batch_num_sum = sum(batch_num_list)
-    if batch_num_sum < batch_size:
-        batch_num_list[-1] += batch_size - batch_num_sum
-    slices = []
-    end = 0
-    for batch_num in batch_num_list:
-        begin = int(min(end, batch_size))
-        end = int(min(begin + batch_num, batch_size))
-        if begin >= end:
-            raise ValueError("Too many slices such that some splits are empty")
-        slices.append(slice(begin, end))
-    return slices
+    (reference executor_manager.py:11-43 semantics): per-device counts
+    are the rounded proportional shares, any rounding shortfall lands on
+    the last device, and boundaries are clamped to the batch."""
+    total = sum(work_load_list)
+    counts = [round(w * batch_size / total) for w in work_load_list]
+    if sum(counts) < batch_size:
+        counts[-1] += batch_size - sum(counts)
+    bounds = [0]
+    for c in counts:
+        bounds.append(int(min(bounds[-1] + c, batch_size)))
+    if any(lo >= hi for lo, hi in zip(bounds, bounds[1:])):
+        raise ValueError("Too many slices such that some splits are empty")
+    return [slice(lo, hi) for lo, hi in zip(bounds, bounds[1:])]
 
 
 def _check_arguments(symbol):
@@ -141,17 +138,16 @@ class DataParallelExecutorGroup:
         _load_label(data_batch, self.label_arrays)
 
     def forward(self, is_train=False):
-        for texec in self.train_execs:
-            texec.forward(is_train=is_train)
+        for ex in self.train_execs:
+            ex.forward(is_train=is_train)
 
     def backward(self):
-        for texec in self.train_execs:
-            texec.backward()
+        for ex in self.train_execs:
+            ex.backward()
 
     def update_metric(self, metric, labels):
-        for texec, islice in zip(self.train_execs, self.slices):
-            labels_slice = [label[islice] for label in labels]
-            metric.update(labels_slice, texec.outputs)
+        for ex, part in zip(self.train_execs, self.slices):
+            metric.update([lbl[part] for lbl in labels], ex.outputs)
 
 
 class DataParallelExecutorManager:
@@ -188,17 +184,20 @@ class DataParallelExecutorManager:
             monitor.install(train_exec)
 
     def set_params(self, arg_params, aux_params):
-        for texec in self.execgrp.train_execs:
-            texec.copy_params_from(arg_params, aux_params)
+        for ex in self.execgrp.train_execs:
+            ex.copy_params_from(arg_params, aux_params)
+
+    @staticmethod
+    def _mean_out(names, blocks, dst):
+        """Device-mean each replicated block into ``dst`` on host."""
+        for name, replicas in zip(names, blocks):
+            mean = sum(r.copyto(cpu()) for r in replicas) / len(replicas)
+            mean.copyto(dst[name])
 
     def copy_to(self, arg_params, aux_params):
         """Copy (averaged over devices) params out (reference :300-310)."""
-        for name, block in zip(self.param_names, self.param_arrays):
-            weight = sum(w.copyto(cpu()) for w in block) / len(block)
-            weight.copyto(arg_params[name])
-        for name, block in zip(self.aux_names, self.aux_arrays):
-            weight = sum(w.copyto(cpu()) for w in block) / len(block)
-            weight.copyto(aux_params[name])
+        self._mean_out(self.param_names, self.param_arrays, arg_params)
+        self._mean_out(self.aux_names, self.aux_arrays, aux_params)
 
     @property
     def param_arrays(self):
@@ -212,19 +211,21 @@ class DataParallelExecutorManager:
     def aux_arrays(self):
         return self.curr_execgrp.aux_arrays
 
+    def _group_for(self, batch):
+        """The executor group serving this batch: the sole group when
+        not bucketing, else the bucket's group (built on first sight,
+        sharing params with the default group)."""
+        if self.sym_gen is None:
+            return self.execgrp
+        key = batch.bucket_key
+        if key not in self.execgrp_bucket:
+            self.execgrp_bucket[key] = DataParallelExecutorGroup(
+                self.sym_gen(key), self.arg_names, self.param_names,
+                self.ctx, self.slices, batch, shared_group=self.execgrp)
+        return self.execgrp_bucket[key]
+
     def load_data_batch(self, data_batch):
-        if self.sym_gen is not None:
-            key = data_batch.bucket_key
-            if key not in self.execgrp_bucket:
-                # create new bucket entry sharing params with the default
-                symbol = self.sym_gen(key)
-                execgrp = DataParallelExecutorGroup(
-                    symbol, self.arg_names, self.param_names, self.ctx,
-                    self.slices, data_batch, shared_group=self.execgrp)
-                self.execgrp_bucket[key] = execgrp
-            self.curr_execgrp = self.execgrp_bucket[key]
-        else:
-            self.curr_execgrp = self.execgrp
+        self.curr_execgrp = self._group_for(data_batch)
         self.curr_execgrp.load_data_batch(data_batch)
 
     def forward(self, is_train=False):

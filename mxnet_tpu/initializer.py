@@ -151,16 +151,20 @@ class Mixed:
 
 
 class Uniform(Initializer):
+    """Uniform draw on [-scale, scale]."""
+
     def __init__(self, scale=0.07):
-        self.scale = scale
+        self.scale = float(scale)
 
     def _init_weight(self, _, arr):
         random.uniform(-self.scale, self.scale, out=arr)
 
 
 class Normal(Initializer):
+    """Zero-mean gaussian draw with standard deviation ``sigma``."""
+
     def __init__(self, sigma=0.01):
-        self.sigma = sigma
+        self.sigma = float(sigma)
 
     def _init_weight(self, _, arr):
         random.normal(0, self.sigma, out=arr)
@@ -186,34 +190,31 @@ class Orthogonal(Initializer):
 
 
 class Xavier(Initializer):
-    """Xavier/Glorot init (reference initializer.py Xavier)."""
+    """Xavier/Glorot init (reference initializer.py Xavier): draw from a
+    distribution scaled by ``sqrt(magnitude / factor)`` where ``factor``
+    is a fan statistic of the weight. Convolution kernels [O, I, *K]
+    count the receptive field into both fans."""
+
+    _FACTOR = {"avg": lambda fi, fo: (fi + fo) / 2.0,
+               "in": lambda fi, fo: fi,
+               "out": lambda fi, fo: fo}
+    _DRAW = {"uniform": lambda s, arr: random.uniform(-s, s, out=arr),
+             "gaussian": lambda s, arr: random.normal(0, s, out=arr)}
 
     def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        if factor_type not in self._FACTOR:
+            raise ValueError("Incorrect factor type")
+        if rnd_type not in self._DRAW:
+            raise ValueError("Unknown random type")
         self.rnd_type = rnd_type
         self.factor_type = factor_type
         self.magnitude = float(magnitude)
 
     def _init_weight(self, _, arr):
-        shape = arr.shape
-        hw_scale = 1.0
-        if len(shape) > 2:
-            hw_scale = np.prod(shape[2:])
-        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
-        if self.factor_type == "avg":
-            factor = (fan_in + fan_out) / 2.0
-        elif self.factor_type == "in":
-            factor = fan_in
-        elif self.factor_type == "out":
-            factor = fan_out
-        else:
-            raise ValueError("Incorrect factor type")
-        scale = np.sqrt(self.magnitude / factor)
-        if self.rnd_type == "uniform":
-            random.uniform(-scale, scale, out=arr)
-        elif self.rnd_type == "gaussian":
-            random.normal(0, scale, out=arr)
-        else:
-            raise ValueError("Unknown random type")
+        receptive = float(np.prod(arr.shape[2:])) if arr.ndim > 2 else 1.0
+        fans = arr.shape[1] * receptive, arr.shape[0] * receptive
+        scale = np.sqrt(self.magnitude / self._FACTOR[self.factor_type](*fans))
+        self._DRAW[self.rnd_type](scale, arr)
 
 
 class MSRAPrelu(Xavier):

@@ -16,6 +16,7 @@ from __future__ import annotations
 import gzip
 import os
 import struct
+import queue
 import threading
 
 import numpy as np
@@ -184,8 +185,13 @@ class NDArrayIter(DataIter):
 
 
 class ResizeIter(DataIter):
-    """Resize an iterator to a fixed number of batches per epoch
-    (reference io.py:112)."""
+    """Redefine an iterator's epoch as exactly ``size`` batches
+    (reference io.py:112 semantics): shorter epochs stop early, longer
+    ones restart the wrapped iterator mid-epoch as needed.
+
+    ``reset_internal=False`` decouples the two epoch notions entirely —
+    the wrapped iterator keeps its own position across our resets.
+    """
 
     def __init__(self, data_iter, size, reset_internal=True):
         super().__init__()
@@ -194,26 +200,32 @@ class ResizeIter(DataIter):
         self.reset_internal = reset_internal
         self.cur = 0
         self.current_batch = None
-        self.provide_data = data_iter.provide_data
-        self.provide_label = data_iter.provide_label
-        self.batch_size = data_iter.batch_size
+        # batch geometry is whatever the wrapped iterator provides
+        for attr in ("provide_data", "provide_label", "batch_size"):
+            setattr(self, attr, getattr(data_iter, attr))
 
     def reset(self):
         self.cur = 0
         if self.reset_internal:
             self.data_iter.reset()
 
-    def iter_next(self):
-        if self.cur == self.size:
-            return False
+    def _draw(self):
+        """Next batch from the wrapped iterator, restarting it at
+        epoch boundaries so our own epoch length is ``size`` alone."""
         try:
-            self.current_batch = self.data_iter.next()
+            return self.data_iter.next()
         except StopIteration:
             self.data_iter.reset()
-            self.current_batch = self.data_iter.next()
+            return self.data_iter.next()
+
+    def iter_next(self):
+        if self.cur >= self.size:
+            return False
         self.cur += 1
+        self.current_batch = self._draw()
         return True
 
+    # the wrapped batch is passed through whole
     def getdata(self):
         return self.current_batch.data
 
@@ -227,98 +239,119 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
-class PrefetchingIter(DataIter):
-    """Thread-pipelined prefetcher over one or more iterators (reference
-    io.py:166; C++ analogue iter_prefetcher.h dmlc::ThreadedIter).
+class _PipelineWorker(threading.Thread):
+    """Depth-1 producer for one iterator: a request/response channel.
 
-    Overlaps host-side batch preparation with device compute — the same
-    cross-step overlap the reference's engine provides.
+    The consumer keeps exactly one fetch request outstanding, so the
+    wrapped iterator's host-side work (decode, augment, collate) runs
+    while the previous batch is being consumed.
+    """
+
+    _FETCH, _QUIT = object(), object()
+
+    def __init__(self, it):
+        super().__init__(daemon=True)
+        self._it = it
+        self._requests = queue.Queue()   # unbounded: posting never blocks
+        self._results = queue.Queue()
+        self._pending = True             # a fetch is requested/in flight
+        self.start()
+        self._requests.put(self._FETCH)  # pipeline primed at construction
+
+    def run(self):
+        while self._requests.get() is not self._QUIT:
+            try:
+                batch = self._it.next()
+            except StopIteration:
+                batch = None             # epoch-boundary marker
+            self._results.put(batch)
+
+    def take(self):
+        """Collect the in-flight batch and post the next request — but
+        NOT past an epoch end: after None the wrapped iterator must not
+        be touched again until restart(), or iterators with carry-over
+        state (NDArrayIter roll_over cursors) would advance twice."""
+        if not self._pending:
+            return None                  # exhausted, awaiting restart()
+        batch = self._results.get()
+        if batch is None:
+            self._pending = False
+        else:
+            self._requests.put(self._FETCH)
+        return batch
+
+    def restart(self):
+        """Absorb any in-flight fetch, rewind the iterator, re-prime."""
+        if self._pending:
+            self._results.get()
+        self._it.reset()
+        self._pending = True
+        self._requests.put(self._FETCH)
+
+    def stop(self):
+        self._requests.put(self._QUIT)
+
+
+class PrefetchingIter(DataIter):
+    """Host-pipelined prefetcher over one or more iterators (the role of
+    reference io.py:166 / dmlc::ThreadedIter in iter_prefetcher.h):
+    batch i+1 is prepared by worker threads while batch i is in use,
+    overlapping input preparation with device compute.
+
+    Built from one ``_PipelineWorker`` queue pair per wrapped iterator;
+    an epoch boundary travels through the response stream as ``None``
+    from every worker at once. ``rename_data``/``rename_label`` remap
+    the provided names per iterator (one dict each), letting several
+    sources feed differently-named model inputs.
     """
 
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
-        if not isinstance(iters, list):
-            iters = [iters]
-        self.n_iter = len(iters)
-        assert self.n_iter > 0
-        self.iters = iters
+        self.iters = iters if isinstance(iters, list) else [iters]
+        assert self.iters, "PrefetchingIter needs at least one iterator"
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0][1][0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
-        self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
-        self.next_batch = [None for _ in range(self.n_iter)]
-
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
-                try:
-                    self.next_batch[i] = self.iters[i].next()
-                except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
-
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
-            for i in range(self.n_iter)]
-        for t in self.prefetch_threads:
-            t.start()
+        self.current_batch = None
+        self._workers = [_PipelineWorker(i) for i in self.iters]
 
     def __del__(self):
-        self.started = False
-        for e in self.data_taken:
-            e.set()
+        for w in getattr(self, "_workers", []):
+            w.stop()
+
+    @staticmethod
+    def _combined(provides, renames):
+        if renames is None:
+            return [entry for p in provides for entry in p]
+        return [(r[name], shape) for r, p in zip(renames, provides)
+                for name, shape in p]
 
     @property
     def provide_data(self):
-        if self.rename_data is None:
-            return sum([i.provide_data for i in self.iters], [])
-        return sum([[(r[n], s) for n, s in i.provide_data]
-                    for r, i in zip(self.rename_data, self.iters)], [])
+        return self._combined([i.provide_data for i in self.iters],
+                              self.rename_data)
 
     @property
     def provide_label(self):
-        if self.rename_label is None:
-            return sum([i.provide_label for i in self.iters], [])
-        return sum([[(r[n], s) for n, s in i.provide_label]
-                    for r, i in zip(self.rename_label, self.iters)], [])
+        return self._combined([i.provide_label for i in self.iters],
+                              self.rename_label)
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
-        for i in self.iters:
-            i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        for w in self._workers:
+            w.restart()
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
-        if self.next_batch[0] is None:
-            for i in self.next_batch:
-                assert i is None, "Number of entry mismatches between iterators"
+        parts = [w.take() for w in self._workers]
+        ended = [p is None for p in parts]
+        if any(ended):
+            assert all(ended), "iterators ended at different batch counts"
             return False
-        for batch in self.next_batch:
-            assert batch.pad == self.next_batch[0].pad, \
-                "Number of entry mismatches between iterators"
+        assert all(p.pad == parts[0].pad for p in parts), \
+            "iterators disagree on batch padding"
         self.current_batch = DataBatch(
-            sum([batch.data for batch in self.next_batch], []),
-            sum([batch.label for batch in self.next_batch], []),
-            self.next_batch[0].pad,
-            self.next_batch[0].index)
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+            [d for p in parts for d in p.data],
+            [l for p in parts for l in p.label],
+            parts[0].pad, parts[0].index)
         return True
 
     def getdata(self):

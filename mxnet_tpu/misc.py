@@ -10,13 +10,14 @@ the rate changes.
 from __future__ import annotations
 
 import logging
-import math
 
 __all__ = ["LearningRateScheduler", "FactorScheduler"]
 
 
 class LearningRateScheduler:
-    """Base class: maps an iteration count to a learning rate."""
+    """Base class: maps an iteration count to a learning rate. The owner
+    (optimizer) assigns ``base_lr`` after construction, so the default
+    here only matters for standalone use."""
 
     def __init__(self):
         self.base_lr = 0.01
@@ -26,26 +27,30 @@ class LearningRateScheduler:
 
 
 class FactorScheduler(LearningRateScheduler):
-    """Reduce the learning rate by `factor` every `step` iterations."""
+    """Staircase decay: multiply the rate by ``factor`` once per ``step``
+    iterations, i.e. ``base_lr * factor**(iteration // step)``.
+
+    The schedule itself is stateless (any iteration can be queried out
+    of order); the only state is the last rate returned, kept so each
+    decay is logged exactly once.
+    """
 
     def __init__(self, step, factor=0.1):
         super().__init__()
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1 round")
+            raise ValueError("step must be a positive iteration count")
         if factor >= 1.0:
-            raise ValueError("Factor must be less than 1 to make lr reduce")
+            raise ValueError("factor must be < 1 so the rate decays")
         self.step = step
         self.factor = factor
-        self.old_lr = self.base_lr
-        self.init = False
+        self.old_lr = None
 
     def __call__(self, iteration):
-        if not self.init:
-            self.init = True
+        if self.old_lr is None:
             self.old_lr = self.base_lr
-        lr = self.base_lr * math.pow(self.factor, int(iteration / self.step))
+        lr = self.base_lr * self.factor ** (iteration // self.step)
         if lr != self.old_lr:
             self.old_lr = lr
-            logging.info("At Iteration [%d]: Swith to new learning rate %.5f",
+            logging.info("Iteration %d: learning rate decayed to %.5f",
                          iteration, lr)
         return lr

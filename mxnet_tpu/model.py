@@ -168,9 +168,9 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
 
     train_data.reset()
     for epoch in range(begin_epoch, end_epoch):
-        tic = time.time()
-        eval_metric.reset()
+        epoch_start = time.time()
         nbatch = 0
+        eval_metric.reset()
         for data_batch in _epoch_batches(train_data, epoch_size, logger,
                                          epoch):
             executor_manager.load_data_batch(data_batch)
@@ -197,8 +197,8 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
                                                  eval_metric=eval_metric,
                                                  locals=locals())
                 _run_callbacks(batch_end_callback, batch_end_params)
-        toc = time.time()
-        logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
+        logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                    time.time() - epoch_start)
 
         if epoch_end_callback or epoch + 1 == end_epoch:
             executor_manager.copy_to(arg_params, aux_params)

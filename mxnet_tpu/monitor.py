@@ -55,38 +55,44 @@ class Monitor:
                                  should_run=lambda: self.activated)
         self.exes.append(exe)
 
+    def _sync_args(self):
+        """Fence: all in-flight argument updates land before sampling."""
+        for exe in self.exes:
+            for array in exe.arg_arrays:
+                array.wait_to_read()
+
     def tic(self):
-        """Start collecting for this batch if interval elapsed (:65)."""
+        """Arm collection for this batch when the interval elapses
+        (reference monitor.py tic:65)."""
         if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
+            self._sync_args()
             self.queue = []
             self.activated = True
         self.step += 1
 
+    @staticmethod
+    def _render(stat):
+        """One stat entry -> printable string; stat_func may return a
+        single NDArray or a list of them."""
+        values = stat if isinstance(stat, list) else [stat]
+        assert all(isinstance(v, NDArray) for v in values)
+        return ",".join("%f" % v.asnumpy().ravel()[0] for v in values)
+
     def toc(self):
-        """Stop collecting, return stats (:77-112)."""
+        """Disarm and drain: the queued per-node stats plus a sample of
+        every argument array (reference monitor.py toc:77-112)."""
         if not self.activated:
             return []
-        for exe in self.exes:
-            for array in exe.arg_arrays:
-                array.wait_to_read()
+        self._sync_args()
         for exe in self.exes:
             for name, array in zip(exe._arg_names, exe.arg_arrays):
                 self.stat_helper(name, array)
         self.activated = False
-        res = []
-        if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ",".join("%f" % v.asnumpy().ravel()[0] for v in v_list)
-            res.append((n, k, s))
+        entries = sorted(self.queue, key=lambda e: e[1]) if self.sort \
+            else self.queue
         self.queue = []
-        return res
+        return [(step, name, self._render(stat))
+                for step, name, stat in entries]
 
     def toc_print(self):
         """Print stats (reference toc_print)."""

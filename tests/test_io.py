@@ -75,6 +75,28 @@ def test_prefetching_iter():
     assert len([b for b in pref]) == len(got_base)
 
 
+def test_prefetching_iter_preserves_rollover_state():
+    """The prefetch worker must NOT touch the wrapped iterator past an
+    epoch-end StopIteration: NDArrayIter roll_over carries the cursor
+    across epochs, so an extra speculative fetch would shift every
+    subsequent epoch's batches."""
+    data = np.arange(5, dtype=np.float64)
+
+    def epochs(it, n):
+        out = []
+        for _ in range(n):
+            out.append([b.data[0].asnumpy().tolist() for b in it])
+            it.reset()
+        return out
+
+    direct = mx.io.NDArrayIter(data.copy(), batch_size=4,
+                               last_batch_handle="roll_over")
+    pref = mx.io.PrefetchingIter(
+        mx.io.NDArrayIter(data.copy(), batch_size=4,
+                          last_batch_handle="roll_over"))
+    assert epochs(pref, 3) == epochs(direct, 3)
+
+
 def _write_mnist(tmp_path, n=256):
     rs = np.random.RandomState(0)
     images = rs.randint(0, 255, (n, 28, 28)).astype(np.uint8)
