@@ -1,0 +1,80 @@
+# Training callbacks (role of reference R-package/R/callback.R).
+#
+# One protocol for both hooks of mx.model.FeedForward.create: the
+# callback receives a single `env` list and returns TRUE to continue.
+#   batch.end.callback  env$round, env$batch, env$metric
+#   epoch.end.callback  env$round, env$metric, env$symbol,
+#                       env$arg.params — returning FALSE stops training
+#                       early (see mx.callback.early.stop)
+
+#' Metric history collector: logger <- mx.metric.logger$new(), then pass
+#' it to mx.callback.log.train.metric to record the per-call values in
+#' logger$train.
+#' @export
+mx.metric.logger <- list(new = function() {
+  env <- new.env()
+  env$train <- numeric(0)
+  env$eval <- numeric(0)
+  env
+})
+
+#' Log (and optionally record) the training metric every `period`
+#' batches — usable as either callback slot
+#' @export
+mx.callback.log.train.metric <- function(period = 50, logger = NULL) {
+  function(env) {
+    at <- if (is.null(env$batch)) env$round else env$batch
+    if (at %% period == 0) {
+      m <- metric.get(env$metric)
+      message(sprintf("Batch [%d] Train-%s=%f", at, m$name, m$value))
+      if (!is.null(logger)) logger$train <- c(logger$train, m$value)
+    }
+    TRUE
+  }
+}
+
+#' Log training throughput every `frequent` batches
+#' @export
+mx.callback.log.speedometer <- function(batch.size, frequent = 50) {
+  state <- new.env()
+  state$tic <- proc.time()[["elapsed"]]
+  state$last <- 0
+  function(env) {
+    if (env$batch %% frequent == 0) {
+      now <- proc.time()[["elapsed"]]
+      done <- env$batch - state$last
+      if (now > state$tic && done > 0) {
+        message(sprintf("Batch [%d] Speed: %.2f samples/sec", env$batch,
+                        done * batch.size / (now - state$tic)))
+      }
+      state$tic <- now
+      state$last <- env$batch
+    }
+    TRUE
+  }
+}
+
+#' Checkpoint the model every `period` epochs
+#' @export
+mx.callback.save.checkpoint <- function(prefix, period = 1) {
+  function(env) {
+    if (env$round %% period == 0) {
+      mx.model.save(list(symbol = env$symbol,
+                         arg.params = env$arg.params),
+                    prefix, env$round)
+      message(sprintf("Model checkpoint saved to %s-%04d.params",
+                      prefix, env$round))
+    }
+    TRUE
+  }
+}
+
+#' Stop training once the metric improves past `threshold` (lower is
+#' better, e.g. rmse)
+#' @export
+mx.callback.early.stop <- function(threshold) {
+  function(env) {
+    m <- metric.get(env$metric)
+    !(is.finite(m$value) && m$value < threshold)
+  }
+}

@@ -13,6 +13,24 @@ mx.metric.accuracy <- mx.metric.custom("accuracy", function(label, pred) {
   mean(yhat == as.vector(label))
 })
 
+#' Root mean squared error (regression heads emit one column)
+#' @export
+mx.metric.rmse <- mx.metric.custom("rmse", function(label, pred) {
+  sqrt(mean((as.vector(label) - as.vector(pred))^2))
+})
+
+#' Mean absolute error
+#' @export
+mx.metric.mae <- mx.metric.custom("mae", function(label, pred) {
+  mean(abs(as.vector(label) - as.vector(pred)))
+})
+
+#' Root mean squared log error
+#' @export
+mx.metric.rmsle <- mx.metric.custom("rmsle", function(label, pred) {
+  sqrt(mean((log1p(as.vector(pred)) - log1p(as.vector(label)))^2))
+})
+
 metric.update <- function(metric, label, pred) {
   metric$sum <- metric$sum + metric$feval(label, pred)
   metric$n <- metric$n + 1

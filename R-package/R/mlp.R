@@ -1,0 +1,33 @@
+# Convenience multi-layer perceptron (role of reference
+# R-package/R/mlp.R): stack FullyConnected + Activation layers from a
+# width vector and train with mx.model.FeedForward.create.
+
+#' Train a multi-layer perceptron in one call
+#'
+#' @param data training matrix, one example per row
+#' @param label label vector (class ids for softmax, values for rmse)
+#' @param hidden_node integer vector of hidden-layer widths
+#' @param out_node output-layer width (number of classes, or 1)
+#' @param out_activation "softmax", "logistic", or "rmse" output loss
+#' @param activation hidden activation ("tanh", "relu", "sigmoid")
+#' @param ... passed to mx.model.FeedForward.create (num.round,
+#'   array.batch.size, learning.rate, momentum, eval.metric, ...)
+#' @export
+mx.mlp <- function(data, label, hidden_node = 1, out_node = 2,
+                   out_activation = "softmax", activation = "tanh",
+                   ctx = mx.cpu(), ...) {
+  net <- mx.symbol.Variable("data")
+  for (i in seq_along(hidden_node)) {
+    net <- mx.symbol.FullyConnected(data = net,
+                                    num_hidden = hidden_node[[i]])
+    net <- mx.symbol.Activation(data = net, act_type = activation)
+  }
+  net <- mx.symbol.FullyConnected(data = net, num_hidden = out_node)
+  net <- switch(out_activation,
+                softmax = mx.symbol.SoftmaxOutput(data = net,
+                                                  name = "softmax"),
+                logistic = mx.symbol.LogisticRegressionOutput(data = net),
+                rmse = mx.symbol.LinearRegressionOutput(data = net),
+                stop("unknown out_activation: ", out_activation))
+  mx.model.FeedForward.create(net, X = data, y = label, ctx = ctx, ...)
+}

@@ -49,6 +49,22 @@ mx.opt.update <- function(opt, index, weight, grad) {
   }
 }
 
+#' Name of the label argument a loss-headed symbol expects (the
+#' argument ending in `_label`; `softmax_label` for SoftmaxOutput,
+#' `linearregressionoutput*_label` for regression heads, ...)
+mx.model.label.name <- function(symbol) {
+  names <- arguments(symbol)
+  hit <- grep("_label$", names, value = TRUE)
+  if (length(hit) == 0) "softmax_label" else hit[[1]]
+}
+
+#' Uniform-init descriptor, accepted by the `initializer` argument of
+#' mx.model.FeedForward.create (reference mx.init.uniform)
+#' @export
+mx.init.uniform <- function(scale) {
+  structure(list(scale = scale), class = "MXInitializer")
+}
+
 #' Train a model from in-memory data (reference
 #' mx.model.FeedForward.create)
 #' @export
@@ -58,11 +74,17 @@ mx.model.FeedForward.create <- function(symbol, X, y, ctx = mx.cpu(),
                                         learning.rate = 0.01,
                                         momentum = 0,
                                         initializer.scale = 0.07,
+                                        initializer = NULL,
+                                        eval.metric = mx.metric.accuracy,
+                                        batch.end.callback = NULL,
+                                        epoch.end.callback = NULL,
                                         verbose = TRUE) {
+  if (!is.null(initializer)) initializer.scale <- initializer$scale
   n <- nrow(X)
   batch <- min(array.batch.size, n)
-  input.shapes <- list(data = c(batch, ncol(X)),
-                       softmax_label = c(batch))
+  label.name <- mx.model.label.name(symbol)
+  input.shapes <- list(data = c(batch, ncol(X)))
+  input.shapes[[label.name]] <- c(batch)
   init <- mx.model.init.params(symbol, input.shapes, initializer.scale,
                                ctx)
   arg.names <- arguments(symbol)
@@ -91,7 +113,7 @@ mx.model.FeedForward.create <- function(symbol, X, y, ctx = mx.cpu(),
 
   opt <- mx.opt.sgd(learning.rate, momentum, 1 / batch)
   nbatches <- floor(n / batch)
-  metric <- mx.metric.accuracy
+  metric <- eval.metric
   for (round in seq_len(num.round)) {
     metric <- metric.reset(metric)
     for (b in seq_len(nbatches)) {
@@ -101,7 +123,7 @@ mx.model.FeedForward.create <- function(symbol, X, y, ctx = mx.cpu(),
       .Call(MXR_FuncInvoke, "_copyto", list(xb$handle), numeric(0),
             list(exec.args$data$handle))
       .Call(MXR_FuncInvoke, "_copyto", list(yb$handle), numeric(0),
-            list(exec.args$softmax_label$handle))
+            list(exec.args[[label.name]]$handle))
       mx.exec.forward(exec, is.train = TRUE)
       mx.exec.backward(exec)
       for (i in seq_along(arg.names)) {
@@ -114,39 +136,111 @@ mx.model.FeedForward.create <- function(symbol, X, y, ctx = mx.cpu(),
       }
       out <- mx.exec.outputs(exec)[[1]]
       metric <- metric.update(metric, as.array(yb), as.array(out))
+      if (!is.null(batch.end.callback)) {
+        batch.end.callback(list(round = round, batch = b,
+                                metric = metric))
+      }
     }
     if (verbose) {
       m <- metric.get(metric)
       message(sprintf("Round [%d] Train-%s=%f", round, m$name, m$value))
     }
+    if (!is.null(epoch.end.callback)) {
+      keep <- epoch.end.callback(list(round = round, metric = metric,
+                                      symbol = symbol,
+                                      arg.params = init$arg.params))
+      if (identical(keep, FALSE)) break
+    }
   }
+  aux.names <- auxiliary.states(symbol)
+  names(aux) <- aux.names
   structure(list(symbol = symbol, arg.params = init$arg.params,
-                 ctx = ctx, batch = batch),
+                 aux.params = aux, ctx = ctx, batch = batch),
             class = "MXFeedForwardModel")
 }
 
-#' Predict class probabilities
+#' Save a model as `prefix-symbol.json` + `prefix-NNNN.params` — the
+#' same checkpoint format every other binding reads (arg:/aux: name
+#' prefixes), so R-trained models load in Python and vice versa.
+#' @export
+mx.model.save <- function(model, prefix, iteration) {
+  writeLines(mx.symbol.to.json(model$symbol),
+             sprintf("%s-symbol.json", prefix))
+  params <- model$arg.params
+  names(params) <- paste0("arg:", names(params))
+  for (name in names(model$aux.params)) {
+    params[[paste0("aux:", name)]] <- model$aux.params[[name]]
+  }
+  mx.nd.save(params, sprintf("%s-%04d.params", prefix, iteration))
+  invisible(model)
+}
+
+#' Load a checkpoint saved by any binding
+#' @export
+mx.model.load <- function(prefix, iteration) {
+  symbol <- mx.symbol.load.json(
+    paste(readLines(sprintf("%s-symbol.json", prefix)), collapse = "\n"))
+  blobs <- mx.nd.load(sprintf("%s-%04d.params", prefix, iteration))
+  arg.params <- list()
+  aux.params <- list()
+  for (name in names(blobs)) {
+    if (startsWith(name, "arg:")) {
+      arg.params[[substring(name, 5)]] <- blobs[[name]]
+    } else if (startsWith(name, "aux:")) {
+      aux.params[[substring(name, 5)]] <- blobs[[name]]
+    }
+  }
+  structure(list(symbol = symbol, arg.params = arg.params,
+                 aux.params = aux.params, ctx = mx.cpu(), batch = 128),
+            class = "MXFeedForwardModel")
+}
+
+#' Predict class probabilities. X is either a matrix (one example per
+#' ROW, the 2-d path) or an array whose LAST R dimension is the batch
+#' (e.g. c(224, 224, 3, n) images — the R-layout mirror of the
+#' framework's NCHW).
 #' @export
 predict.MXFeedForwardModel <- function(object, X, ...) {
-  n <- nrow(X)
+  two.d <- length(dim(X)) <= 2
+  dims <- if (two.d) c(ncol(X), nrow(X)) else dim(X)
+  feature.dims <- dims[-length(dims)]
+  n <- dims[[length(dims)]]
   batch <- min(object$batch, n)
-  exec <- mx.simple.bind(object$symbol, object$ctx, grad.req = "null",
-                         data = c(batch, ncol(X)),
-                         softmax_label = c(batch))
+
+  take <- function(idx) {  # examples `idx`, padded to a full batch
+    idx <- c(idx, rep(idx[[1]], batch - length(idx)))
+    if (two.d) return(X[idx, , drop = FALSE])
+    args <- c(list(X), rep(TRUE, length(feature.dims)), list(idx),
+              list(drop = FALSE))
+    do.call(`[`, args)
+  }
+
+  bind.shapes <- list(object$symbol, object$ctx, grad.req = "null",
+                      data = if (two.d) c(batch, feature.dims)
+                             else c(feature.dims, batch))
+  bind.shapes[[mx.model.label.name(object$symbol)]] <- c(batch)
+  exec <- do.call(mx.simple.bind, bind.shapes)
   for (name in names(object$arg.params)) {
     .Call(MXR_FuncInvoke, "_copyto",
           list(object$arg.params[[name]]$handle), numeric(0),
           list(exec$arg.arrays[[name]]$handle))
   }
+  # aux states (BatchNorm moving stats) position-match the symbol's
+  # auxiliary.states order; without this, loaded checkpoints would
+  # normalize with zeroed stats
+  aux.names <- auxiliary.states(object$symbol)
+  for (i in seq_along(aux.names)) {
+    src <- object$aux.params[[aux.names[[i]]]]
+    if (!is.null(src)) {
+      .Call(MXR_FuncInvoke, "_copyto", list(src$handle), numeric(0),
+            list(exec$aux.arrays[[i]]$handle))
+    }
+  }
   out <- NULL
   for (b in seq_len(ceiling(n / batch))) {
     lo <- (b - 1) * batch + 1
     hi <- min(b * batch, n)
-    xb <- X[lo:hi, , drop = FALSE]
-    if (nrow(xb) < batch) {  # pad the tail batch
-      xb <- rbind(xb, xb[rep(1, batch - nrow(xb)), , drop = FALSE])
-    }
-    nd <- mx.nd.array(xb, object$ctx)
+    nd <- mx.nd.array(take(lo:hi), object$ctx)
     .Call(MXR_FuncInvoke, "_copyto", list(nd$handle), numeric(0),
           list(exec$arg.arrays$data$handle))
     mx.exec.forward(exec, is.train = FALSE)

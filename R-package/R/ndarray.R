@@ -45,6 +45,17 @@ mx.nd.internal.shape <- function(nd) {
   .Call(MXR_NDArrayGetShape, nd$handle)
 }
 
+#' Copy an NDArray to a (possibly different) device
+#' @export
+mx.nd.copyto <- function(src, ctx) {
+  shape <- mx.nd.internal.shape(src)  # already framework (row-major) order
+  handle <- .Call(MXR_NDArrayCreate, as.integer(shape),
+                  ctx$device_typeid, ctx$device_id)
+  .Call(MXR_FuncInvoke, "_copyto", list(src$handle), numeric(0),
+        list(handle))
+  new.ndarray(handle)
+}
+
 #' Copy an NDArray back to an R array (blocking read)
 #' @export
 as.array.MXNDArray <- function(x, ...) {

@@ -1,0 +1,27 @@
+# Random-number helpers (role of reference R-package/R/random.R).
+#
+# Draws happen with R's own RNG on the host and are staged into device
+# NDArrays — the R binding's analogue of the Python package's
+# host-seeded streams. mx.set.seed therefore controls every stochastic
+# path in this binding (init, mx.runif, mx.rnorm).
+
+#' Seed the framework RNG used by initializers and samplers
+#' @export
+mx.set.seed <- function(seed) {
+  set.seed(seed)
+  invisible(seed)
+}
+
+#' Uniform random NDArray on [min, max)
+#' @export
+mx.runif <- function(shape, min = 0, max = 1, ctx = mx.cpu()) {
+  v <- array(stats::runif(prod(shape), min, max), dim = shape)
+  mx.nd.array(v, ctx)
+}
+
+#' Gaussian random NDArray
+#' @export
+mx.rnorm <- function(shape, mean = 0, sd = 1, ctx = mx.cpu()) {
+  v <- array(stats::rnorm(prod(shape), mean, sd), dim = shape)
+  mx.nd.array(v, ctx)
+}

@@ -31,6 +31,18 @@ mx.symbol.to.json <- function(symbol) {
   .Call(MXR_SymbolToJSON, symbol$handle)
 }
 
+#' Compose a symbol with new inputs by argument name (reference
+#' mx.apply): returns a NEW symbol; the original is untouched (deep
+#' copy via the JSON round trip — no mutation of shared graphs).
+#' @export
+mx.apply <- function(symbol, ..., name = "") {
+  inputs <- list(...)
+  copy <- mx.symbol.load.json(mx.symbol.to.json(symbol))
+  .Call(MXR_SymbolCompose, copy$handle, name, names(inputs),
+        lapply(inputs, function(s) s$handle))
+  copy
+}
+
 arguments <- function(symbol) {
   .Call(MXR_SymbolListArguments, symbol$handle)
 }

@@ -1,0 +1,45 @@
+# Computation-graph visualization (role of reference
+# R-package/R/viz.graph.R). Dependency-free: rather than binding a
+# plotting package, emit GraphViz DOT text from the symbol's JSON —
+# pipe it to `dot -Tsvg` or any renderer.
+
+#' Render a symbol's graph as GraphViz DOT text
+#'
+#' @param json symbol JSON from mx.symbol.to.json(sym)
+#' @param print.dot cat the DOT source (default) in addition to
+#'   returning it invisibly
+#' @export
+graph.viz <- function(json, print.dot = TRUE) {
+  # pull "name" and "op" per node from the JSON node list; the format
+  # is the checkpoint-stable graph JSON every binding shares
+  node.re <- "\\{[^{}]*\"op\"[^{}]*\\}"
+  nodes <- regmatches(json, gregexpr(node.re, json))[[1]]
+  field <- function(node, key) {
+    m <- regmatches(node,
+                    regexec(sprintf("\"%s\": ?\"([^\"]*)\"", key), node))
+    if (length(m[[1]]) < 2) "" else m[[1]][[2]]
+  }
+  lines <- c("digraph mxnet_tpu {", "  rankdir=BT;")
+  for (i in seq_along(nodes)) {
+    op <- field(nodes[[i]], "op")
+    nm <- field(nodes[[i]], "name")
+    shape <- if (op == "null") "ellipse" else "box"
+    label <- if (op == "null") nm else sprintf("%s\\n%s", op, nm)
+    lines <- c(lines, sprintf("  n%d [label=\"%s\", shape=%s];",
+                              i - 1, label, shape))
+    inputs <- regmatches(nodes[[i]],
+                         regexec("\"inputs\": ?\\[(.*)\\]",
+                                 nodes[[i]]))[[1]]
+    if (length(inputs) >= 2 && nzchar(inputs[[2]])) {
+      srcs <- regmatches(inputs[[2]],
+                         gregexpr("\\[([0-9]+)", inputs[[2]]))[[1]]
+      for (s in srcs) {
+        lines <- c(lines, sprintf("  n%s -> n%d;",
+                                  sub("\\[", "", s), i - 1))
+      }
+    }
+  }
+  dot <- paste(c(lines, "}"), collapse = "\n")
+  if (print.dot) cat(dot, "\n")
+  invisible(dot)
+}
