@@ -92,10 +92,20 @@ class Decoder:
     compute_dtype : str, optional
         Cast floating parameters (and caches) for the decode math, e.g.
         ``"bfloat16"``; token ids are integer-semantic and never cast.
+    cache_block : int, optional
+        Prefix-bounded cache reads for single-token steps: attend over
+        only the ``ceil((pos+1)/cache_block)`` leading cache blocks via
+        an online-softmax ``lax.fori_loop`` (dynamic trip count) instead
+        of reading all ``max_len`` K/V rows every step. EXACT — online
+        softmax is a reassociation, not an approximation. Saves HBM
+        traffic proportional to the unfilled cache suffix (the K/V
+        buffers rival the parameters in bytes at long ``max_len``).
+        Must divide ``max_len``. ``None`` (default) keeps the one-shot
+        full-cache read.
     """
 
     def __init__(self, symbol, params, max_len, aux_params=None,
-                 compute_dtype=None):
+                 compute_dtype=None, cache_block=None):
         symbol = _logits_symbol(symbol)
         self._topo = symbol._topo()
         self._heads = symbol._heads
@@ -103,6 +113,13 @@ class Decoder:
             raise MXNetError("Decoder needs a single-output symbol, got %d"
                              % len(self._heads))
         self.max_len = int(max_len)
+        self._cache_block = None if cache_block is None else int(cache_block)
+        if self._cache_block is not None and (
+                self._cache_block < 1
+                or self.max_len % self._cache_block != 0):
+            raise MXNetError(
+                "Decoder: cache_block=%r must be a positive divisor of "
+                "max_len=%d" % (cache_block, self.max_len))
 
         self._mha = []
         for n in self._topo:
@@ -215,15 +232,58 @@ class Decoder:
                                       (0, pos, 0, 0))
         cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
                                       (0, pos, 0, 0))
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / float(np.sqrt(d))
-        kpos = jnp.arange(self.max_len)[None, None, None, :]
-        qpos = pos + jnp.arange(c)[None, None, :, None]
-        s = jnp.where(kpos <= qpos, s,
-                      jnp.float32(-1e30).astype(s.dtype))
-        o = jnp.einsum("bhqk,bkhd->bqhd",
-                       jax.nn.softmax(s, axis=-1), cv)
+        if self._cache_block is not None and c == 1:
+            o = self._blocked_attn(q, ck, cv, pos)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / float(np.sqrt(d))
+            kpos = jnp.arange(self.max_len)[None, None, None, :]
+            qpos = pos + jnp.arange(c)[None, None, :, None]
+            s = jnp.where(kpos <= qpos, s,
+                          jnp.float32(-1e30).astype(s.dtype))
+            o = jnp.einsum("bhqk,bkhd->bqhd",
+                           jax.nn.softmax(s, axis=-1), cv)
         return jnp.einsum("bte,fe->btf", o.reshape(b, c, e), wo) + bo, \
             ck, cv
+
+    def _blocked_attn(self, q, ck, cv, pos):
+        """Single-token attention reading only the filled cache prefix.
+
+        Online-softmax (flash-decoding) accumulation over the
+        ``ceil((pos+1)/cache_block)`` leading blocks of the K/V cache —
+        a ``lax.fori_loop`` whose trip count is the TRACED ``pos``, so
+        the compiled program's HBM reads grow with the decoded prefix
+        instead of always touching all ``max_len`` rows. Exact: the
+        running max/denominator reassociates the softmax, it does not
+        approximate it."""
+        b, c, h, d = q.shape
+        bl = self._cache_block
+        qf = q.astype(jnp.float32)
+        nblocks = (pos + bl) // bl  # ceil((pos+1)/bl), pos is traced
+
+        def body(i, carry):
+            m, s, acc = carry
+            kb = lax.dynamic_slice(ck, (0, i * bl, 0, 0), (b, bl, h, d))
+            vb = lax.dynamic_slice(cv, (0, i * bl, 0, 0), (b, bl, h, d))
+            sc = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                            kb.astype(jnp.float32)) / float(np.sqrt(d))
+            kpos = i * bl + jnp.arange(bl)[None, None, None, :]
+            sc = jnp.where(kpos <= pos, sc, -jnp.inf)
+            m2 = jnp.maximum(m, sc.max(axis=-1))
+            alpha = jnp.exp(m - m2)
+            p = jnp.exp(sc - m2[..., None])       # masked lanes -> 0
+            s2 = s * alpha + p.sum(axis=-1)
+            acc2 = acc * alpha[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32))
+            return m2, s2, acc2
+
+        m0 = jnp.full((b, h, c), -jnp.inf, jnp.float32)
+        s0 = jnp.zeros((b, h, c), jnp.float32)
+        a0 = jnp.zeros((b, h, c, d), jnp.float32)
+        # slot `pos` was just written, so block 0 always contributes:
+        # the denominator is never zero
+        _, s, acc = lax.fori_loop(0, nblocks, body, (m0, s0, a0))
+        o = (acc / s[..., None]).astype(q.dtype)   # [b,h,c,d]
+        return o.transpose(0, 2, 1, 3)             # [b,c,h,d]
 
     def _run(self, params, aux, caches, pos, tokens):
         """One chunk: tokens [B, C] at positions [pos, pos+C) →

@@ -193,6 +193,37 @@ def test_decode_step_prefill_bounds():
         dec.step(caches, -1, np.zeros((1,), np.int64))
 
 
+def test_decode_cache_block_matches_full_read():
+    """cache_block (prefix-bounded online-softmax reads) is a
+    reassociation of the same attention — step logits must agree with
+    the full-cache-read path and greedy generate must emit identical
+    tokens."""
+    rng = np.random.RandomState(12)
+    T = 12
+    sym = _lm()
+    params = _init_params(sym, T, 2, rng)
+    full = Decoder(sym, params, max_len=T)
+    blocked = Decoder(sym, params, max_len=T, cache_block=4)
+
+    toks = rng.randint(0, VOCAB, (2, T))
+    cf, cb = full.init_cache(2), blocked.init_cache(2)
+    _, cf = full.prefill(cf, toks[:, :5])
+    _, cb = blocked.prefill(cb, toks[:, :5])
+    for pos in range(5, T):  # crosses 4-slot block boundaries at 8, 12
+        lf, cf = full.step(cf, pos, toks[:, pos])
+        lb, cb = blocked.step(cb, pos, toks[:, pos])
+        np.testing.assert_allclose(np.asarray(lb), np.asarray(lf),
+                                   rtol=2e-5, atol=2e-5)
+
+    prompt = rng.randint(0, VOCAB, (2, 3))
+    np.testing.assert_array_equal(
+        np.asarray(blocked.generate(prompt, num_steps=7)),
+        np.asarray(full.generate(prompt, num_steps=7)))
+
+    with pytest.raises(mx.MXNetError, match="cache_block"):
+        Decoder(sym, params, max_len=T, cache_block=5)  # not a divisor
+
+
 def test_decode_rejects_rank3_batchnorm():
     """BatchNorm normalizes axis 1 — the time axis for [B, T, E] LM
     data — so it is NOT position-wise on rank-3 data; the decoder must
