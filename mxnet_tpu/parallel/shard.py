@@ -38,15 +38,24 @@ class ShardingRules:
     data_axes : tuple of axis names to shard the leading (batch) dim of
         every data/label input over. Defaults to ("dp",) when the mesh has
         a dp axis, else no sharding.
+    seq_axes : tuple of axis names to shard the SECOND (sequence) dim of
+        rank>=2 data/label inputs over (GSPMD sequence parallelism: the
+        compiler inserts the gathers attention needs). Default: none —
+        the dedicated ring-attention path (SequenceParallelTrainer) stays
+        the long-context default; this is the composition knob for
+        running dp x tp x sp in ONE pjit program.
     """
 
-    def __init__(self, mesh, param_rules=(), data_axes=None):
+    def __init__(self, mesh, param_rules=(), data_axes=None,
+                 seq_axes=None):
         self.mesh = mesh
         self.param_rules = [(re.compile(pat), spec)
                             for pat, spec in param_rules]
         if data_axes is None:
             data_axes = tuple(a for a in ("dp",) if a in mesh.shape)
         self.data_axes = tuple(a for a in data_axes if a in mesh.shape)
+        self.seq_axes = tuple(a for a in (seq_axes or ())
+                              if a in mesh.shape)
 
     # -- spec resolution -------------------------------------------------
     def _fit_spec(self, spec, shape):
@@ -79,15 +88,21 @@ class ShardingRules:
         return P()
 
     def data_spec(self, name, shape):
-        if not self.data_axes:
+        def fit(axes, dim):
+            size = 1
+            for ax in axes:
+                size *= self.mesh.shape[ax]
+            if not axes or dim % size != 0:
+                return None
+            return axes if len(axes) > 1 else axes[0]
+
+        if not shape:
             return P()
-        axes = self.data_axes
-        size = 1
-        for ax in axes:
-            size *= self.mesh.shape[ax]
-        if not shape or shape[0] % size != 0:
-            return P()
-        return P(axes if len(axes) > 1 else axes[0])
+        batch = fit(self.data_axes, shape[0])
+        seq = fit(self.seq_axes, shape[1]) if len(shape) > 1 else None
+        if seq is None:
+            return P(batch) if batch is not None else P()
+        return P(batch, seq)
 
     # -- NamedSharding helpers ------------------------------------------
     def param_sharding(self, name, shape):

@@ -203,6 +203,7 @@ class ParallelTrainer:
         self._rng = jax.random.PRNGKey(
             np.random.randint(0, 2**31 - 1) if seed is None else seed)
         self._jit_step = None
+        self._jit_multi = {}  # num_steps -> compiled scan-of-steps
         self._jit_eval = None
         if initializer is None:
             initializer = Uniform(0.01)
@@ -453,6 +454,57 @@ class ParallelTrainer:
                 self.params, self.opt_state, self.aux, batch,
                 np.float32(lr), np.int32(self._t), self._rng)
         return outs
+
+    def _build_multi_step(self, num_steps):
+        def run(params, opt_state, aux, batch, lrs, t0, rng_base):
+            def body(carry, lr_i):
+                p, s, a = carry
+                lr, idx = lr_i
+                p, s, a, outs = self._step_impl(p, s, list(a), batch,
+                                                lr, t0 + 1 + idx,
+                                                rng_base)
+                return (p, s, a), None
+
+            (p, s, a), _ = lax.scan(
+                body, (params, opt_state, list(aux)),
+                (lrs, jnp.arange(num_steps)))
+            return p, s, list(a)
+
+        in_sh = (self._param_sh, self._opt_sh, None, self._data_sh,
+                 self._repl, self._repl, self._repl)
+        out_sh = (self._param_sh, self._opt_sh, None)
+        return jax.jit(run, in_shardings=in_sh, out_shardings=out_sh,
+                       donate_argnums=(0, 1, 2))
+
+    def multi_step(self, batch, num_steps):
+        """Run ``num_steps`` consecutive train steps on the SAME batch
+        as ONE compiled program — a ``lax.scan`` over the fused step
+        with donated params/optimizer-state/aux.
+
+        Per-step host dispatch disappears entirely, which matters when
+        dispatch dominates the step itself: small models, high-latency
+        links (the bench relay), or profiling where only steady-state
+        device time should count. The rng/step-counter/lr-schedule
+        sequence matches ``num_steps`` calls of :meth:`step` exactly
+        (pinned by ``test_parallel.py::test_multi_step_matches_steps``).
+        Returns nothing; params advance in place (use ``get_params``).
+        """
+        if self.params is None:
+            self.init_params()
+        if num_steps not in self._jit_multi:
+            self._jit_multi[num_steps] = self._build_multi_step(num_steps)
+        batch = self._shard_batch(batch, "multi_step")
+        sched = self.optimizer.lr_scheduler
+        lrs = np.asarray(
+            [sched(self._t + 1 + i) if sched is not None
+             else self.optimizer.lr for i in range(num_steps)],
+            np.float32)
+        with self.mesh:
+            self.params, self.opt_state, self.aux = \
+                self._jit_multi[num_steps](
+                    self.params, self.opt_state, self.aux, batch, lrs,
+                    np.int32(self._t), self._rng)
+        self._t += num_steps
 
     def forward(self, batch):
         """Inference forward (no aux update); returns outputs list."""
