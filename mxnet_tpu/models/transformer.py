@@ -9,7 +9,23 @@ FeedForward or ParallelTrainer like every other zoo model.
 """
 from __future__ import annotations
 
+import os
+
 from .. import symbol as sym
+
+
+def _ln(data, name):
+    """LayerNorm site. ``MXNET_DIAG_IDENTITY_LN=1`` replaces every
+    LayerNorm in the model with identity — a DIAGNOSTIC knob for the
+    perf-attribution A/B (doc/performance.md: bounding the
+    LN/elementwise share of the step) — never a training mode (the
+    un-normalized model diverges)."""
+    if os.environ.get("MXNET_DIAG_IDENTITY_LN", "0") == "1":
+        return data
+    return sym.LayerNorm(data=data,
+                         gamma=sym.Variable(name + "_gamma"),
+                         beta=sym.Variable(name + "_beta"),
+                         name=name)
 
 __all__ = ["transformer_block", "moe_transformer_block",
            "get_transformer_lm", "tp_rules", "ep_rules"]
@@ -18,10 +34,7 @@ __all__ = ["transformer_block", "moe_transformer_block",
 def _attn_sublayer(data, num_heads, name, causal, impl, dropout,
                    rope=False):
     """x + MHA(LN(x)) then LN — the shared attention half of a block."""
-    ln1 = sym.LayerNorm(data=data,
-                        gamma=sym.Variable(name + "_ln1_gamma"),
-                        beta=sym.Variable(name + "_ln1_beta"),
-                        name=name + "_ln1")
+    ln1 = _ln(data, name + "_ln1")
     attn = sym.MultiHeadAttention(
         data=ln1,
         qkv_weight=sym.Variable(name + "_qkv_weight"),
@@ -31,10 +44,7 @@ def _attn_sublayer(data, num_heads, name, causal, impl, dropout,
         num_heads=num_heads, causal=causal, impl=impl, dropout=dropout,
         rope=rope, name=name + "_attn")
     x = data + attn
-    ln2 = sym.LayerNorm(data=x,
-                        gamma=sym.Variable(name + "_ln2_gamma"),
-                        beta=sym.Variable(name + "_ln2_beta"),
-                        name=name + "_ln2")
+    ln2 = _ln(x, name + "_ln2")
     return x, ln2
 
 
@@ -150,8 +160,7 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
                                         impl=impl, dropout=dropout,
                                         rope=rope)
     with scope(last=True):
-        ln_f = sym.LayerNorm(data=net, gamma=sym.Variable("lnf_gamma"),
-                             beta=sym.Variable("lnf_beta"), name="lnf")
+        ln_f = _ln(net, "lnf")
         logits = sym.FullyConnected(data=ln_f, num_hidden=vocab_size,
                                     name="lm_head", flatten=False)
         if loss_layout in ("flat", "ce"):

@@ -92,7 +92,7 @@ class Decoder:
     compute_dtype : str, optional
         Cast floating parameters (and caches) for the decode math, e.g.
         ``"bfloat16"``; token ids are integer-semantic and never cast.
-    cache_block : int, optional
+    cache_block : int, None, or "auto"
         Prefix-bounded cache reads for single-token steps: attend over
         only the ``ceil((pos+1)/cache_block)`` leading cache blocks via
         an online-softmax ``lax.fori_loop`` (dynamic trip count) instead
@@ -100,12 +100,17 @@ class Decoder:
         softmax is a reassociation, not an approximation. Saves HBM
         traffic proportional to the unfilled cache suffix (the K/V
         buffers rival the parameters in bytes at long ``max_len``).
-        Must divide ``max_len``. ``None`` (default) keeps the one-shot
-        full-cache read.
+        Must divide ``max_len``. ``None`` keeps the one-shot full-cache
+        read. Default ``"auto"``: ``None`` up to 1024 slots, 128
+        beyond — measured on the 124M LM at b8 (doc/performance.md
+        round 5): at ``max_len`` 1024 the dynamic loop costs slightly
+        more than it saves (0.91 vs 0.85 ms/token), at 4096 it is 7.4x
+        faster (0.69 vs 5.1 ms/token) because the full read touches
+        the whole 1.2 GB cache every step.
     """
 
     def __init__(self, symbol, params, max_len, aux_params=None,
-                 compute_dtype=None, cache_block=None):
+                 compute_dtype=None, cache_block="auto"):
         symbol = _logits_symbol(symbol)
         self._topo = symbol._topo()
         self._heads = symbol._heads
@@ -113,6 +118,10 @@ class Decoder:
             raise MXNetError("Decoder needs a single-output symbol, got %d"
                              % len(self._heads))
         self.max_len = int(max_len)
+        if cache_block == "auto":
+            cache_block = None if self.max_len <= 1024 else 128
+            if cache_block is not None and self.max_len % cache_block:
+                cache_block = None  # odd max_len: keep the exact default
         self._cache_block = None if cache_block is None else int(cache_block)
         if self._cache_block is not None and (
                 self._cache_block < 1

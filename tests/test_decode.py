@@ -224,6 +224,31 @@ def test_decode_cache_block_matches_full_read():
         Decoder(sym, params, max_len=T, cache_block=5)  # not a divisor
 
 
+def test_decode_cache_block_auto_resolution():
+    """The "auto" default keeps the one-shot full read up to 1024
+    slots, switches to 128-blocks beyond (the measured crossover), and
+    falls back to the exact full read when 128 does not divide
+    max_len. The auto-blocked decoder must emit the same greedy tokens
+    as an explicit full-read decoder."""
+    rng = np.random.RandomState(13)
+    T = 2048
+    sym = get_transformer_lm(VOCAB, num_layers=1, embed_dim=EMBED,
+                             num_heads=HEADS, impl="dense",
+                             seq_len=T)
+    params = _init_params(sym, T, 1, rng)
+
+    assert Decoder(sym, params, max_len=512)._cache_block is None
+    auto = Decoder(sym, params, max_len=2048)
+    assert auto._cache_block == 128          # beyond the crossover
+    assert Decoder(sym, params, max_len=2000)._cache_block is None
+
+    full = Decoder(sym, params, max_len=2048, cache_block=None)
+    prompt = rng.randint(0, VOCAB, (1, 3))
+    np.testing.assert_array_equal(
+        np.asarray(auto.generate(prompt, num_steps=5)),
+        np.asarray(full.generate(prompt, num_steps=5)))
+
+
 def test_decode_rejects_rank3_batchnorm():
     """BatchNorm normalizes axis 1 — the time axis for [B, T, E] LM
     data — so it is NOT position-wise on rank-3 data; the decoder must
