@@ -52,3 +52,22 @@ mx.exec.outputs <- function(exec) {
   names(outs) <- outputs(exec$symbol)
   outs
 }
+
+#' Overwrite bound argument arrays by name (reference
+#' mx.exec.update.arg.arrays)
+#' @export
+mx.exec.update.arg.arrays <- function(exec, arg.arrays) {
+  if (length(arg.arrays) && is.null(names(arg.arrays))) {
+    stop("arg.arrays must be a NAMED list of NDArrays")
+  }
+  for (name in names(arg.arrays)) {
+    dst <- exec$arg.arrays[[name]]
+    if (is.null(dst)) {
+      stop("unknown executor argument: ", name)
+    }
+    .Call(MXR_FuncInvoke, "_copyto",
+          list(arg.arrays[[name]]$handle), numeric(0),
+          list(dst$handle))
+  }
+  invisible(exec)
+}

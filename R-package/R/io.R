@@ -26,11 +26,15 @@ mx.io.MNISTIter <- function(...)
 mx.io.ImageRecordIter <- function(...)
   mx.io.internal.create("ImageRecordIter", list(...))
 
+#' Rewind a data iterator to the epoch start
+#' @export
 mx.io.reset <- function(iter) {
   .Call(MXR_DataIterReset, iter$handle)
   invisible(iter)
 }
 
+#' Advance to the next batch; FALSE at epoch end
+#' @export
 mx.io.next <- function(iter) {
   if (.Call(MXR_DataIterNext, iter$handle) == 0L) return(NULL)
   list(data = new.ndarray(.Call(MXR_DataIterGetData, iter$handle)),

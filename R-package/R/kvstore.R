@@ -11,16 +11,22 @@ mx.kv.create <- function(type = "local") {
             class = "MXKVStore")
 }
 
+#' Initialize a key with an NDArray value
+#' @export
 mx.kv.init <- function(kv, key, value) {
   .Call(MXR_KVStoreInit, kv$handle, as.integer(key), value$handle)
   invisible(kv)
 }
 
+#' Push a value into a key (aggregated by the store)
+#' @export
 mx.kv.push <- function(kv, key, value) {
   .Call(MXR_KVStorePush, kv$handle, as.integer(key), value$handle)
   invisible(kv)
 }
 
+#' Pull a key's aggregated value into `out`
+#' @export
 mx.kv.pull <- function(kv, key, out) {
   .Call(MXR_KVStorePull, kv$handle, as.integer(key), out$handle)
   out

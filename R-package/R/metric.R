@@ -1,5 +1,7 @@
 # Evaluation metrics (reference R-package/R/metric.R).
 
+#' Create a custom evaluation metric from a name and feval(label, pred)
+#' @export
 mx.metric.custom <- function(name, feval) {
   structure(list(name = name, feval = feval,
                  sum = 0, n = 0), class = "MXMetric")

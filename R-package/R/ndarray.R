@@ -89,28 +89,48 @@ mx.nd.internal.scalar <- function(fname, lhs, s) {
   out
 }
 
+# R dispatches the group generic when EITHER operand is an MXNDArray
+# (and for unary +/- with e2 missing), so each method handles: unary,
+# array op array, array op scalar, and scalar op array (the latter via
+# the _r*_scalar reversed kernels for the non-commutative ops).
 #' @export
 "+.MXNDArray" <- function(e1, e2) {
-  if (inherits(e2, "MXNDArray")) mx.nd.internal.binary("_plus", e1, e2)
-  else mx.nd.internal.scalar("_plus_scalar", e1, e2)
+  if (missing(e2)) return(e1)               # unary +
+  if (!inherits(e1, "MXNDArray")) {
+    mx.nd.internal.scalar("_plus_scalar", e2, e1)
+  } else if (inherits(e2, "MXNDArray")) {
+    mx.nd.internal.binary("_plus", e1, e2)
+  } else mx.nd.internal.scalar("_plus_scalar", e1, e2)
 }
 
 #' @export
 "-.MXNDArray" <- function(e1, e2) {
-  if (inherits(e2, "MXNDArray")) mx.nd.internal.binary("_minus", e1, e2)
-  else mx.nd.internal.scalar("_minus_scalar", e1, e2)
+  if (missing(e2)) {                        # unary -
+    return(mx.nd.internal.scalar("_mul_scalar", e1, -1))
+  }
+  if (!inherits(e1, "MXNDArray")) {
+    mx.nd.internal.scalar("_rminus_scalar", e2, e1)
+  } else if (inherits(e2, "MXNDArray")) {
+    mx.nd.internal.binary("_minus", e1, e2)
+  } else mx.nd.internal.scalar("_minus_scalar", e1, e2)
 }
 
 #' @export
 "*.MXNDArray" <- function(e1, e2) {
-  if (inherits(e2, "MXNDArray")) mx.nd.internal.binary("_mul", e1, e2)
-  else mx.nd.internal.scalar("_mul_scalar", e1, e2)
+  if (!inherits(e1, "MXNDArray")) {
+    mx.nd.internal.scalar("_mul_scalar", e2, e1)
+  } else if (inherits(e2, "MXNDArray")) {
+    mx.nd.internal.binary("_mul", e1, e2)
+  } else mx.nd.internal.scalar("_mul_scalar", e1, e2)
 }
 
 #' @export
 "/.MXNDArray" <- function(e1, e2) {
-  if (inherits(e2, "MXNDArray")) mx.nd.internal.binary("_div", e1, e2)
-  else mx.nd.internal.scalar("_div_scalar", e1, e2)
+  if (!inherits(e1, "MXNDArray")) {
+    mx.nd.internal.scalar("_rdiv_scalar", e2, e1)
+  } else if (inherits(e2, "MXNDArray")) {
+    mx.nd.internal.binary("_div", e1, e2)
+  } else mx.nd.internal.scalar("_div_scalar", e1, e2)
 }
 
 #' Save named NDArrays (bit-compatible with mx.nd.save everywhere else)

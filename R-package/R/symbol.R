@@ -43,14 +43,20 @@ mx.apply <- function(symbol, ..., name = "") {
   copy
 }
 
+#' List a symbol's argument names in graph order
+#' @export
 arguments <- function(symbol) {
   .Call(MXR_SymbolListArguments, symbol$handle)
 }
 
+#' List a symbol's output names
+#' @export
 outputs <- function(symbol) {
   .Call(MXR_SymbolListOutputs, symbol$handle)
 }
 
+#' List a symbol's auxiliary state names (BatchNorm moving stats)
+#' @export
 auxiliary.states <- function(symbol) {
   .Call(MXR_SymbolListAuxiliaryStates, symbol$handle)
 }
@@ -88,4 +94,45 @@ mx.symbol.internal.create <- function(op, name, kwargs) {
   .Call(MXR_SymbolCompose, handle, name, names(inputs),
         lapply(inputs, function(s) s$handle))
   new.symbol(handle)
+}
+
+# Arithmetic on symbols builds the registered elementwise graph nodes,
+# so `A + B` composes the same _Plus/_MinusScalar/... ops as Python.
+.sym.binop <- function(op, scalar.op, e1, e2, rev.op = NULL) {
+  s1 <- inherits(e1, "MXSymbol")
+  s2 <- inherits(e2, "MXSymbol")
+  if (s1 && s2) {
+    return(mx.symbol.internal.create(op, "", list(lhs = e1, rhs = e2)))
+  }
+  if (s1) {
+    return(mx.symbol.internal.create(scalar.op, "",
+                                     list(data = e1, scalar = e2)))
+  }
+  mx.symbol.internal.create(if (is.null(rev.op)) scalar.op else rev.op,
+                            "", list(data = e2, scalar = e1))
+}
+
+#' @export
+"+.MXSymbol" <- function(e1, e2) {
+  if (missing(e2)) return(e1)               # unary +
+  .sym.binop("_Plus", "_PlusScalar", e1, e2)
+}
+
+#' @export
+"-.MXSymbol" <- function(e1, e2) {
+  if (missing(e2)) {                        # unary -
+    return(mx.symbol.internal.create("_MulScalar", "",
+                                     list(data = e1, scalar = -1)))
+  }
+  .sym.binop("_Minus", "_MinusScalar", e1, e2, rev.op = "_RMinusScalar")
+}
+
+#' @export
+"*.MXSymbol" <- function(e1, e2) {
+  .sym.binop("_Mul", "_MulScalar", e1, e2)
+}
+
+#' @export
+"/.MXSymbol" <- function(e1, e2) {
+  .sym.binop("_Div", "_DivScalar", e1, e2, rev.op = "_RDivScalar")
 }

@@ -1,0 +1,14 @@
+# Tiny device-arithmetic throughput probe.
+library(mxnet.tpu)
+
+shape <- c(256, 256)
+a <- mx.runif(shape, -1, 1)
+tic <- proc.time()[["elapsed"]]
+reps <- 50
+for (i in seq_len(reps)) {
+  a <- a * 1.0001 + 0.5
+}
+as.array(a)  # blocking read: waits for the chain
+toc <- proc.time()[["elapsed"]]
+elems <- prod(shape) * reps * 2
+message(sprintf("%.1f M elementwise ops/sec", elems / (toc - tic) / 1e6))

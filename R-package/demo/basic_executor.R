@@ -1,0 +1,17 @@
+# Bind a symbol and run it: the whole graph is one compiled program.
+library(mxnet.tpu)
+
+A <- mx.symbol.Variable("A")
+B <- mx.symbol.Variable("B")
+C <- A + B
+
+exec <- mx.simple.bind(C, mx.cpu(), grad.req = "null",
+                       A = c(2), B = c(2))
+mx.exec.update.arg.arrays(exec, list(A = mx.nd.array(c(1, 2)),
+                                     B = mx.nd.array(c(10, 20))))
+mx.exec.forward(exec, is.train = FALSE)
+print(as.array(mx.exec.outputs(exec)[[1]]))
+
+mx.exec.update.arg.arrays(exec, list(A = mx.nd.array(c(100, 200))))
+mx.exec.forward(exec, is.train = FALSE)
+print(as.array(mx.exec.outputs(exec)[[1]]))

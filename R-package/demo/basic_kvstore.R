@@ -1,0 +1,9 @@
+# Key-value store aggregation across device copies.
+library(mxnet.tpu)
+
+kv <- mx.kv.create("local")
+a <- mx.nd.array(c(1, 2))
+mx.kv.init(kv, 0, a)
+mx.kv.push(kv, 0, mx.nd.array(c(4, 5)))
+out <- mx.kv.pull(kv, 0, mx.nd.zeros(c(2)))
+print(as.array(out))

@@ -1,0 +1,20 @@
+# One-call MLP training, prediction, checkpoint round trip.
+library(mxnet.tpu)
+
+mx.set.seed(0)
+n <- 100
+x <- rbind(matrix(rnorm(n * 2, -1), ncol = 2),
+           matrix(rnorm(n * 2, +1), ncol = 2))
+y <- c(rep(0, n), rep(1, n))
+
+model <- mx.mlp(x, y, hidden_node = 8, out_node = 2,
+                out_activation = "softmax", num.round = 10,
+                array.batch.size = 20, learning.rate = 0.1,
+                momentum = 0.9, eval.metric = mx.metric.accuracy)
+
+preds <- predict(model, x)
+print(mean((max.col(preds) - 1) == y))
+
+mx.model.save(model, "demo_model", 1)
+back <- mx.model.load("demo_model", 1)
+stopifnot(identical(arguments(back$symbol), arguments(model$symbol)))

@@ -1,0 +1,19 @@
+# Device arrays and R-native arithmetic on them.
+library(mxnet.tpu)
+
+vec <- mx.nd.array(1:3)
+vec <- vec + 1.0
+vec <- vec + vec
+vec <- vec - 5
+vec <- 10 / vec            # scalar-on-the-left forms work too
+vec <- 7 * vec
+vec <- 1 - vec + (2 * vec) / (vec + 0.5)
+print(as.array(vec))
+
+mat <- mx.nd.array(matrix(1:4, 2, 2))
+mat <- (mat * 3 + 5) / 10
+print(as.array(mat))
+
+# explicit device placement (mx.tpu() on a TPU host)
+other <- mx.nd.copyto(mat, mx.cpu())
+print(as.array(other))

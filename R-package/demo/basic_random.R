@@ -1,0 +1,6 @@
+# Seeded host RNG staged into device arrays.
+library(mxnet.tpu)
+
+mx.set.seed(10)
+print(as.array(mx.runif(c(2, 2), -10, 10)))
+print(as.array(mx.rnorm(c(2, 2), mean = 0, sd = 2)))

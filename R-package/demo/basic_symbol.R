@@ -1,0 +1,22 @@
+# Declarative graph construction and composition.
+library(mxnet.tpu)
+
+data <- mx.symbol.Variable("data")
+net1 <- mx.symbol.FullyConnected(data = data, name = "fc1",
+                                 num_hidden = 10)
+net1 <- mx.symbol.FullyConnected(data = net1, name = "fc2",
+                                 num_hidden = 100)
+stopifnot(identical(arguments(net1),
+                    c("data", "fc1_weight", "fc1_bias", "fc2_weight",
+                      "fc2_bias")))
+
+net2 <- mx.symbol.Variable("data2")
+net2 <- mx.symbol.FullyConnected(data = net2, name = "fc3",
+                                 num_hidden = 10)
+net2 <- mx.symbol.Activation(data = net2, act_type = "relu")
+net2 <- mx.symbol.FullyConnected(data = net2, name = "fc4",
+                                 num_hidden = 20)
+
+# graft net1 in as net2's input; both originals stay usable
+composed <- mx.apply(net2, data2 = net1, name = "composed")
+print(arguments(composed))

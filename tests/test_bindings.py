@@ -268,3 +268,108 @@ def test_r_sources_brace_balance():
             prev = ch
         assert quote in (None, "\n") and not any(depth.values()), \
             (rfile, depth, quote)
+
+
+def test_r_demos_cover_existing_api():
+    """Every demo in R-package/demo/ calls only package functions that
+    are BOTH defined and exported through NAMESPACE (library() attaches
+    only exports — an unexported call dies at demo runtime), and
+    00Index lists exactly the demo files present — the no-R analogue
+    of R CMD check's demo validation. Any called token that names a
+    package-local function is checked, not just the mx.* ones
+    (catches e.g. an unexported arguments())."""
+    import glob
+    import re
+
+    rdir = os.path.join(ROOT, "R-package", "R")
+    defined = set()
+    for rfile in glob.glob(os.path.join(rdir, "*.R")):
+        defined |= set(re.findall(r"^`?([A-Za-z][\w.]*)`?\s*<-",
+                                  open(rfile).read(), re.M))
+    namespace = open(os.path.join(ROOT, "R-package", "NAMESPACE")).read()
+    exported = set(re.findall(r"^export\(([\w.]+)\)", namespace, re.M))
+    export_pats = [re.compile(p) for p in
+                   re.findall(r"exportPattern\(\"(.*)\"\)",
+                              namespace.replace("\\\\", "\\"))]
+    s3 = {"predict", "as.array", "print"}  # generics, dispatch exported
+
+    def visible(name):
+        return name in exported or name in s3 \
+            or any(p.match(name) for p in export_pats)
+
+    demos = sorted(glob.glob(os.path.join(ROOT, "R-package", "demo",
+                                          "*.R")))
+    assert len(demos) == 7, demos
+    index = open(os.path.join(ROOT, "R-package", "demo",
+                              "00Index")).read()
+    for demo in demos:
+        stem = os.path.splitext(os.path.basename(demo))[0]
+        assert re.search(r"^%s\b" % re.escape(stem), index, re.M), \
+            "%s missing from demo/00Index" % stem
+        src = open(demo).read()
+        # every called token that names a package-defined function
+        calls = {c for c in re.findall(r"\b([A-Za-z][\w.]*)\(", src)
+                 if c in defined or c.startswith("mx.")}
+        undefined = sorted(c for c in calls
+                           if c not in defined and c not in s3)
+        assert not undefined, "%s calls undefined APIs: %s" \
+            % (os.path.basename(demo), undefined)
+        unexported = sorted(c for c in calls if not visible(c))
+        assert not unexported, "%s calls unexported APIs: %s" \
+            % (os.path.basename(demo), unexported)
+        shim = open(os.path.join(ROOT, "R-package", "src",
+                                 "mxnet_r.c")).read()
+        for entry in re.findall(r"\.Call\((MXR_\w+)", src):
+            assert ("SEXP %s(" % entry) in shim, \
+                "%s uses unknown .Call entry %s" % (demo, entry)
+
+
+def test_r_man_pages_cover_exports():
+    """man/ has a generated .Rd page for every export(...) in
+    NAMESPACE, and regeneration is idempotent (freshness gate like the
+    ops generators)."""
+    import glob
+    import re
+    import shutil
+
+    namespace = open(os.path.join(ROOT, "R-package", "NAMESPACE")).read()
+    exported = set(re.findall(r"^export\(([\w.]+)\)", namespace, re.M))
+    assert exported
+    pages = {os.path.splitext(os.path.basename(p))[0]
+             for p in glob.glob(os.path.join(ROOT, "R-package", "man",
+                                             "*.Rd"))}
+    # mx.symbol.* exports ride the exportPattern + generated-ops doc
+    missing = sorted(e for e in exported
+                     if e not in pages and not e.startswith("mx.symbol."))
+    # data objects (mx.metric.accuracy etc.) are values, not functions:
+    # documented in metric.Rd-style source comments, no usage block
+    missing = [m for m in missing
+               if m not in ("mx.metric.accuracy", "mx.metric.rmse",
+                            "mx.metric.mae", "mx.metric.rmsle",
+                            "mx.metric.logger")]
+    assert not missing, "exports without man pages: %s" % missing
+
+    # idempotency: regenerating into a copy reproduces the tree
+    import subprocess
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        work = os.path.join(tmp, "R-package")
+        shutil.copytree(os.path.join(ROOT, "R-package"), work)
+        r = subprocess.run([sys.executable, "generate_man.py"],
+                           cwd=work, capture_output=True, text=True,
+                           timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        fresh = {os.path.basename(p)
+                 for p in glob.glob(os.path.join(work, "man", "*.Rd"))}
+        committed_pages = {
+            os.path.basename(p)
+            for p in glob.glob(os.path.join(ROOT, "R-package", "man",
+                                            "*.Rd"))}
+        # set equality: catches orphaned committed pages too
+        assert fresh == committed_pages, \
+            (sorted(fresh - committed_pages),
+             sorted(committed_pages - fresh))
+        for page in fresh:
+            assert open(os.path.join(work, "man", page)).read() == \
+                open(os.path.join(ROOT, "R-package", "man",
+                                  page)).read(), page
