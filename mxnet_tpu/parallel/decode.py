@@ -101,16 +101,33 @@ class Decoder:
         traffic proportional to the unfilled cache suffix (the K/V
         buffers rival the parameters in bytes at long ``max_len``).
         Must divide ``max_len``. ``None`` keeps the one-shot full-cache
-        read. Default ``"auto"``: ``None`` up to 1024 slots, 128
-        beyond — measured on the 124M LM at b8 (doc/performance.md
-        round 5): at ``max_len`` 1024 the dynamic loop costs slightly
-        more than it saves (0.91 vs 0.85 ms/token), at 4096 it is 7.4x
-        faster (0.69 vs 5.1 ms/token) because the full read touches
-        the whole 1.2 GB cache every step.
+        read. Default ``"auto"``: ``None`` up to 512 slots, 128 beyond
+        — long-chain measurements on the 124M LM at b8
+        (doc/performance.md round 5): blocked reads win 15% at
+        ``max_len`` 1024 (1.52 vs 1.79 ms/token, cache filling to 960)
+        and 1.9x at 4096 (2.78 vs 5.15, the full read touching the
+        whole 1.2 GB buffer every step); at a few hundred slots the
+        dynamic loop's serialization outweighs the read it saves.
+    cache_dtype : str, optional
+        ``"int8"`` stores K/V quantized — symmetric per-(position, head)
+        row scales (``amax/127``, f32, D-fold smaller than the rows they
+        scale) kept in side buffers, dequantized at the attention read.
+        Halves cache RESIDENCY vs bf16 (2x the max_len x batch budget
+        in the same HBM) at ~0.4% row RMS error (per-row scales, so one
+        outlier position cannot poison its neighbours). NOT a speed
+        default: measured SLOWER on this chip (doc/performance.md
+        round 5 — 3.65 vs 1.79 ms/token at b8/L1024, 3.57 vs 2.78 at
+        L4096: the per-step quantize + per-read dequantize arithmetic
+        costs more than the halved cache bytes save), so use it for
+        memory, not latency. NOT exact — greedy argmax is robust in
+        practice but bit-parity tests use the default. Any float dtype
+        string (e.g. ``"bfloat16"``) is also accepted and simply stores
+        the cache at that dtype; default follows ``compute_dtype``.
     """
 
     def __init__(self, symbol, params, max_len, aux_params=None,
-                 compute_dtype=None, cache_block="auto"):
+                 compute_dtype=None, cache_block="auto",
+                 cache_dtype=None):
         symbol = _logits_symbol(symbol)
         self._topo = symbol._topo()
         self._heads = symbol._heads
@@ -119,7 +136,7 @@ class Decoder:
                              % len(self._heads))
         self.max_len = int(max_len)
         if cache_block == "auto":
-            cache_block = None if self.max_len <= 1024 else 128
+            cache_block = None if self.max_len <= 512 else 128
             if cache_block is not None and self.max_len % cache_block:
                 cache_block = None  # odd max_len: keep the exact default
         self._cache_block = None if cache_block is None else int(cache_block)
@@ -170,7 +187,23 @@ class Decoder:
                              "(pass the checkpoint's aux_params, e.g. "
                              "BatchNorm moving stats)" % missing_aux)
         self._aux = [cast(jnp.asarray(aux_params[a])) for a in aux_names]
-        self._cache_dtype = compute_dtype or "float32"
+        if cache_dtype is None:
+            self._cache_int8 = False
+            self._cache_dtype = compute_dtype or "float32"
+        else:
+            try:
+                cdt = jnp.dtype(cache_dtype)
+            except TypeError:
+                raise MXNetError(
+                    "Decoder: cache_dtype must be 'int8' or a float "
+                    "dtype, got %r" % (cache_dtype,))
+            self._cache_int8 = cdt == jnp.int8
+            if not self._cache_int8 \
+                    and not jnp.issubdtype(cdt, jnp.floating):
+                raise MXNetError(
+                    "Decoder: cache_dtype must be 'int8' or a float "
+                    "dtype, got %r" % (cache_dtype,))
+            self._cache_dtype = cdt
 
         # pos_embed bounds the decodable length
         for n in self._topo:
@@ -211,18 +244,61 @@ class Decoder:
 
     # -- cache ----------------------------------------------------------
     def init_cache(self, batch_size):
-        """Zeroed K/V buffers, [B, max_len, H, D] per attention node."""
+        """Zeroed K/V buffers, [B, max_len, H, D] per attention node
+        (plus [B, max_len, H] f32 row scales when ``cache_dtype="int8"``)."""
         caches = []
         for n in self._mha:
             e = self._params[n.inputs[1][0].name].shape[1]  # qkv [3E, E]
             h = n.params["num_heads"]
             shape = (batch_size, self.max_len, h, e // h)
-            caches.append((jnp.zeros(shape, self._cache_dtype),
-                           jnp.zeros(shape, self._cache_dtype)))
+            if self._cache_int8:
+                caches.append((jnp.zeros(shape, jnp.int8),
+                               jnp.ones(shape[:3], jnp.float32),
+                               jnp.zeros(shape, jnp.int8),
+                               jnp.ones(shape[:3], jnp.float32)))
+            else:
+                caches.append((jnp.zeros(shape, self._cache_dtype),
+                               jnp.zeros(shape, self._cache_dtype)))
         return caches
 
+    @staticmethod
+    def _quantize_rows(x):
+        """[B, C, H, D] float -> (int8 values, [B, C, H] f32 scales):
+        symmetric amax/127 per (position, head) row."""
+        xf = x.astype(jnp.float32)
+        s = jnp.max(jnp.abs(xf), axis=-1) / 127.0
+        s = jnp.where(s > 0, s, 1.0)
+        q = jnp.round(xf / s[..., None]).astype(jnp.int8)
+        return q, s
+
     # -- the derived incremental walk -----------------------------------
-    def _cached_mha(self, node, ins, ck, cv, pos):
+    def _write_cache(self, entry, k, v, pos):
+        """Insert a [B, C, H, D] K/V chunk at ``pos`` into a cache entry."""
+        if self._cache_int8:
+            ck, ks, cv, vs = entry
+            k8, ksc = self._quantize_rows(k)
+            v8, vsc = self._quantize_rows(v)
+            return (lax.dynamic_update_slice(ck, k8, (0, pos, 0, 0)),
+                    lax.dynamic_update_slice(ks, ksc, (0, pos, 0)),
+                    lax.dynamic_update_slice(cv, v8, (0, pos, 0, 0)),
+                    lax.dynamic_update_slice(vs, vsc, (0, pos, 0)))
+        ck, cv = entry
+        return (lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                         (0, pos, 0, 0)),
+                lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                         (0, pos, 0, 0)))
+
+    def _read_cache(self, entry, dtype):
+        """Whole-cache K/V for the attention read: dequantized to
+        ``dtype`` if int8, else returned at the stored dtype (jnp
+        promotion governs mixed cache/compute float dtypes)."""
+        if self._cache_int8:
+            ck, ks, cv, vs = entry
+            return ((ck * ks[..., None]).astype(dtype),
+                    (cv * vs[..., None]).astype(dtype))
+        return entry
+
+    def _cached_mha(self, node, ins, entry, pos):
         x, wqkv, bqkv, wo, bo = ins
         b, c, e = x.shape
         h = node.params["num_heads"]
@@ -237,13 +313,11 @@ class Decoder:
             posv = pos + jnp.arange(c)
             q = rope_rotate(q, posv, node.params["rope_base"])
             k = rope_rotate(k, posv, node.params["rope_base"])
-        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                      (0, pos, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                      (0, pos, 0, 0))
+        entry = self._write_cache(entry, k, v, pos)
         if self._cache_block is not None and c == 1:
-            o = self._blocked_attn(q, ck, cv, pos)
+            o = self._blocked_attn(q, entry, pos)
         else:
+            ck, cv = self._read_cache(entry, q.dtype)
             s = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / float(np.sqrt(d))
             kpos = jnp.arange(self.max_len)[None, None, None, :]
             qpos = pos + jnp.arange(c)[None, None, :, None]
@@ -252,9 +326,9 @@ class Decoder:
             o = jnp.einsum("bhqk,bkhd->bqhd",
                            jax.nn.softmax(s, axis=-1), cv)
         return jnp.einsum("bte,fe->btf", o.reshape(b, c, e), wo) + bo, \
-            ck, cv
+            entry
 
-    def _blocked_attn(self, q, ck, cv, pos):
+    def _blocked_attn(self, q, entry, pos):
         """Single-token attention reading only the filled cache prefix.
 
         Online-softmax (flash-decoding) accumulation over the
@@ -268,13 +342,25 @@ class Decoder:
         bl = self._cache_block
         qf = q.astype(jnp.float32)
         nblocks = (pos + bl) // bl  # ceil((pos+1)/bl), pos is traced
+        if self._cache_int8:
+            ck, ks, cv, vs = entry
+        else:
+            ck, cv = entry
+
+        def _block(buf, scale, i):
+            z = lax.dynamic_slice(buf, (0, i * bl, 0, 0), (b, bl, h, d))
+            z = z.astype(jnp.float32)
+            if scale is not None:
+                sb = lax.dynamic_slice(scale, (0, i * bl, 0), (b, bl, h))
+                z = z * sb[..., None]
+            return z
 
         def body(i, carry):
             m, s, acc = carry
-            kb = lax.dynamic_slice(ck, (0, i * bl, 0, 0), (b, bl, h, d))
-            vb = lax.dynamic_slice(cv, (0, i * bl, 0, 0), (b, bl, h, d))
+            kb = _block(ck, ks if self._cache_int8 else None, i)
+            vb = _block(cv, vs if self._cache_int8 else None, i)
             sc = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                            kb.astype(jnp.float32)) / float(np.sqrt(d))
+                            kb) / float(np.sqrt(d))
             kpos = i * bl + jnp.arange(bl)[None, None, None, :]
             sc = jnp.where(kpos <= pos, sc, -jnp.inf)
             m2 = jnp.maximum(m, sc.max(axis=-1))
@@ -282,7 +368,7 @@ class Decoder:
             p = jnp.exp(sc - m2[..., None])       # masked lanes -> 0
             s2 = s * alpha + p.sum(axis=-1)
             acc2 = acc * alpha[..., None] + jnp.einsum(
-                "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32))
+                "bhqk,bkhd->bhqd", p, vb)
             return m2, s2, acc2
 
         m0 = jnp.full((b, h, c), -jnp.inf, jnp.float32)
@@ -310,9 +396,8 @@ class Decoder:
             ins = [env[(id(inp), idx)] for inp, idx in n.inputs]
             name = n.spec.name
             if name == "MultiHeadAttention":
-                ck, cv = new_caches[mha_i]
-                out, ck, cv = self._cached_mha(n, ins, ck, cv, pos)
-                new_caches[mha_i] = (ck, cv)
+                out, new_caches[mha_i] = self._cached_mha(
+                    n, ins, new_caches[mha_i], pos)
                 mha_i += 1
                 env[(id(n), 0)] = out
                 continue

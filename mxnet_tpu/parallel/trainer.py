@@ -66,6 +66,16 @@ class ParallelTrainer:
     zero1 : bool
         Shard optimizer state over ``dp`` (ZeRO-1); same update math
         (equal to reduction-reassociation), state memory 1/dp per chip.
+    fsdp : bool
+        Shard the PARAMETERS themselves over ``dp`` (ZeRO-3/FSDP):
+        every param whose rules leave it replicated is sharded along
+        its first dp-divisible axis, and optimizer state follows the
+        param shards (zero1 is implied). Expressed purely as
+        in/out shardings — GSPMD derives the use-site all-gathers and
+        the gradient reduce-scatter, so param + state + gradient
+        memory are all 1/dp per chip at the cost of re-gathering
+        weights each step. Composes with tp ``param_rules`` (params a
+        rule already shards are left to the rule).
     grad_accum : int
         Split each step's batch into this many sequentially-scanned
         microbatches with one update on the summed gradients
@@ -79,8 +89,8 @@ class ParallelTrainer:
 
     def __init__(self, symbol, input_shapes, optimizer="sgd", mesh=None,
                  rules=None, initializer=None, seed=None, optimizer_params=None,
-                 compute_dtype=None, remat=None, zero1=False, grad_accum=1,
-                 clip_grad_norm=None):
+                 compute_dtype=None, remat=None, zero1=False, fsdp=False,
+                 grad_accum=1, clip_grad_norm=None):
         self.symbol = symbol
         # Mixed precision: forward/backward in compute_dtype (bfloat16 —
         # native MXU input width, halves HBM traffic for activations),
@@ -150,6 +160,33 @@ class ParallelTrainer:
         self._data_sh = {n: self.rules.data_sharding(n, s)
                          for n, s in self.input_shapes.items()}
         self._repl = self.rules.replicated()
+        # FSDP / ZeRO-3: the params themselves live dp-sharded. Like
+        # zero1 this is sharding annotations only — no manual gather
+        # code: jit's in/out shardings pin the param (and state) layout,
+        # and GSPMD inserts the all-gather at each weight's use site in
+        # the forward/backward and reduce-scatters its gradient back to
+        # the shard for the (now shard-local) optimizer update. The
+        # reference has no analogue (one GPU holds whole weights;
+        # dist kvstore shards only the SERVER copy — kvstore_dist.h);
+        # this is the TPU-idiomatic route to models larger than one
+        # chip's HBM without pipeline stages.
+        self.fsdp = bool(fsdp)
+        if self.fsdp:
+            if "dp" not in self.mesh.shape:
+                raise MXNetError("fsdp=True needs a 'dp' mesh axis")
+            from jax.sharding import NamedSharding
+            dp = self.mesh.shape["dp"]
+            for n in self.param_names:
+                if self._param_sh[n].spec not in (P(), None):
+                    continue  # a tp/custom rule already shards this param
+                shape = self.arg_shapes[n]
+                ax = next((i for i, d in enumerate(shape)
+                           if d % dp == 0 and d >= dp), None)
+                if ax is not None:
+                    spec = [None] * len(shape)
+                    spec[ax] = "dp"
+                    self._param_sh[n] = NamedSharding(self.mesh,
+                                                      P(*spec))
         # ZeRO-1: shard OPTIMIZER STATE over dp. Params stay replicated
         # (their sharding is unchanged), but momentum/Adam moments — the
         # 1-2x param-sized buffers — live 1/dp per chip. Expressed purely
@@ -161,7 +198,7 @@ class ParallelTrainer:
         # not bitwise.
         self.zero1 = bool(zero1)
         self._opt_sh = None
-        if self.zero1:
+        if self.zero1 and not self.fsdp:
             if "dp" not in self.mesh.shape:
                 raise MXNetError("zero1=True needs a 'dp' mesh axis")
             from jax.sharding import NamedSharding
@@ -182,6 +219,18 @@ class ParallelTrainer:
                     jax.ShapeDtypeStruct(self.arg_shapes[n], jnp.float32))
                 self._opt_sh[n] = jax.tree_util.tree_map(
                     lambda _leaf, _n=n: leaf_sh(_n), template)
+        if self.fsdp:
+            # optimizer state leaves are param-shaped: they must follow
+            # the param shards exactly for the update to stay
+            # shard-local (overrides zero1's dim-0 scheme, which can
+            # disagree with the fsdp axis choice)
+            self._opt_sh = {}
+            for n in self.param_names:
+                template = jax.eval_shape(
+                    self._opt_init,
+                    jax.ShapeDtypeStruct(self.arg_shapes[n], jnp.float32))
+                self._opt_sh[n] = jax.tree_util.tree_map(
+                    lambda _leaf, _n=n: self._param_sh[_n], template)
 
         # state ----------------------------------------------------------
         # default Pallas fusion only on a single-device mesh: under

@@ -225,7 +225,7 @@ def test_decode_cache_block_matches_full_read():
 
 
 def test_decode_cache_block_auto_resolution():
-    """The "auto" default keeps the one-shot full read up to 1024
+    """The "auto" default keeps the one-shot full read up to 512
     slots, switches to 128-blocks beyond (the measured crossover), and
     falls back to the exact full read when 128 does not divide
     max_len. The auto-blocked decoder must emit the same greedy tokens
@@ -238,6 +238,7 @@ def test_decode_cache_block_auto_resolution():
     params = _init_params(sym, T, 1, rng)
 
     assert Decoder(sym, params, max_len=512)._cache_block is None
+    assert Decoder(sym, params, max_len=1024)._cache_block == 128
     auto = Decoder(sym, params, max_len=2048)
     assert auto._cache_block == 128          # beyond the crossover
     assert Decoder(sym, params, max_len=2000)._cache_block is None
@@ -247,6 +248,74 @@ def test_decode_cache_block_auto_resolution():
     np.testing.assert_array_equal(
         np.asarray(auto.generate(prompt, num_steps=5)),
         np.asarray(full.generate(prompt, num_steps=5)))
+
+
+def test_decode_int8_kv_cache():
+    """cache_dtype="int8": per-(position, head)-row symmetric quantized
+    K/V. Not exact, but the error is bounded by the row amax/254 per
+    element, so step logits on this O(1)-logit model stay within a
+    small absolute band of the exact decoder — for both the full-read
+    and blocked-read paths — and generate/clone_cache compose with the
+    4-leaf cache entries."""
+    rng = np.random.RandomState(21)
+    T = 16
+    sym = _lm()
+    params = _init_params(sym, T, 2, rng)
+
+    toks = rng.randint(0, VOCAB, (2, T))
+    want = _full_logits(sym, params, toks)
+    for block in (None, 4):
+        q = Decoder(sym, params, max_len=T, cache_dtype="int8",
+                    cache_block=block)
+        caches = q.init_cache(2)
+        assert len(caches[0]) == 4 and caches[0][0].dtype == jnp.int8
+        got, caches = q.prefill(caches, toks[:, :8])
+        np.testing.assert_allclose(np.asarray(got), want[:, :8],
+                                   atol=0.05)
+        for pos in range(8, T):
+            logits, caches = q.step(caches, pos, toks[:, pos])
+            np.testing.assert_allclose(np.asarray(logits), want[:, pos],
+                                       atol=0.05)
+
+    dec = Decoder(sym, params, max_len=T, cache_dtype="int8")
+    prompt = rng.randint(0, VOCAB, (2, 4))
+    out, caches = dec.generate(prompt, num_steps=4, return_cache=True)
+    out = np.asarray(out)
+    assert out.shape == (2, 8)
+    np.testing.assert_array_equal(out[:, :4], prompt)
+    branch = Decoder.clone_cache(caches)
+    l1, _ = dec.step(branch, 7, out[:, -1])
+    l2, _ = dec.step(caches, 7, out[:, -1])
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+    seqs, scores = dec.beam_search(prompt, num_steps=3, beam_size=2)
+    assert np.asarray(seqs).shape == (2, 2, 7)
+
+    with pytest.raises(mx.MXNetError, match="cache_dtype"):
+        Decoder(sym, params, max_len=T, cache_dtype="int32")
+    with pytest.raises(mx.MXNetError, match="cache_dtype"):
+        Decoder(sym, params, max_len=T, cache_dtype="not-a-dtype")
+    # the dtype OBJECT is as good as the string
+    assert Decoder(sym, params, max_len=T,
+                   cache_dtype=np.int8)._cache_int8
+
+
+def test_decode_int8_quantize_rows():
+    """The quantizer is exact on rows already on the int8 grid and
+    bounded by amax/254 elsewhere; zero rows round-trip to zero."""
+    rng = np.random.RandomState(22)
+    x = jnp.asarray(rng.uniform(-2, 2, (2, 3, 4, 8)).astype(np.float32))
+    q, s = Decoder._quantize_rows(x)
+    np.testing.assert_allclose(
+        np.asarray(q, np.float32) * np.asarray(s)[..., None],
+        np.asarray(x), atol=float(np.abs(np.asarray(x)).max()) / 254.0)
+    grid = jnp.asarray([[-127.0, 64.0, 0.0, 1.0]]) * 0.03
+    q, s = Decoder._quantize_rows(grid[None, None])
+    np.testing.assert_allclose(
+        np.asarray(q, np.float32) * np.asarray(s)[..., None],
+        np.asarray(grid[None, None]), rtol=1e-6)
+    q, s = Decoder._quantize_rows(jnp.zeros((1, 1, 1, 4)))
+    assert np.all(np.asarray(q) == 0) and np.all(np.asarray(s) == 1.0)
 
 
 def test_decode_rejects_rank3_batchnorm():
