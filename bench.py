@@ -165,7 +165,7 @@ def bench_inception_bn(batch=128, steps=15):
     return batch * steps / dt
 
 
-def bench_cifar(batch=128, steps=30):
+def bench_cifar(batch=128, steps=200):
     """CIFAR Inception-BN-28-small training vs the GTX 980 baseline
     (BASELINE.md: 842 img/s). Rounds 2-4 this was dispatch-bound: each
     2-16 ms relay dispatch swamped the sub-ms step, spreading captures
@@ -173,7 +173,11 @@ def bench_cifar(batch=128, steps=30):
     (ParallelTrainer.multi_step = lax.scan over the fused step with
     donated params — the same transform that fixed the GEMM
     calibration), timed as the N-vs-2N program difference ending in a
-    real value fetch. Returns (img_per_sec, relative_spread)."""
+    real value fetch. 200 steps, not 30: a 30-step increment is
+    ~120 ms, inside the relay's ±100 ms per-chain jitter (the decode
+    bench hit the same wall — see bench_decode); at 200 the increment
+    is ~0.8 s and repeats agree. Returns (img_per_sec,
+    relative_spread)."""
     from mxnet_tpu.models import get_inception_bn_small
 
     sym = get_inception_bn_small(num_classes=10)
@@ -265,11 +269,14 @@ def bench_decode(prompt=64, layers=12, embed=768,
     # serves every decoder (a larger table than max_len is valid)
     shapes = {"data": (8, 4 * max_len),
               "softmax_label": (8, 4 * max_len)}
-    arg_shapes, _, _ = sym.infer_shape(**shapes)
-    params = {n: jnp.asarray(rng.uniform(-0.05, 0.05, s)
-                             .astype(np.float32))
-              for n, s in zip(sym.list_arguments(), arg_shapes)
-              if n not in shapes}
+    def init_params(s):
+        arg_shapes, _, _ = s.infer_shape(**shapes)
+        return {n: jnp.asarray(rng.uniform(-0.05, 0.05, sh)
+                               .astype(np.float32))
+                for n, sh in zip(s.list_arguments(), arg_shapes)
+                if n not in shapes}
+
+    params = init_params(sym)
     steps_short = (max_len - prompt) // 2 // 64 * 64  # 448 at 1024
     steps_long = max_len                              # 1024 at L4096
 
@@ -322,6 +329,15 @@ def bench_decode(prompt=64, layers=12, embed=768,
                         compute_dtype="bfloat16", cache_dtype="int8")
     arms["int8_full_b8"] = measure(int8_full, steps_short, 8)
     arms["int8_auto_b8_L%d" % (4 * max_len)] = measure(int8_long,
+                                                       steps_long, 8)
+    # GQA (num_kv_heads=2 of 12): K/V cache 6x smaller — the grouped
+    # projection also drops ~12M params, both cuts honest decode wins
+    gqa_sym = get_transformer_lm(vocab, num_layers=layers,
+                                 embed_dim=embed, num_heads=heads,
+                                 num_kv_heads=2, impl="flash")
+    gqa_long = Decoder(gqa_sym, init_params(gqa_sym),
+                       max_len=4 * max_len, compute_dtype="bfloat16")
+    arms["gqa2_auto_b8_L%d" % (4 * max_len)] = measure(gqa_long,
                                                        steps_long, 8)
     return arms
 
@@ -529,7 +545,7 @@ def main():
             "value": round(cifar, 1),
             "vs_gtx980_baseline": round(cifar / CIFAR_BASELINE, 3),
             "spread": round(cifar_spread, 3),
-            "method": "30 train steps per compiled program "
+            "method": "200 train steps per compiled program "
                       "(multi_step lax.scan, donated params), "
                       "N-vs-2N difference; spread = (max-min)/median "
                       "per-step time over 3 reps",

@@ -32,6 +32,12 @@ def main():
     ap.add_argument("--seq-len", type=int, default=32)
     ap.add_argument("--batches", type=int, default=60)
     ap.add_argument("--gen-steps", type=int, default=16)
+    ap.add_argument("--num-kv-heads", type=int, default=0,
+                    help="grouped-query attention: K/V heads "
+                         "(0 = num_heads); the decode cache shrinks "
+                         "by the group factor")
+    ap.add_argument("--cache-dtype", default=None,
+                    help="e.g. int8 — half-size quantized K/V cache")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -40,7 +46,8 @@ def main():
     # LOSSES, so the training log below is a real NLL (the reference
     # layout would emit probabilities); the Decoder strips either head
     sym = get_transformer_lm(V, num_layers=2, embed_dim=32, num_heads=2,
-                             impl="dense", loss_layout="ce")
+                             impl="dense", loss_layout="ce",
+                             num_kv_heads=args.num_kv_heads)
     trainer = par.ParallelTrainer(
         sym, {"data": (16, T), "softmax_label": (16, T)},
         optimizer="adam", mesh=par.data_parallel_mesh(1),
@@ -57,7 +64,8 @@ def main():
             logging.info("batch %d nll/token %.4f (uniform %.4f)", i,
                          float(np.asarray(out[0]).mean()), np.log(V))
 
-    dec = Decoder(sym, trainer.params, max_len=T)
+    dec = Decoder(sym, trainer.params, max_len=T,
+                  cache_dtype=args.cache_dtype)
     prompt = (rng.randint(0, V, (4, 1)) + np.arange(8)[None, :]) % V
     out = np.asarray(dec.generate(prompt, num_steps=args.gen_steps))
     want = (prompt[:, -1:] + 1 + np.arange(args.gen_steps)[None, :]) % V

@@ -175,9 +175,11 @@ def rope_rotate(x, positions, base=10000.0):
 class MultiHeadAttention(OpSpec):
     """Multi-head self-attention with fused QKV projection.
 
-    data: [B, T, E]; weights: qkv_weight [3E, E], qkv_bias [3E],
-    out_weight [E, E], out_bias [E] (weights laid out ``num_hidden x
-    input`` like FullyConnected, fully_connected-inl.h:148-171).
+    data: [B, T, E]; weights: qkv_weight [F, E], qkv_bias [F] with
+    ``F = E + 2*num_kv_heads*head_dim`` (= 3E without grouped-query
+    attention), out_weight [E, E], out_bias [E] (weights laid out
+    ``num_hidden x input`` like FullyConnected,
+    fully_connected-inl.h:148-171).
 
     ``impl``: flash (Pallas kernel), blockwise (lax.scan recurrence), or
     dense. Long sequences shard over the ``sp`` mesh axis via
@@ -189,16 +191,36 @@ class MultiHeadAttention(OpSpec):
     token's absolute position, so it composes with every impl
     (under shard_map the shard's global offset comes from
     ``lax.axis_index``; striping re-deals already-rotated tokens).
+
+    ``num_kv_heads`` (default 0 = ``num_heads``) enables grouped-query
+    attention: K/V are projected to only this many heads and each K/V
+    head serves ``num_heads/num_kv_heads`` query heads. The fused
+    projection shrinks to ``[E + 2*num_kv_heads*head_dim, E]``, and —
+    the point on TPU — the decoder's K/V cache shrinks by the group
+    factor, cutting the per-token HBM reads that dominate deep-fill
+    decode (doc/performance.md "KV-cache decode"). Inside the training
+    step K/V are broadcast back to ``num_heads`` (XLA fuses the
+    broadcast into the attention GEMMs), so every impl composes.
     """
 
     name = "MultiHeadAttention"
     params = {"num_heads": Param("int"),
+              "num_kv_heads": Param("int", 0),
               "causal": Param("bool", True),
               "impl": Param("str", "flash"),
               "dropout": Param("float", 0.0),
               "rope": Param("bool", False),
               "rope_base": Param("float", 10000.0),
               "axis_name": Param("str", "sp")}
+
+    @staticmethod
+    def kv_heads(p):
+        kv = p.get("num_kv_heads", 0) or p["num_heads"]
+        if kv < 1 or p["num_heads"] % kv:
+            raise MXNetError(
+                "MultiHeadAttention: num_kv_heads=%d must be a positive "
+                "divisor of num_heads=%d" % (kv, p["num_heads"]))
+        return kv
 
     def arguments(self, p):
         return ["data", "qkv_weight", "qkv_bias", "out_weight", "out_bias"]
@@ -216,9 +238,11 @@ class MultiHeadAttention(OpSpec):
         if p["rope"] and (e // p["num_heads"]) % 2:
             raise MXNetError("MultiHeadAttention: rope needs an even "
                              "head dim, got %d" % (e // p["num_heads"]))
+        kv = self.kv_heads(p)
+        f = e + 2 * kv * (e // p["num_heads"])  # q rows + kv k/v rows
         ins = [d,
-               shape_assign(in_shapes[1], (3 * e, e), "qkv_weight"),
-               shape_assign(in_shapes[2], (3 * e,), "qkv_bias"),
+               shape_assign(in_shapes[1], (f, e), "qkv_weight"),
+               shape_assign(in_shapes[2], (f,), "qkv_bias"),
                shape_assign(in_shapes[3], (e, e), "out_weight"),
                shape_assign(in_shapes[4], (e,), "out_bias")]
         return ins, [d], []
@@ -228,13 +252,18 @@ class MultiHeadAttention(OpSpec):
         b, t, e = x.shape
         h = p["num_heads"]
         d = e // h
+        kv = self.kv_heads(p)
         qkv = jnp.einsum("bte,fe->btf", x, wqkv) + bqkv
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(z):
-            return z.reshape(b, t, h, d)
-
-        q, k, v = heads(q), heads(k), heads(v)
+        q = qkv[..., :e].reshape(b, t, h, d)
+        k = qkv[..., e:e + kv * d].reshape(b, t, kv, d)
+        v = qkv[..., e + kv * d:].reshape(b, t, kv, d)
+        if kv != h:
+            # GQA: broadcast each K/V head to its query group — XLA
+            # folds the repeat into the attention GEMM operands, so no
+            # materialized copy in practice; the projection and (in the
+            # Decoder) the cache stay at kv heads
+            k = jnp.repeat(k, h // kv, axis=2)
+            v = jnp.repeat(v, h // kv, axis=2)
         if p["rope"]:
             if d % 2:
                 raise MXNetError("MultiHeadAttention: rope needs an even "

@@ -244,13 +244,18 @@ class Decoder:
 
     # -- cache ----------------------------------------------------------
     def init_cache(self, batch_size):
-        """Zeroed K/V buffers, [B, max_len, H, D] per attention node
-        (plus [B, max_len, H] f32 row scales when ``cache_dtype="int8"``)."""
+        """Zeroed K/V buffers, [B, max_len, Hkv, D] per attention node
+        (plus [B, max_len, Hkv] f32 row scales when
+        ``cache_dtype="int8"``). ``Hkv < num_heads`` under grouped-query
+        attention — the cache shrinks by the group factor."""
+        from ..ops.attention import MultiHeadAttention as _MHA
+
         caches = []
         for n in self._mha:
-            e = self._params[n.inputs[1][0].name].shape[1]  # qkv [3E, E]
+            e = self._params[n.inputs[1][0].name].shape[1]  # qkv [F, E]
             h = n.params["num_heads"]
-            shape = (batch_size, self.max_len, h, e // h)
+            shape = (batch_size, self.max_len,
+                     _MHA.kv_heads(n.params), e // h)
             if self._cache_int8:
                 caches.append((jnp.zeros(shape, jnp.int8),
                                jnp.ones(shape[:3], jnp.float32),
@@ -299,16 +304,23 @@ class Decoder:
         return entry
 
     def _cached_mha(self, node, ins, entry, pos):
+        from ..ops.attention import MultiHeadAttention as _MHA
+
         x, wqkv, bqkv, wo, bo = ins
         b, c, e = x.shape
         h = node.params["num_heads"]
         d = e // h
+        kv = _MHA.kv_heads(node.params)
         qkv = jnp.einsum("bte,fe->btf", x, wqkv) + bqkv
-        q, k, v = [z.reshape(b, c, h, d)
-                   for z in jnp.split(qkv, 3, axis=-1)]
+        q = qkv[..., :e].reshape(b, c, h, d)
+        k = qkv[..., e:e + kv * d].reshape(b, c, kv, d)
+        v = qkv[..., e + kv * d:].reshape(b, c, kv, d)
         if node.params.get("rope"):
             # rotate with ABSOLUTE positions (pos is traced); the cache
             # stores post-rotation K, matching the full forward exactly
+            # (rotation is per-head, so rotating the kv heads before
+            # their group broadcast equals the full forward's
+            # rotate-after-repeat)
             from ..ops.attention import rope_rotate
             posv = pos + jnp.arange(c)
             q = rope_rotate(q, posv, node.params["rope_base"])
@@ -316,7 +328,7 @@ class Decoder:
         entry = self._write_cache(entry, k, v, pos)
         if self._cache_block is not None and c == 1:
             o = self._blocked_attn(q, entry, pos)
-        else:
+        elif kv == h:
             ck, cv = self._read_cache(entry, q.dtype)
             s = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / float(np.sqrt(d))
             kpos = jnp.arange(self.max_len)[None, None, None, :]
@@ -324,6 +336,20 @@ class Decoder:
             s = jnp.where(kpos <= qpos, s,
                           jnp.float32(-1e30).astype(s.dtype))
             o = jnp.einsum("bhqk,bkhd->bqhd",
+                           jax.nn.softmax(s, axis=-1), cv)
+        else:
+            # GQA: grouped einsums read the kv-head cache directly —
+            # query heads fold to [B, C, Hkv, G, D] and contract
+            # against their shared K/V head, no repeated cache copy
+            ck, cv = self._read_cache(entry, q.dtype)
+            qg = q.reshape(b, c, kv, h // kv, d)
+            s = jnp.einsum("bqKgd,bkKd->bKgqk", qg,
+                           ck) / float(np.sqrt(d))
+            kpos = jnp.arange(self.max_len)[None, None, None, None, :]
+            qpos = pos + jnp.arange(c)[None, None, None, :, None]
+            s = jnp.where(kpos <= qpos, s,
+                          jnp.float32(-1e30).astype(s.dtype))
+            o = jnp.einsum("bKgqk,bkKd->bqKgd",
                            jax.nn.softmax(s, axis=-1), cv)
         return jnp.einsum("bte,fe->btf", o.reshape(b, c, e), wo) + bo, \
             entry
@@ -346,12 +372,17 @@ class Decoder:
             ck, ks, cv, vs = entry
         else:
             ck, cv = entry
+        kvh = ck.shape[2]  # < h under grouped-query attention
+        g = h // kvh
+        qg = qf.reshape(b, c, kvh, g, d)
 
         def _block(buf, scale, i):
-            z = lax.dynamic_slice(buf, (0, i * bl, 0, 0), (b, bl, h, d))
+            z = lax.dynamic_slice(buf, (0, i * bl, 0, 0),
+                                  (b, bl, kvh, d))
             z = z.astype(jnp.float32)
             if scale is not None:
-                sb = lax.dynamic_slice(scale, (0, i * bl, 0), (b, bl, h))
+                sb = lax.dynamic_slice(scale, (0, i * bl, 0),
+                                       (b, bl, kvh))
                 z = z * sb[..., None]
             return z
 
@@ -359,16 +390,25 @@ class Decoder:
             m, s, acc = carry
             kb = _block(ck, ks if self._cache_int8 else None, i)
             vb = _block(cv, vs if self._cache_int8 else None, i)
-            sc = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                            kb) / float(np.sqrt(d))
+            if g == 1:
+                sc = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                                kb) / float(np.sqrt(d))
+            else:  # grouped: query heads share their kv head's block
+                sc = jnp.einsum("bqKgd,bkKd->bKgqk", qg, kb) \
+                    .reshape(b, h, c, bl) / float(np.sqrt(d))
             kpos = i * bl + jnp.arange(bl)[None, None, None, :]
             sc = jnp.where(kpos <= pos, sc, -jnp.inf)
             m2 = jnp.maximum(m, sc.max(axis=-1))
             alpha = jnp.exp(m - m2)
             p = jnp.exp(sc - m2[..., None])       # masked lanes -> 0
             s2 = s * alpha + p.sum(axis=-1)
-            acc2 = acc * alpha[..., None] + jnp.einsum(
-                "bhqk,bkhd->bhqd", p, vb)
+            if g == 1:
+                upd = jnp.einsum("bhqk,bkhd->bhqd", p, vb)
+            else:
+                upd = jnp.einsum("bKgqk,bkKd->bKgqd",
+                                 p.reshape(b, kvh, g, c, bl),
+                                 vb).reshape(b, h, c, d)
+            acc2 = acc * alpha[..., None] + upd
             return m2, s2, acc2
 
         m0 = jnp.full((b, h, c), -jnp.inf, jnp.float32)
