@@ -32,7 +32,7 @@ __all__ = ["transformer_block", "moe_transformer_block",
 
 
 def _attn_sublayer(data, num_heads, name, causal, impl, dropout,
-                   rope=False, num_kv_heads=0):
+                   rope=False, num_kv_heads=0, window=0):
     """x + MHA(LN(x)) then LN — the shared attention half of a block."""
     ln1 = _ln(data, name + "_ln1")
     attn = sym.MultiHeadAttention(
@@ -42,7 +42,8 @@ def _attn_sublayer(data, num_heads, name, causal, impl, dropout,
         out_weight=sym.Variable(name + "_proj_weight"),
         out_bias=sym.Variable(name + "_proj_bias"),
         num_heads=num_heads, num_kv_heads=num_kv_heads, causal=causal,
-        impl=impl, dropout=dropout, rope=rope, name=name + "_attn")
+        impl=impl, dropout=dropout, rope=rope, window=window,
+        name=name + "_attn")
     x = data + attn
     ln2 = _ln(x, name + "_ln2")
     return x, ln2
@@ -50,10 +51,11 @@ def _attn_sublayer(data, num_heads, name, causal, impl, dropout,
 
 def transformer_block(data, num_heads, hidden, embed_dim, name,
                       causal=True, impl="flash", dropout=0.0,
-                      rope=False, num_kv_heads=0):
+                      rope=False, num_kv_heads=0, window=0):
     """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x)). data: [B,T,E]."""
     x, ln2 = _attn_sublayer(data, num_heads, name, causal, impl, dropout,
-                            rope=rope, num_kv_heads=num_kv_heads)
+                            rope=rope, num_kv_heads=num_kv_heads,
+                            window=window)
     f1 = sym.FullyConnected(data=ln2, num_hidden=hidden,
                             name=name + "_ffn1", flatten=False)
     act = sym.Activation(data=f1, act_type="relu", name=name + "_ffn_relu")
@@ -64,12 +66,14 @@ def transformer_block(data, num_heads, hidden, embed_dim, name,
 
 def moe_transformer_block(data, num_heads, hidden, embed_dim, num_experts,
                           name, causal=True, impl="flash", dropout=0.0,
-                          moe_top_k=0, rope=False, num_kv_heads=0):
+                          moe_top_k=0, rope=False, num_kv_heads=0,
+                          window=0):
     """Transformer block whose FFN is a mixture of experts (MoEFFN):
     shard the expert dim over ``ep`` (ep_rules) for expert parallelism.
     ``moe_top_k>0`` enables static-shaped top-k hard routing."""
     x, ln2 = _attn_sublayer(data, num_heads, name, causal, impl, dropout,
-                            rope=rope, num_kv_heads=num_kv_heads)
+                            rope=rope, num_kv_heads=num_kv_heads,
+                            window=window)
     moe = sym.MoEFFN(
         data=ln2,
         gate_weight=sym.Variable(name + "_gate_weight"),
@@ -86,7 +90,8 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
                        ffn_hidden=None, seq_len=None, impl="flash",
                        dropout=0.0, num_experts=0, pipeline_stages=None,
                        moe_top_k=0, loss_layout="reference",
-                       pos_encoding="learned", num_kv_heads=0):
+                       pos_encoding="learned", num_kv_heads=0,
+                       window=0):
     """Decoder-only LM: Embedding -> N blocks -> tied-free FC -> softmax
     over vocab per position (multi_output SoftmaxOutput, the reference's
     per-position softmax mode, softmax_output-inl.h multi_output).
@@ -117,6 +122,10 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
     ``num_kv_heads`` (0 = ``num_heads``): grouped-query attention —
     K/V projected to fewer heads, shrinking the decoder's K/V cache by
     the group factor (see MultiHeadAttention).
+
+    ``window`` (0 = unlimited): sliding-window attention in every
+    block; the decode cache becomes an O(window) ring buffer (pair
+    with ``pos_encoding="rope"`` for unbounded-length generation).
     """
     from ..attribute import AttrScope
 
@@ -158,13 +167,15 @@ def get_transformer_lm(vocab_size, num_layers=2, embed_dim=128, num_heads=4,
                                             dropout=dropout,
                                             moe_top_k=moe_top_k,
                                             rope=rope,
-                                            num_kv_heads=num_kv_heads)
+                                            num_kv_heads=num_kv_heads,
+                                            window=window)
             else:
                 net = transformer_block(net, num_heads, ffn_hidden,
                                         embed_dim, "layer%d" % i,
                                         impl=impl, dropout=dropout,
                                         rope=rope,
-                                        num_kv_heads=num_kv_heads)
+                                        num_kv_heads=num_kv_heads,
+                                        window=window)
     with scope(last=True):
         ln_f = _ln(net, "lnf")
         logits = sym.FullyConnected(data=ln_f, num_hidden=vocab_size,

@@ -201,6 +201,17 @@ class MultiHeadAttention(OpSpec):
     decode (doc/performance.md "KV-cache decode"). Inside the training
     step K/V are broadcast back to ``num_heads`` (XLA fuses the
     broadcast into the attention GEMMs), so every impl composes.
+
+    ``window`` (default 0 = unlimited) enables sliding-window
+    attention: position q attends only to keys in
+    ``(q - window, q]`` — ``window`` positions including itself.
+    Causal-only. Supported by the dense and blockwise impls
+    (``impl="flash"`` transparently computes windowed attention via
+    the blockwise recurrence — same O(T·block) memory); the sp ring
+    impls reject it. The decoder's cache for a windowed attention is a
+    RING BUFFER of ``window`` slots, so decode memory and per-token
+    cache reads are O(window) no matter how long the generation runs
+    (with rope there is no positional table to outgrow either).
     """
 
     name = "MultiHeadAttention"
@@ -211,6 +222,7 @@ class MultiHeadAttention(OpSpec):
               "dropout": Param("float", 0.0),
               "rope": Param("bool", False),
               "rope_base": Param("float", 10000.0),
+              "window": Param("int", 0),
               "axis_name": Param("str", "sp")}
 
     @staticmethod
@@ -239,6 +251,14 @@ class MultiHeadAttention(OpSpec):
             raise MXNetError("MultiHeadAttention: rope needs an even "
                              "head dim, got %d" % (e // p["num_heads"]))
         kv = self.kv_heads(p)
+        if p.get("window", 0):
+            if p["window"] < 1:
+                raise MXNetError("MultiHeadAttention: window must be "
+                                 ">= 1 (0 disables), got %d"
+                                 % p["window"])
+            if not p["causal"]:
+                raise MXNetError("MultiHeadAttention: window>0 is "
+                                 "defined for causal attention only")
         f = e + 2 * kv * (e // p["num_heads"])  # q rows + kv k/v rows
         ins = [d,
                shape_assign(in_shapes[1], (f, e), "qkv_weight"),
@@ -258,10 +278,12 @@ class MultiHeadAttention(OpSpec):
         k = qkv[..., e:e + kv * d].reshape(b, t, kv, d)
         v = qkv[..., e + kv * d:].reshape(b, t, kv, d)
         if kv != h:
-            # GQA: broadcast each K/V head to its query group — XLA
-            # folds the repeat into the attention GEMM operands, so no
-            # materialized copy in practice; the projection and (in the
-            # Decoder) the cache stay at kv heads
+            # GQA: broadcast each K/V head to its query group. On the
+            # einsum paths (dense/blockwise) XLA folds the repeat into
+            # the attention GEMM operands; the Pallas flash kernel
+            # takes concrete buffers, so there the expanded K/V ARE
+            # materialized — GQA's training win is the smaller
+            # projection, its big win the kv-head decode cache
             k = jnp.repeat(k, h // kv, axis=2)
             v = jnp.repeat(v, h // kv, axis=2)
         if p["rope"]:
@@ -276,19 +298,40 @@ class MultiHeadAttention(OpSpec):
             q = rope_rotate(q, posv, p["rope_base"])
             k = rope_rotate(k, posv, p["rope_base"])
         impl = p["impl"]
+        window = p.get("window", 0)
+        if window:
+            if not p["causal"]:
+                raise MXNetError("MultiHeadAttention: window>0 is "
+                                 "defined for causal attention only")
+            if impl in ("ring", "ring_striped"):
+                raise MXNetError(
+                    "MultiHeadAttention: window>0 is not supported by "
+                    "the sp ring impls — short windows don't need "
+                    "sequence sharding; use impl='flash'/'blockwise'/"
+                    "'dense'")
+            if impl == "flash":
+                # the Pallas flash kernel has no window mask; the
+                # blockwise recurrence does, at the same O(T·block)
+                # memory
+                impl = "blockwise"
         if impl == "flash":
             from .pallas_kernels import flash_attention
             o = flash_attention(q, k, v, causal=p["causal"])
         elif impl == "blockwise":
             from ..parallel.ring import blockwise_attention
-            o = blockwise_attention(q, k, v, causal=p["causal"])
+            o = blockwise_attention(q, k, v, causal=p["causal"],
+                                    window=window)
         elif impl == "dense":
             # float(): np.sqrt returns a STRONG f64 scalar under x64,
             # which would silently promote the whole graph (and f64 is
             # emulated, ~10x slower, on TPU)
             s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / float(np.sqrt(d))
             if p["causal"]:
-                mask = jnp.tril(jnp.ones((t, t), bool))
+                qpos_m = jnp.arange(t)[:, None]
+                kpos_m = jnp.arange(t)[None, :]
+                mask = kpos_m <= qpos_m
+                if window:
+                    mask &= qpos_m - kpos_m < window
                 s = jnp.where(mask[None, None], s, -jnp.inf)
             o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
         elif impl == "ring":

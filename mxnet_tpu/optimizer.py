@@ -24,7 +24,8 @@ from .ndarray import NDArray, zeros
 from . import random as mx_random
 
 __all__ = ["Optimizer", "SGD", "SGLD", "ccSGD", "Adam", "AdaGrad", "RMSProp",
-           "AdaDelta", "Test", "create", "get_updater", "register"]
+           "AdaDelta", "AdaFactor", "Test", "create", "get_updater",
+           "register"]
 
 
 class Optimizer:
@@ -220,6 +221,99 @@ class AdamW(Adam):
                     - lr * self.wd * weight._val)
         mean._set(new_mean)
         var._set(new_var)
+
+
+@register
+class AdaFactor(Optimizer):
+    """Adafactor (Shazeer & Stern 2018) — sublinear optimizer memory.
+
+    For rank>=2 weights the second moment is stored as a rank-reduced
+    ROW factor plus COLUMN factor (O(n+m) floats instead of O(nm); the
+    reconstruction ``v ≈ r⊗c / mean(r)`` is exact when v is rank-1 and
+    tight in practice), so e.g. a [32k, 768] embedding's state drops
+    from 24.6M floats to 33k. The T5-era TPU optimizer; no reference
+    counterpart (2015).
+
+    Paper-recommended schedule: ``beta2_t = 1 - t^-decay_rate``, the
+    update RMS-clipped at ``clipping_threshold``, and (with
+    ``scale_by_param``) the step scaled by ``max(epsilon2, RMS(w))`` so
+    steps are relative to weight magnitude. ``beta1>0`` adds
+    first-moment momentum (off by default, as in the paper — that is
+    where the memory saving comes from). Weight decay is decoupled
+    (AdamW-style).
+    """
+
+    def __init__(self, learning_rate=0.01, beta1=0.0, decay_rate=0.8,
+                 epsilon1=1e-30, epsilon2=1e-3, clipping_threshold=1.0,
+                 scale_by_param=True, factored=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = float(beta1)
+        self.decay_rate = float(decay_rate)
+        self.epsilon1 = float(epsilon1)
+        self.epsilon2 = float(epsilon2)
+        self.clipping_threshold = float(clipping_threshold)
+        self.scale_by_param = bool(scale_by_param)
+        self.factored = bool(factored)
+
+    def _factored(self, shape):
+        return self.factored and len(shape) >= 2
+
+    def create_state(self, index, weight):
+        if self._factored(weight.shape):
+            state = [zeros(weight.shape[:-1], weight.context,
+                           dtype=weight.dtype),
+                     zeros(weight.shape[:-2] + weight.shape[-1:],
+                           weight.context, dtype=weight.dtype)]
+        else:
+            state = [zeros(weight.shape, weight.context,
+                           dtype=weight.dtype)]
+        if self.beta1 > 0:
+            state.append(zeros(weight.shape, weight.context,
+                               dtype=weight.dtype))
+        return state
+
+    def _step(self, w, g, state, lr, t):
+        """Pure math on jax arrays; state is a list of arrays laid out
+        as in create_state. Shared verbatim by the fused adapter."""
+        g = self._clip_rescale(g)
+        beta2t = 1.0 - t ** (-self.decay_rate)
+        g2 = g * g + self.epsilon1
+        if self._factored(w.shape):
+            vr, vc = state[0], state[1]
+            new_vr = beta2t * vr + (1 - beta2t) * g2.mean(axis=-1)
+            new_vc = beta2t * vc + (1 - beta2t) * g2.mean(axis=-2)
+            # v_hat = (vr ⊗ vc) / mean(vr): normalize the row factor so
+            # the product has vc's scale
+            r = new_vr / new_vr.mean(axis=-1, keepdims=True)
+            u = g / (jnp.sqrt(r)[..., None]
+                     * jnp.sqrt(new_vc)[..., None, :])
+            new_state = [new_vr, new_vc]
+        else:
+            new_v = beta2t * state[0] + (1 - beta2t) * g2
+            u = g / jnp.sqrt(new_v)
+            new_state = [new_v]
+        rms_u = jnp.sqrt(jnp.mean(u * u))
+        u = u / jnp.maximum(1.0, rms_u / self.clipping_threshold)
+        scale = lr
+        if self.scale_by_param:
+            scale = lr * jnp.maximum(self.epsilon2,
+                                     jnp.sqrt(jnp.mean(w * w)))
+        u = scale * u
+        if self.beta1 > 0:
+            new_m = self.beta1 * state[-1] + (1 - self.beta1) * u
+            u = new_m
+            new_state.append(new_m)
+        return w - u - lr * self.wd * w, new_state
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        self._update_count(index)
+        t = float(self._index_update_count[index])
+        new_w, new_state = self._step(
+            weight._val, grad._val, [s._val for s in state], lr, t)
+        weight._set(new_w)
+        for s, v in zip(state, new_state):
+            s._set(v)
 
 
 @register

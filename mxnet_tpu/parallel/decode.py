@@ -242,28 +242,42 @@ class Decoder:
                                for k, v in aux_params.items()},
                    **kwargs)
 
+    def _node_window(self, node):
+        """Ring-buffer slot count for a windowed attention node (0 for
+        ordinary full-history nodes)."""
+        w = node.params.get("window", 0)
+        return min(int(w), self.max_len) if w else 0
+
     # -- cache ----------------------------------------------------------
     def init_cache(self, batch_size):
         """Zeroed K/V buffers, [B, max_len, Hkv, D] per attention node
         (plus [B, max_len, Hkv] f32 row scales when
         ``cache_dtype="int8"``). ``Hkv < num_heads`` under grouped-query
-        attention — the cache shrinks by the group factor."""
+        attention — the cache shrinks by the group factor. Sliding-
+        window nodes get a RING of only ``window`` slots plus a
+        [B, window] int32 buffer of each slot's absolute position
+        (-1 = never written) — decode memory O(window) regardless of
+        generation length."""
         from ..ops.attention import MultiHeadAttention as _MHA
 
         caches = []
         for n in self._mha:
             e = self._params[n.inputs[1][0].name].shape[1]  # qkv [F, E]
             h = n.params["num_heads"]
-            shape = (batch_size, self.max_len,
-                     _MHA.kv_heads(n.params), e // h)
+            win = self._node_window(n)
+            slots = win or self.max_len
+            shape = (batch_size, slots, _MHA.kv_heads(n.params), e // h)
             if self._cache_int8:
-                caches.append((jnp.zeros(shape, jnp.int8),
-                               jnp.ones(shape[:3], jnp.float32),
-                               jnp.zeros(shape, jnp.int8),
-                               jnp.ones(shape[:3], jnp.float32)))
+                entry = (jnp.zeros(shape, jnp.int8),
+                         jnp.ones(shape[:3], jnp.float32),
+                         jnp.zeros(shape, jnp.int8),
+                         jnp.ones(shape[:3], jnp.float32))
             else:
-                caches.append((jnp.zeros(shape, self._cache_dtype),
-                               jnp.zeros(shape, self._cache_dtype)))
+                entry = (jnp.zeros(shape, self._cache_dtype),
+                         jnp.zeros(shape, self._cache_dtype))
+            if win:
+                entry += (jnp.full((batch_size, slots), -1, jnp.int32),)
+            caches.append(entry)
         return caches
 
     @staticmethod
@@ -325,6 +339,11 @@ class Decoder:
             posv = pos + jnp.arange(c)
             q = rope_rotate(q, posv, node.params["rope_base"])
             k = rope_rotate(k, posv, node.params["rope_base"])
+        win = self._node_window(node)
+        if win:
+            o, entry = self._window_attn(q, k, v, entry, pos, win)
+            return jnp.einsum("bte,fe->btf", o.reshape(b, c, e),
+                              wo) + bo, entry
         entry = self._write_cache(entry, k, v, pos)
         if self._cache_block is not None and c == 1:
             o = self._blocked_attn(q, entry, pos)
@@ -353,6 +372,82 @@ class Decoder:
                            jax.nn.softmax(s, axis=-1), cv)
         return jnp.einsum("bte,fe->btf", o.reshape(b, c, e), wo) + bo, \
             entry
+
+    def _window_attn(self, q, k, v, entry, pos, win):
+        """Sliding-window attention against a ring-buffer cache.
+
+        EXACT for any chunk size: queries score the PRE-CHUNK ring
+        (slots masked by their stored absolute positions — a slot is
+        visible iff written, strictly before this chunk, and within
+        the query's window) and the IN-CHUNK keys (dense causal+window
+        mask) under ONE softmax; only then does the chunk's tail
+        overwrite the ring. Reading before writing is what makes
+        chunked prefill correct — a ring slot a mid-chunk query still
+        needs is never clobbered by a later in-chunk key first.
+        Returns (o [B, C, H, D], updated entry)."""
+        b, c, h, d = q.shape
+        kvh = k.shape[2]
+        g = h // kvh
+        if self._cache_int8:
+            ck, ks, cv, vs, cpos = entry
+            ckf = ck * ks[..., None]
+            cvf = cv * vs[..., None]
+        else:
+            ck, cv, cpos = entry
+            ckf, cvf = ck, cv
+
+        def to_h(z):  # GQA: broadcast the (small) ring/chunk K/V rows
+            return jnp.repeat(z, g, axis=2) if g > 1 else z
+
+        qf = q.astype(jnp.float32)
+        ckf = to_h(ckf.astype(jnp.float32))
+        cvf = to_h(cvf.astype(jnp.float32))
+        kf = to_h(k.astype(jnp.float32))
+        vf = to_h(v.astype(jnp.float32))
+        qpos = pos + jnp.arange(c)
+        scale = 1.0 / float(np.sqrt(d))
+
+        s_ring = jnp.einsum("bqhd,bkhd->bhqk", qf, ckf) * scale
+        cp = cpos[:, None, None, :]
+        ring_ok = (cp >= 0) & (cp < pos) \
+            & (cp > qpos[None, None, :, None] - win)
+        s_ring = jnp.where(ring_ok, s_ring, -jnp.inf)
+
+        s_chunk = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+        chunk_ok = (qpos[:, None] >= qpos[None, :]) \
+            & (qpos[:, None] - qpos[None, :] < win)
+        s_chunk = jnp.where(chunk_ok[None, None], s_chunk, -jnp.inf)
+
+        # one softmax over ring + chunk keys (self is always valid, so
+        # no empty rows)
+        p = jax.nn.softmax(
+            jnp.concatenate([s_ring, s_chunk], axis=-1), axis=-1)
+        nring = ckf.shape[1]
+        o = jnp.einsum("bhqk,bkhd->bqhd", p[..., :nring], cvf) \
+            + jnp.einsum("bhqk,bkhd->bqhd", p[..., nring:], vf)
+        o = o.astype(q.dtype)
+
+        # write the chunk tail (the last min(c, win) tokens — earlier
+        # ones would be overwritten within this same chunk anyway)
+        tail = max(0, c - win)
+        ct = c - tail
+        newpos = pos + tail + jnp.arange(ct)
+        slots = newpos % win
+        kt, vt = k[:, tail:], v[:, tail:]
+        posb = jnp.broadcast_to(newpos[None], (b, ct)).astype(jnp.int32)
+        if self._cache_int8:
+            k8, ksc = self._quantize_rows(kt)
+            v8, vsc = self._quantize_rows(vt)
+            entry = (ck.at[:, slots].set(k8),
+                     ks.at[:, slots].set(ksc),
+                     cv.at[:, slots].set(v8),
+                     vs.at[:, slots].set(vsc),
+                     cpos.at[:, slots].set(posb))
+        else:
+            entry = (ck.at[:, slots].set(kt.astype(ck.dtype)),
+                     cv.at[:, slots].set(vt.astype(cv.dtype)),
+                     cpos.at[:, slots].set(posb))
+        return o, entry
 
     def _blocked_attn(self, q, entry, pos):
         """Single-token attention reading only the filled cache prefix.

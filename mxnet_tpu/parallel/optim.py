@@ -94,6 +94,29 @@ def _adamw(opt):
     return init, update
 
 
+def _adafactor(opt):
+    # the eager class's _step is already pure jax math over arrays (the
+    # factored-moment reconstruction lives in one place); this adapter
+    # only maps state pytrees and the traced step count onto it
+    def init(w):
+        # explicit dtype: the package enables jax x64, so a bare
+        # jnp.zeros would be f64 and silently promote the whole update
+        if opt._factored(w.shape):
+            state = [jnp.zeros(w.shape[:-1], w.dtype),
+                     jnp.zeros(w.shape[:-2] + w.shape[-1:], w.dtype)]
+        else:
+            state = [jnp.zeros_like(w)]
+        if opt.beta1 > 0:
+            state.append(jnp.zeros_like(w))
+        return tuple(state)
+
+    def update(w, g, state, lr, t, rng):
+        t = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        new_w, new_state = opt._step(w, g, list(state), lr, t)
+        return new_w, tuple(new_state)
+    return init, update
+
+
 def _adagrad(opt):
     def init(w):
         return jnp.zeros_like(w)
@@ -151,6 +174,7 @@ _FACTORIES = {
     opt_mod.SGLD: _sgld,
     opt_mod.AdamW: _adamw,
     opt_mod.Adam: _adam,
+    opt_mod.AdaFactor: _adafactor,
     opt_mod.AdaGrad: _adagrad,
     opt_mod.RMSProp: _rmsprop,
     opt_mod.AdaDelta: _adadelta,

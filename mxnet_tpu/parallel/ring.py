@@ -82,13 +82,17 @@ def _finalize(o, l):
 
 
 def blockwise_attention(q, k, v, *, causal=False, block_size=512,
-                        scale=None):
+                        scale=None, window=0):
     """Memory-efficient attention on one device: K/V consumed in blocks by
     ``lax.scan`` over the flash recurrence, so peak memory is O(T·block)
     instead of O(T²). Shapes: [B,T,H,D] each; returns [B,T,H,D] in q.dtype.
+    ``window``>0 additionally masks keys more than ``window-1`` positions
+    behind their query (sliding-window attention; requires ``causal``).
     """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
+    if window and not causal:
+        raise ValueError("blockwise_attention: window>0 requires causal")
     if scale is None:
         scale = 1.0 / (D ** 0.5)
     nblk = -(-Tk // block_size)
@@ -111,6 +115,8 @@ def blockwise_attention(q, k, v, *, causal=False, block_size=512,
         mask = kpos[None, :] < Tk  # padding mask
         if causal:
             mask = mask & (qpos[:, None] >= kpos[None, :])
+            if window:
+                mask = mask & (qpos[:, None] - kpos[None, :] < window)
         else:
             mask = jnp.broadcast_to(mask, (Tq, block_size))
         o, l, m = _block_update(q, kblk, vblk, o, l, m, mask, scale)

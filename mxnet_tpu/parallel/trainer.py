@@ -203,13 +203,15 @@ class ParallelTrainer:
                 raise MXNetError("zero1=True needs a 'dp' mesh axis")
             from jax.sharding import NamedSharding
 
-            def leaf_sh(name):
-                shape = self.arg_shapes[name]
+            def leaf_sh(leaf):
+                # by LEAF shape, not param shape: factored states
+                # (AdaFactor) carry lower-rank moment leaves
+                shape = leaf.shape
                 dp = self.mesh.shape["dp"]
                 if shape and shape[0] % dp == 0:
                     spec = P("dp", *([None] * (len(shape) - 1)))
                 else:
-                    spec = P()  # tiny/odd params: replicate their state
+                    spec = P()  # tiny/odd leaves: replicate
                 return NamedSharding(self.mesh, spec)
 
             self._opt_sh = {}
@@ -217,20 +219,34 @@ class ParallelTrainer:
                 template = jax.eval_shape(
                     self._opt_init,
                     jax.ShapeDtypeStruct(self.arg_shapes[n], jnp.float32))
-                self._opt_sh[n] = jax.tree_util.tree_map(
-                    lambda _leaf, _n=n: leaf_sh(_n), template)
+                self._opt_sh[n] = jax.tree_util.tree_map(leaf_sh,
+                                                         template)
         if self.fsdp:
-            # optimizer state leaves are param-shaped: they must follow
-            # the param shards exactly for the update to stay
-            # shard-local (overrides zero1's dim-0 scheme, which can
-            # disagree with the fsdp axis choice)
+            # param-shaped state leaves follow the param shards exactly
+            # (shard-local update); lower-rank leaves (AdaFactor's
+            # factored moments) fall back to the dim-0 rule — GSPMD
+            # derives whatever gathers their reconstruction needs
+            from jax.sharding import NamedSharding
+
+            def fsdp_leaf_sh(leaf, param_shape, param_sh):
+                if tuple(leaf.shape) == tuple(param_shape):
+                    return param_sh
+                dp = self.mesh.shape["dp"]
+                if leaf.shape and leaf.shape[0] % dp == 0:
+                    return NamedSharding(
+                        self.mesh,
+                        P("dp", *([None] * (len(leaf.shape) - 1))))
+                return NamedSharding(self.mesh, P())
+
             self._opt_sh = {}
             for n in self.param_names:
                 template = jax.eval_shape(
                     self._opt_init,
                     jax.ShapeDtypeStruct(self.arg_shapes[n], jnp.float32))
                 self._opt_sh[n] = jax.tree_util.tree_map(
-                    lambda _leaf, _n=n: self._param_sh[_n], template)
+                    lambda leaf, _n=n: fsdp_leaf_sh(
+                        leaf, self.arg_shapes[_n], self._param_sh[_n]),
+                    template)
 
         # state ----------------------------------------------------------
         # default Pallas fusion only on a single-device mesh: under

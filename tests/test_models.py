@@ -332,6 +332,67 @@ def test_transformer_gqa_matches_numpy_oracle():
         bad.infer_shape(data=(B, T, E))
 
 
+def test_attention_sliding_window_matches_numpy():
+    """window=W masks keys more than W-1 positions behind their query:
+    dense equals a numpy oracle, the flash impl (which falls back to
+    the blockwise recurrence for windows) equals dense, and invalid
+    window configs refuse at shape-inference time."""
+    B, T, E, H, W = 2, 10, 16, 2, 3
+    d = E // H
+    rng = np.random.RandomState(29)
+    vals = {"data": rng.randn(B, T, E).astype(np.float32),
+            "qkv_weight": rng.randn(3 * E, E).astype(np.float32) * 0.1,
+            "qkv_bias": rng.randn(3 * E).astype(np.float32) * 0.1,
+            "out_weight": rng.randn(E, E).astype(np.float32) * 0.1,
+            "out_bias": rng.randn(E).astype(np.float32) * 0.1}
+
+    def run(impl):
+        a = mx.sym.MultiHeadAttention(
+            data=mx.sym.Variable("data"),
+            qkv_weight=mx.sym.Variable("qkv_weight"),
+            qkv_bias=mx.sym.Variable("qkv_bias"),
+            out_weight=mx.sym.Variable("out_weight"),
+            out_bias=mx.sym.Variable("out_bias"),
+            num_heads=H, causal=True, impl=impl, window=W, name="a")
+        exe = a.bind(mx.cpu(),
+                     {k: mx.nd.array(v) for k, v in vals.items()})
+        exe.forward(is_train=False)
+        return exe.outputs[0].asnumpy()
+
+    x = vals["data"]
+    qkv = x @ vals["qkv_weight"].T + vals["qkv_bias"]
+    q, k, v = [z.reshape(B, T, H, d) for z in np.split(qkv, 3, -1)]
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    qp, kp = np.arange(T)[:, None], np.arange(T)[None, :]
+    mask = (kp <= qp) & (qp - kp < W)
+    s = np.where(mask[None, None], s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    o = np.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, E)
+    want = o @ vals["out_weight"].T + vals["out_bias"]
+
+    np.testing.assert_allclose(run("dense"), want, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(run("flash"), run("dense"),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(run("blockwise"), run("dense"),
+                               rtol=1e-4, atol=1e-5)
+
+    def bad(**kw):
+        a = mx.sym.MultiHeadAttention(
+            data=mx.sym.Variable("data"),
+            qkv_weight=mx.sym.Variable("w"),
+            qkv_bias=mx.sym.Variable("b"),
+            out_weight=mx.sym.Variable("ow"),
+            out_bias=mx.sym.Variable("ob"),
+            num_heads=H, name="bad", **kw)
+        a.infer_shape(data=(B, T, E))
+
+    with pytest.raises(mx.MXNetError, match="causal"):
+        bad(window=W, causal=False)
+    with pytest.raises(mx.MXNetError, match="window"):
+        bad(window=-2)
+
+
 def test_transformer_gqa_lm_trains():
     """A GQA LM (half the kv heads) trains the cycle task end-to-end —
     the grouped projection learns like the full one."""

@@ -1756,6 +1756,79 @@ def test_pipeline_imbalanced_memory_and_warning():
                                                 bytes_padded)
 
 
+def test_fused_step_adafactor():
+    """AdaFactor: the fused functional path matches the eager oracle,
+    the factored second moment actually stores O(n+m) floats for rank-2
+    weights, and the state shards under zero1 AND fsdp (the factored
+    leaves are LOWER-RANK than their params — exactly what the
+    leaf-shape-aware sharding rules exist for)."""
+    sym = _mlp_symbol()
+    rng = np.random.RandomState(13)
+    data = rng.randn(8, 64).astype(np.float32)
+    label = rng.randint(0, 10, (8,)).astype(np.float32)
+    shapes = {"data": data.shape, "softmax_label": label.shape}
+    arg_names = sym.list_arguments()
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    init = np.random.RandomState(5)
+    params0 = {n: init.uniform(-0.1, 0.1, s).astype("f")
+               for n, s in zip(arg_names, arg_shapes) if n not in shapes}
+
+    # eager oracle
+    args = {n: mx.nd.array(params0[n]) if n in params0 else mx.nd.zeros(s)
+            for n, s in zip(arg_names, arg_shapes)}
+    grads = {n: mx.nd.zeros(params0[n].shape) for n in params0}
+    exe = sym.bind(mx.cpu(), args, args_grad=grads)
+    opt = mx.optimizer.create("adafactor", rescale_grad=1.0 / 8, wd=0.01)
+    updater = mx.optimizer.get_updater(opt)
+    args["data"][:] = data
+    args["softmax_label"][:] = label
+    pnames = [n for n in arg_names if n in params0]
+    for _ in range(3):
+        exe.forward(is_train=True)
+        exe.backward()
+        for i, n in enumerate(pnames):
+            updater(i, grads[n], args[n])
+
+    trainer = par.ParallelTrainer(
+        sym, shapes, optimizer="adafactor", mesh=par.data_parallel_mesh(),
+        optimizer_params={"wd": 0.01})
+    trainer.init_params({n: mx.nd.array(v) for n, v in params0.items()})
+    for _ in range(3):
+        trainer.step({"data": data, "softmax_label": label})
+    got, _ = trainer.get_params()
+    for n in pnames:
+        np.testing.assert_allclose(got[n].asnumpy(), args[n].asnumpy(),
+                                   rtol=2e-5, atol=2e-6, err_msg=n)
+
+    # factored memory: state for a [H, 64] weight is H + 64 floats
+    w_shape = dict(zip(arg_names, arg_shapes))["fc1_weight"]
+    leaves = jax.tree_util.tree_leaves(trainer.opt_state["fc1_weight"])
+    assert sum(l.size for l in leaves) == w_shape[0] + w_shape[1], leaves
+    assert all(l.ndim == 1 for l in leaves)
+    # f32, not f64: the package enables x64, so bare jnp.zeros would
+    # silently promote params through the update
+    assert all(l.dtype == jnp.float32 for l in leaves), leaves
+    assert all(v.dtype == jnp.float32 for v in trainer.params.values())
+
+    # zero1 and fsdp build leaf-shaped shardings without error and step;
+    # looser tolerance than the elementwise optimizers: AdaFactor's
+    # row/col means and global RMS reassociate under sharding (observed
+    # ~5e-4 relative over 3 steps), where Adam's update reassociates
+    # only through the gradient sum
+    for kw in (dict(zero1=True), dict(fsdp=True)):
+        tr = par.ParallelTrainer(
+            sym, shapes, optimizer="adafactor",
+            mesh=par.build_mesh({"dp": 8}), **kw)
+        tr.init_params({n: mx.nd.array(v) for n, v in params0.items()})
+        for _ in range(3):
+            tr.step({"data": data, "softmax_label": label})
+        got_s, _ = tr.get_params()
+        for n in pnames:
+            np.testing.assert_allclose(
+                got_s[n].asnumpy(), args[n].asnumpy(),
+                rtol=2e-3, atol=2e-6, err_msg="%s/%s" % (kw, n))
+
+
 def test_fused_step_adamw():
     """Functional AdamW (decoupled wd) matches eager AdamW, and differs
     from Adam-with-L2 on the same stream (the decoupling is real)."""

@@ -91,7 +91,8 @@ def test_conv_convergence():
 
 def test_optimizers_step():
     """Each optimizer takes a step that reduces a quadratic loss."""
-    for name in ["sgd", "adam", "rmsprop", "adagrad", "adadelta", "ccsgd"]:
+    for name in ["sgd", "adam", "rmsprop", "adagrad", "adadelta", "ccsgd",
+                 "adafactor"]:
         optimizer = mx.optimizer.create(name)
         w = mx.nd.array(np.array([2.0, -3.0], dtype=np.float32))
         state = optimizer.create_state(0, w)
