@@ -205,13 +205,14 @@ class MultiHeadAttention(OpSpec):
     ``window`` (default 0 = unlimited) enables sliding-window
     attention: position q attends only to keys in
     ``(q - window, q]`` — ``window`` positions including itself.
-    Causal-only. Supported by the dense and blockwise impls
-    (``impl="flash"`` transparently computes windowed attention via
-    the blockwise recurrence — same O(T·block) memory); the sp ring
-    impls reject it. The decoder's cache for a windowed attention is a
-    RING BUFFER of ``window`` slots, so decode memory and per-token
-    cache reads are O(window) no matter how long the generation runs
-    (with rope there is no positional table to outgrow either).
+    Causal-only. The flash Pallas kernel SKIPS out-of-window key/query
+    blocks in the forward and both backward kernels (attention compute
+    scales with T·window instead of T²); dense and blockwise mask; the
+    sp ring impls reject it. The decoder's cache for a windowed
+    attention is a RING BUFFER of ``window`` slots, so decode memory
+    and per-token cache reads are O(window) no matter how long the
+    generation runs (with rope there is no positional table to outgrow
+    either).
     """
 
     name = "MultiHeadAttention"
@@ -309,14 +310,10 @@ class MultiHeadAttention(OpSpec):
                     "the sp ring impls — short windows don't need "
                     "sequence sharding; use impl='flash'/'blockwise'/"
                     "'dense'")
-            if impl == "flash":
-                # the Pallas flash kernel has no window mask; the
-                # blockwise recurrence does, at the same O(T·block)
-                # memory
-                impl = "blockwise"
         if impl == "flash":
             from .pallas_kernels import flash_attention
-            o = flash_attention(q, k, v, causal=p["causal"])
+            o = flash_attention(q, k, v, causal=p["causal"],
+                                window=window)
         elif impl == "blockwise":
             from ..parallel.ring import blockwise_attention
             o = blockwise_attention(q, k, v, causal=p["causal"],

@@ -46,7 +46,15 @@ def default_attn_blocks(head_dim):
     """(block_q, block_k) default for the flash/ring kernels: 512
     tiles measured -33% on the 124M-LM step for head_dim <= 128
     (doc/performance.md round 4); large head dims overflow VMEM at 512.
-    MXNET_FLASH_BLOCK_Q/K override."""
+    MXNET_FLASH_BLOCK_Q/K override.
+
+    Known single-chip ceiling: the BACKWARD kernels keep full-sequence
+    q/do/lse/dcap rows in VMEM (the [T, 1] residuals tile to 128
+    lanes), which at T=8192 exceeds scoped VMEM at >=256 blocks — and
+    this environment's compile relay crashes outright at 128. Train
+    longer sequences the designed way: sp/ring sharding
+    (SequenceParallelTrainer), where each shard's local T stays below
+    the limit."""
     import os
     d = 512 if head_dim <= 128 else 128
     return (int(os.environ.get("MXNET_FLASH_BLOCK_Q", d)),
@@ -57,7 +65,7 @@ def default_attn_blocks(head_dim):
 # flash attention
 
 def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
-                     block_k, seq_k, causal, scale):
+                     block_k, seq_k, causal, scale, window=0):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # [block_q, D]
     bq, d = q.shape
@@ -72,6 +80,15 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
         nkb = jnp.minimum(jnp.int32(nkb),
                           lax.div(hi + jnp.int32(block_k - 1),
                                   jnp.int32(block_k)))
+    lo = jnp.int32(0)
+    if window:
+        # sliding window: the earliest key ANY row of this q block can
+        # see is qi*block_q - (window-1); whole k blocks before it are
+        # skipped (this is where the T/window compute saving comes from)
+        lo = jnp.maximum(jnp.int32(0),
+                         lax.div(qi * jnp.int32(block_q)
+                                 - jnp.int32(window - 1),
+                                 jnp.int32(block_k)))
 
     neg_big = jnp.float32(-1e30)  # avoid -inf arithmetic in Mosaic
 
@@ -85,6 +102,8 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
         mask = kpos < seq_k  # K padding
         if causal:
             mask = mask & (qpos >= kpos)
+        if window:
+            mask = mask & (qpos - kpos < jnp.int32(window))
         s = jnp.where(mask, s, neg_big)
         new_m = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.where(mask, jnp.exp(s - new_m), 0.0)
@@ -98,7 +117,7 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
     m0 = jnp.full((bq, 1), neg_big, jnp.float32)
     # int32 bounds: the package enables jax x64 (f64 NDArray parity), so
     # python-int bounds would make an i64 counter Mosaic cannot lower
-    o, l, m = lax.fori_loop(jnp.int32(0), jnp.int32(nkb), body,
+    o, l, m = lax.fori_loop(lo, jnp.int32(nkb), body,
                             (o0, l0, m0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (o / l).astype(o_ref.dtype)
@@ -109,7 +128,8 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
     lse_ref[0] = m + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, true_tk):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, true_tk,
+               window=0):
     """q,k,v: [BH, T, D] (T padded to block multiples); true_tk = unpadded
     key length (padded keys are masked out). Returns (o, lse)."""
     bh, tq, d = q.shape
@@ -118,7 +138,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, true_tk):
     return pl.pallas_call(
         functools.partial(_attn_fwd_kernel, block_q=block_q,
                           block_k=block_k, seq_k=true_tk, causal=causal,
-                          scale=scale),
+                          scale=scale, window=window),
         out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
                    jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32)],
         grid=grid,
@@ -142,7 +162,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, true_tk):
 
 
 def _attn_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref,
-                    *, block_q, block_k, seq_k, causal, scale):
+                    *, block_q, block_k, seq_k, causal, scale, window=0):
     """dQ for one query block: stream over key blocks, recomputing the
     probability block from (q, k, lse) — nothing T×T is ever resident."""
     qi = pl.program_id(1)
@@ -157,6 +177,12 @@ def _attn_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref,
         nkb = jnp.minimum(jnp.int32(nkb),
                           lax.div(hi + jnp.int32(block_k - 1),
                                   jnp.int32(block_k)))
+    lo = jnp.int32(0)
+    if window:
+        lo = jnp.maximum(jnp.int32(0),
+                         lax.div(qi * jnp.int32(block_q)
+                                 - jnp.int32(window - 1),
+                                 jnp.int32(block_k)))
 
     def body(j, dq):
         k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
@@ -167,19 +193,21 @@ def _attn_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref,
         mask = kpos < seq_k
         if causal:
             mask = mask & (qpos >= kpos)
+        if window:
+            mask = mask & (qpos - kpos < jnp.int32(window))
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - dcap) * scale
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     dq0 = jnp.zeros((bq, d), jnp.float32)
-    dq = lax.fori_loop(jnp.int32(0), jnp.int32(nkb), body, dq0)
+    dq = lax.fori_loop(lo, jnp.int32(nkb), body, dq0)
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _attn_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
                      dk_ref, dv_ref, *, block_q, block_k, seq_q, seq_k,
-                     causal, scale):
+                     causal, scale, window=0):
     """dK/dV for one key block: stream over query blocks."""
     ki = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)          # [bk, D]
@@ -191,6 +219,14 @@ def _attn_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
         lo = lax.div(ki * jnp.int32(block_k), jnp.int32(block_q))
     else:
         lo = jnp.int32(0)
+    if window:
+        # sliding window: the LAST query that can see any key of this
+        # block is (ki*block_k + bk - 1) + window - 1; later q blocks
+        # are skipped entirely
+        nqb = jnp.minimum(
+            nqb, lax.div(ki * jnp.int32(block_k)
+                         + jnp.int32(block_k + window - 2),
+                         jnp.int32(block_q)) + jnp.int32(1))
 
     def body(i, carry):
         dk, dv = carry
@@ -204,6 +240,8 @@ def _attn_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
         mask = (kpos < seq_k) & (qpos < seq_q)
         if causal:
             mask = mask & (qpos >= kpos)
+        if window:
+            mask = mask & (qpos - kpos < jnp.int32(window))
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
@@ -218,7 +256,7 @@ def _attn_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
-               interpret, true_tq, true_tk):
+               interpret, true_tq, true_tk, window=0):
     """Blockwise flash backward: dQ kernel over query blocks, dK/dV
     kernel over key blocks. Memory is O(T·block), not O(T²)."""
     bh, tq, d = q.shape
@@ -227,7 +265,8 @@ def _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
     # query rows have dO == 0 so their D is 0. [BH, T, 1] like lse.
     dcap = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                    axis=-1, keepdims=True)
-    kw = dict(block_q=block_q, block_k=block_k, causal=causal, scale=scale)
+    kw = dict(block_q=block_q, block_k=block_k, causal=causal, scale=scale,
+              window=window)
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, np.int32(0)))
     kfull = pl.BlockSpec((1, tk, d), lambda b, i: (b, np.int32(0),
                                                    np.int32(0)))
@@ -273,33 +312,40 @@ def _reference_attention(q, k, v, causal, scale, true_tk):
     return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash_core(q, k, v, causal, scale, block_q, block_k, interpret,
-                true_tq, true_tk):
+                true_tq, true_tk, window=0):
     return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                      true_tk)[0]
+                      true_tk, window)[0]
 
 
 def _flash_core_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                    true_tq, true_tk):
+                    true_tq, true_tk, window=0):
     o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                        interpret, true_tk)
+                        interpret, true_tk, window)
     return o, (q, k, v, o, lse)
 
 
 def _flash_core_bwd(causal, scale, block_q, block_k, interpret, true_tq,
-                    true_tk, res, g):
+                    true_tk, window, res, g):
     q, k, v, o, lse = res
     return _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k,
-                      interpret, true_tq, true_tk)
+                      interpret, true_tq, true_tk, window)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def flash_attention(q, k, v, *, causal=False, scale=None, block_q=None,
-                    block_k=None, interpret=None):
+                    block_k=None, interpret=None, window=0):
     """Fused attention. q,k,v: [B, T, H, D]; returns [B, T, H, D].
+
+    ``window``>0 (requires ``causal``) computes sliding-window
+    attention: keys more than ``window-1`` positions behind their query
+    are masked AND whole out-of-window key/query blocks are skipped in
+    the forward and both backward kernels, so attention compute scales
+    with T·window instead of T².
 
     Pads T to block multiples internally (padded keys masked out, padded
     queries dropped). Use inside jit; differentiable.
@@ -330,9 +376,16 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=None,
             x = jnp.pad(x, ((0, 0), (0, tp - t), (0, 0)))
         return x
 
+    if window < 0:
+        # a negative window would mask EVERY key (qpos-kpos >= 0 always)
+        # and silently return zeros through the l >= 1e-30 clamp
+        raise ValueError("flash_attention: window must be >= 0, got %d"
+                         % window)
+    if window and not causal:
+        raise ValueError("flash_attention: window>0 requires causal")
     qb, kb, vb = to_bh(q, tq), to_bh(k, tk), to_bh(v, tk)
     out = _flash_core(qb, kb, vb, causal, scale, block_q, block_k, interpret,
-                      tq, tk)
+                      tq, tk, int(window))
     out = out[:, :tq]
     return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
 

@@ -91,6 +91,9 @@ def blockwise_attention(q, k, v, *, causal=False, block_size=512,
     """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
+    if window < 0:
+        raise ValueError("blockwise_attention: window must be >= 0, "
+                         "got %d" % window)
     if window and not causal:
         raise ValueError("blockwise_attention: window>0 requires causal")
     if scale is None:

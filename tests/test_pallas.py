@@ -60,6 +60,75 @@ def test_flash_attention_grad():
                                    rtol=2e-3, atol=2e-4)
 
 
+def _dense_window(q, k, v, window, scale=None):
+    B, T, H, D = q.shape
+    scale = scale or 1.0 / np.sqrt(D)
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    qp, kp = np.arange(T)[:, None], np.arange(T)[None, :]
+    mask = (kp <= qp) & (qp - kp < window)
+    s = np.where(mask[None, None], s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.mark.parametrize("t,window,bq,bk", [
+    (64, 8, 16, 16),    # window inside one block
+    (64, 24, 16, 16),   # window crosses block boundaries
+    (100, 40, 32, 16),  # padded T, asymmetric blocks
+    (64, 64, 16, 16),   # window == T (degenerates to causal)
+])
+def test_flash_attention_sliding_window(t, window, bq, bk):
+    """Windowed flash forward equals the dense sliding-window oracle —
+    including the block-skip bounds (out-of-window blocks never enter
+    the streaming loop)."""
+    rng = np.random.RandomState(7)
+    q = rng.randn(2, t, 2, 16).astype(np.float32)
+    k = rng.randn(2, t, 2, 16).astype(np.float32)
+    v = rng.randn(2, t, 2, 16).astype(np.float32)
+    out = pk.flash_attention(jnp.array(q), jnp.array(k), jnp.array(v),
+                             causal=True, window=window,
+                             block_q=bq, block_k=bk)
+    np.testing.assert_allclose(np.asarray(out),
+                               _dense_window(q, k, v, window),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_sliding_window_grad():
+    """Windowed flash gradients equal dense-windowed autodiff — both
+    backward kernels honor the same block-skip bounds and masks."""
+    rng = np.random.RandomState(8)
+    T, W = 48, 10
+    q = rng.randn(1, T, 1, 8).astype(np.float32)
+    k = rng.randn(1, T, 1, 8).astype(np.float32)
+    v = rng.randn(1, T, 1, 8).astype(np.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(pk.flash_attention(q, k, v, causal=True,
+                                          window=W, block_q=16,
+                                          block_k=16) ** 2)
+
+    def loss_dense(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(8)
+        qp, kp = np.arange(T)[:, None], np.arange(T)[None, :]
+        mask = (kp <= qp) & (qp - kp < W)
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p, v) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(
+        jnp.array(q), jnp.array(k), jnp.array(v))
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(
+        jnp.array(q), jnp.array(k), jnp.array(v))
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+    with pytest.raises(ValueError, match="causal"):
+        pk.flash_attention(jnp.array(q), jnp.array(k), jnp.array(v),
+                           causal=False, window=W)
+
+
 def test_flash_attention_under_jit():
     rng = np.random.RandomState(2)
     q = rng.randn(1, 64, 2, 8).astype(np.float32)
