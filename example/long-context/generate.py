@@ -38,6 +38,9 @@ def main():
                          "by the group factor")
     ap.add_argument("--cache-dtype", default=None,
                     help="e.g. int8 — half-size quantized K/V cache")
+    ap.add_argument("--window", type=int, default=0,
+                    help="sliding-window attention: the decode cache "
+                         "becomes a window-slot ring buffer")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -47,7 +50,8 @@ def main():
     # layout would emit probabilities); the Decoder strips either head
     sym = get_transformer_lm(V, num_layers=2, embed_dim=32, num_heads=2,
                              impl="dense", loss_layout="ce",
-                             num_kv_heads=args.num_kv_heads)
+                             num_kv_heads=args.num_kv_heads,
+                             window=args.window)
     trainer = par.ParallelTrainer(
         sym, {"data": (16, T), "softmax_label": (16, T)},
         optimizer="adam", mesh=par.data_parallel_mesh(1),

@@ -51,10 +51,11 @@ def default_attn_blocks(head_dim):
     Known single-chip ceiling: the BACKWARD kernels keep full-sequence
     q/do/lse/dcap rows in VMEM (the [T, 1] residuals tile to 128
     lanes), which at T=8192 exceeds scoped VMEM at >=256 blocks — and
-    this environment's compile relay crashes outright at 128. Train
-    longer sequences the designed way: sp/ring sharding
-    (SequenceParallelTrainer), where each shard's local T stays below
-    the limit."""
+    this environment's compile relay crashes outright at 128. Full
+    (non-windowed) attention trains longer sequences via sp/ring
+    sharding (SequenceParallelTrainer) where each shard's local T
+    stays below the limit; the ring impls do not support window>0, so
+    windowed training is bounded by this ceiling."""
     import os
     d = 512 if head_dim <= 128 else 128
     return (int(os.environ.get("MXNET_FLASH_BLOCK_Q", d)),
@@ -63,6 +64,17 @@ def default_attn_blocks(head_dim):
 
 # ---------------------------------------------------------------------------
 # flash attention
+
+def _window_lo(qi, block_q, block_k, window):
+    """First key block any row of query block ``qi`` can see under a
+    sliding window: max(0, (qi*block_q - (window-1)) // block_k), in
+    the kernels' int32 arithmetic (shared by the fwd and dQ kernels so
+    their skip bounds cannot drift apart)."""
+    return jnp.maximum(jnp.int32(0),
+                       lax.div(qi * jnp.int32(block_q)
+                               - jnp.int32(window - 1),
+                               jnp.int32(block_k)))
+
 
 def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
                      block_k, seq_k, causal, scale, window=0):
@@ -82,13 +94,9 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q,
                                   jnp.int32(block_k)))
     lo = jnp.int32(0)
     if window:
-        # sliding window: the earliest key ANY row of this q block can
-        # see is qi*block_q - (window-1); whole k blocks before it are
-        # skipped (this is where the T/window compute saving comes from)
-        lo = jnp.maximum(jnp.int32(0),
-                         lax.div(qi * jnp.int32(block_q)
-                                 - jnp.int32(window - 1),
-                                 jnp.int32(block_k)))
+        # sliding window: whole k blocks before the earliest visible
+        # key are skipped (this is where the T/window saving comes from)
+        lo = _window_lo(qi, block_q, block_k, window)
 
     neg_big = jnp.float32(-1e30)  # avoid -inf arithmetic in Mosaic
 
@@ -179,10 +187,7 @@ def _attn_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref,
                                   jnp.int32(block_k)))
     lo = jnp.int32(0)
     if window:
-        lo = jnp.maximum(jnp.int32(0),
-                         lax.div(qi * jnp.int32(block_q)
-                                 - jnp.int32(window - 1),
-                                 jnp.int32(block_k)))
+        lo = _window_lo(qi, block_q, block_k, window)
 
     def body(j, dq):
         k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
