@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from collections import namedtuple
 
@@ -33,7 +34,7 @@ from .executor_manager import (DataParallelExecutorManager,
                                _load_general)
 
 __all__ = ["FeedForward", "save_checkpoint", "load_checkpoint",
-           "BatchEndParam"]
+           "load_optimizer_states", "latest_checkpoint", "BatchEndParam"]
 
 BASE_ESTIMATOR = object
 BatchEndParam = namedtuple("BatchEndParams",
@@ -137,13 +138,88 @@ def _epoch_batches(train_data, epoch_size, logger, epoch):
             return
 
 
+def _resume_blob_fits(resume_states, expected_format, live_opt_name,
+                      logger):
+    """Shared warn-and-degrade guard for checkpointed optimizer-state
+    blobs: False (with a loud log line) when the blob was written by
+    the other training loop, or under a different optimizer — e.g.
+    adam (mean, var) tuples fed to sgd's momentum slot would crash
+    deep inside update() with no hint it came from resume. The caller
+    then continues with checkpointed params but FRESH optimizer
+    state."""
+    if resume_states.get("format") != expected_format:
+        logger.warning(
+            "resume: checkpointed optimizer state (format=%r) does not "
+            "fit this training path — continuing with checkpointed "
+            "params but fresh optimizer state",
+            resume_states.get("format"))
+        return False
+    saved_opt = resume_states.get("optimizer")
+    if saved_opt is not None and live_opt_name is not None \
+            and saved_opt != live_opt_name:
+        logger.warning(
+            "resume: checkpoint was saved under optimizer %r but this "
+            "run uses %r — continuing with checkpointed params but "
+            "fresh optimizer state", saved_opt, live_opt_name)
+        return False
+    return True
+
+
+def _restore_updater_states(updater, resume_states, logger):
+    """Apply a checkpointed optimizer-state blob to an updater; skips
+    blobs written by the fused loop or a different optimizer with a
+    loud log line — the resumed run then continues with FRESH optimizer
+    state but the checkpointed params."""
+    if resume_states is None:
+        return
+    if updater is None or not hasattr(updater, "set_states"):
+        logger.warning(
+            "resume: checkpointed optimizer state (format=%r) does not "
+            "fit this training path — continuing with checkpointed "
+            "params but fresh optimizer state",
+            resume_states.get("format"))
+        return
+    live_opt = getattr(updater, "optimizer", None)
+    if not _resume_blob_fits(
+            resume_states, "updater",
+            type(live_opt).__name__ if live_opt is not None else None,
+            logger):
+        return
+    updater.set_states(resume_states)
+    logger.info("resume: restored optimizer state (%d param slots)",
+                len(resume_states.get("states", {})))
+
+
+def _is_checkpoint_writer(kvstore):
+    """In multi-process dist training every worker runs the training
+    loop, but only rank 0 publishes the shared checkpoint files: the
+    save-path serialization (_SAVE_LOCKS) is in-process only and cannot
+    arbitrate two ranks writing the same .tmp path on a shared FS."""
+    if kvstore is None or "dist" not in getattr(kvstore, "type", ""):
+        return True
+    return getattr(kvstore, "rank", 0) == 0
+
+
+def _updater_states_blob(updater):
+    """Checkpointable blob for an updater that supports get_states
+    (tagged so resume can detect cross-loop mismatches)."""
+    if updater is None or not hasattr(updater, "get_states"):
+        return None
+    blob = updater.get_states()
+    blob["format"] = "updater"
+    if getattr(updater, "optimizer", None) is not None:
+        blob["optimizer"] = type(updater.optimizer).__name__
+    return blob
+
+
 def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
                         arg_params, aux_params, begin_epoch, end_epoch,
                         epoch_size, optimizer, kvstore, update_on_kvstore,
                         train_data, eval_data=None, eval_metric=None,
                         epoch_end_callback=None, batch_end_callback=None,
                         logger=None, work_load_list=None, monitor=None,
-                        eval_batch_end_callback=None, sym_gen=None):
+                        eval_batch_end_callback=None, sym_gen=None,
+                        checkpoint_prefix=None, resume_states=None):
     """The training loop (reference model.py:118-308)."""
     if logger is None:
         logger = logging
@@ -157,6 +233,7 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
 
     if not update_on_kvstore:
         updater = opt.get_updater(optimizer)
+        _restore_updater_states(updater, resume_states, logger)
     if kvstore:
         _initialize_kvstore(kvstore=kvstore,
                             param_arrays=executor_manager.execgrp.param_arrays,
@@ -165,6 +242,12 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
                             update_on_kvstore=update_on_kvstore)
     if update_on_kvstore:
         kvstore.set_optimizer(optimizer)
+        # local update-on-kvstore keeps its updater in-process — restore
+        # there; a dist store's state lives server-side (params-only
+        # resume, _restore_updater_states logs the downgrade)
+        if resume_states is not None:
+            _restore_updater_states(getattr(kvstore, "_updater", None),
+                                    resume_states, logger)
 
     train_data.reset()
     for epoch in range(begin_epoch, end_epoch):
@@ -200,13 +283,28 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
         logger.info("Epoch[%d] Time cost=%.3f", epoch,
                     time.time() - epoch_start)
 
-        if epoch_end_callback or epoch + 1 == end_epoch:
+        if epoch_end_callback \
+                or (checkpoint_prefix and _is_checkpoint_writer(kvstore)) \
+                or epoch + 1 == end_epoch:
+            # non-writer dist ranks skip the per-epoch host gather —
+            # they would only throw it away at the checkpoint gate below
             executor_manager.copy_to(arg_params, aux_params)
         if epoch_end_callback is not None:
             for callback in (epoch_end_callback
                              if isinstance(epoch_end_callback, list)
                              else [epoch_end_callback]):
                 callback(epoch, symbol, arg_params, aux_params)
+        if checkpoint_prefix and _is_checkpoint_writer(kvstore):
+            # crash-resume checkpoint: params + optimizer state, every
+            # epoch, published atomically (save_checkpoint's tmp+replace)
+            if not update_on_kvstore:
+                states = _updater_states_blob(updater)
+            else:
+                states = _updater_states_blob(
+                    getattr(kvstore, "_updater", None))
+            save_checkpoint(checkpoint_prefix, epoch + 1, symbol,
+                            arg_params, aux_params,
+                            optimizer_states=states)
 
         if eval_data:
             eval_metric.reset()
@@ -289,7 +387,8 @@ def _train_fused(symbol, ctx, arg_params, aux_params, begin_epoch,
                  end_epoch, epoch_size, optimizer, train_data,
                  eval_data=None, eval_metric=None, epoch_end_callback=None,
                  batch_end_callback=None, logger=None, kvstore=None,
-                 eval_batch_end_callback=None):
+                 eval_batch_end_callback=None, checkpoint_prefix=None,
+                 resume_states=None):
     """The fused training loop: protocol-identical to
     ``_train_multi_device`` (metrics, callbacks, epoch_size semantics),
     but each step is ONE donated XLA program on a dp mesh
@@ -297,6 +396,8 @@ def _train_fused(symbol, ctx, arg_params, aux_params, begin_epoch,
     the optimizer update fused, with the cross-device reduce as an
     in-program psum instead of kvstore copies (reference
     model.py:118-308 runs these as separate host-driven phases)."""
+    import jax
+
     from .parallel import ParallelTrainer
     if logger is None:
         logger = logging
@@ -308,6 +409,16 @@ def _train_fused(symbol, ctx, arg_params, aux_params, begin_epoch,
     trainer = ParallelTrainer(symbol, input_shapes, optimizer=optimizer,
                               mesh=mesh)
     trainer.init_params(arg_params, aux_params)
+    if resume_states is not None and _resume_blob_fits(
+            resume_states, "fused", type(optimizer).__name__, logger):
+        try:
+            trainer.set_optimizer_states(resume_states)
+            logger.info("resume: restored fused optimizer state at "
+                        "step %d", trainer._t)
+        except MXNetError as e:
+            logger.warning(
+                "resume: %s — continuing with checkpointed params "
+                "but fresh optimizer state", e)
     data_names = [x[0] for x in train_data.provide_data]
     label_names = [x[0] for x in train_data.provide_label]
 
@@ -349,13 +460,28 @@ def _train_fused(symbol, ctx, arg_params, aux_params, begin_epoch,
         toc = time.time()
         logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
 
-        if epoch_end_callback or epoch + 1 == end_epoch:
+        if epoch_end_callback or checkpoint_prefix \
+                or epoch + 1 == end_epoch:
             sync_params()
         if epoch_end_callback is not None:
             for callback in (epoch_end_callback
                              if isinstance(epoch_end_callback, list)
                              else [epoch_end_callback]):
                 callback(epoch, symbol, arg_params, aux_params)
+        if checkpoint_prefix:
+            # the host gather inside get_optimizer_states is a
+            # collective when state is sharded (zero1/fsdp): EVERY
+            # process must dispatch it, or process 0 deadlocks waiting
+            # for an SPMD program the others never launch
+            states = trainer.get_optimizer_states()
+            states["format"] = "fused"
+            states["optimizer"] = type(optimizer).__name__
+            if jax.process_index() == 0:
+                # ...but only one writer per job: the save-path
+                # serialization is in-process only
+                save_checkpoint(checkpoint_prefix, epoch + 1, symbol,
+                                arg_params, aux_params,
+                                optimizer_states=states)
 
         if eval_data:
             eval_metric.reset()
@@ -395,29 +521,153 @@ def _run_callbacks(callbacks, params):
         cb(params)
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+def _clear_stale_tmp(tmp_name):
+    """Remove a stale tmp file left by a writer that died before its
+    os.replace — otherwise a later save's in-flight write to the same
+    tmp path is indistinguishable from the corpse (and a crash between
+    the two would surface the OLD half-written bytes as "in flight")."""
+    if os.path.exists(tmp_name):
+        logging.warning("removing stale checkpoint temp file %s (a "
+                        "previous writer died mid-save)", tmp_name)
+        try:
+            os.remove(tmp_name)
+        except OSError:
+            pass
+
+
+def _atomic_local_save(writer, final_path):
+    """tmp + os.replace publication for local checkpoint files."""
+    tmp_name = final_path + ".tmp"
+    _clear_stale_tmp(tmp_name)
+    writer(tmp_name)
+    os.replace(tmp_name, final_path)
+
+
+def _strip_file_uri(path):
+    return path[len("file://"):] if path.startswith("file://") else path
+
+
+def _is_remote(path):
+    return path.startswith(("s3://", "hdfs://"))
+
+
+def _publish(path, writer):
+    """Write one checkpoint file: remote URIs (the dmlc::Stream surface)
+    write directly — object stores publish atomically on successful
+    close; local paths go through tmp + os.replace."""
+    local = _strip_file_uri(path)
+    if _is_remote(local):
+        writer(local)
+    else:
+        _atomic_local_save(writer, local)
+
+
+# per-prefix locks serializing in-process checkpoint writers:
+# fit(checkpoint_prefix=...) and a do_checkpoint(async_write=True)
+# callback on the SAME prefix would otherwise race on the same .tmp
+# paths — _clear_stale_tmp would delete the other writer's in-flight
+# file out from under its os.replace. Unrelated prefixes stay parallel.
+_SAVE_LOCKS = {}
+_SAVE_LOCKS_GUARD = threading.Lock()
+# absolute .states paths the CURRENT fit run on a prefix published: a
+# states-less writer for the same epoch (a do_checkpoint callback
+# running next to fit's own checkpoint branch) must NOT remove them —
+# only a genuinely stale file from a previous run is removed. fit
+# clears a prefix's entries when a new run starts on it (see
+# _forget_states_published), so "previous run" includes an earlier
+# fit call in this same process, not just a dead process's leftovers.
+_STATES_PUBLISHED = set()
+
+
+def _forget_states_published(prefix):
+    """A new fit run is starting on ``prefix``: .states files already
+    on disk belong to a PREVIOUS run and become eligible for the
+    stale-states cleanup again. Entries for the new run's epochs are
+    re-added as it checkpoints. Anchored to the epoch pattern (like
+    latest_checkpoint) so prefix 'cp' does not forget a sibling run's
+    'cp-run2-0003.states'."""
+    import re
+    base = os.path.abspath(_strip_file_uri(prefix))
+    pat = re.compile(re.escape(base) + r"-\d{4,}\.states$")
+    with _SAVE_LOCKS_GUARD:  # vs a concurrent writer's .add
+        _STATES_PUBLISHED.difference_update(
+            {p for p in _STATES_PUBLISHED if pat.match(p)})
+
+
+def _save_lock_for(prefix):
+    key = _strip_file_uri(prefix)
+    if not _is_remote(key):
+        key = os.path.abspath(key)
+    with _SAVE_LOCKS_GUARD:
+        return _SAVE_LOCKS.setdefault(key, threading.Lock())
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    optimizer_states=None):
     """Save prefix-symbol.json + prefix-%04d.params (reference :311).
+
+    ``optimizer_states`` (a picklable blob, e.g. ``updater.get_states()``
+    or ``ParallelTrainer.get_optimizer_states()``) additionally writes
+    ``prefix-%04d.states`` so a crash-resumed ``fit`` continues the same
+    optimizer trajectory (momentum/adam moments/update counts) instead
+    of restarting them cold.
 
     Local files (plain paths and file:// URIs) are written via tmp +
     os.replace so a writer dying mid-write (e.g.
     do_checkpoint(async_write=True)'s daemon thread at interpreter exit)
-    never leaves a truncated file that looks complete. Remote URIs
+    never leaves a truncated file that looks complete; stale ``.tmp``
+    corpses from a crashed writer are cleaned up first. Remote URIs
     (s3://, hdfs://; the dmlc::Stream surface) write directly — object
     stores publish atomically on successful close.
     """
-    import os
-    symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    local = param_name[len("file://"):] \
-        if param_name.startswith("file://") else param_name
-    if local.startswith(("s3://", "hdfs://")):
-        nd.save(param_name, save_dict)
-    else:
-        tmp_name = local + ".tmp"
-        nd.save(tmp_name, save_dict)
-        os.replace(tmp_name, local)
+    local = _strip_file_uri(param_name)
+    # .states is published BEFORE .params: the .params file is the
+    # checkpoint's completeness marker (latest_checkpoint keys off it),
+    # so a crash between the two hides the half-checkpoint instead of
+    # leaving a params file that silently resumes with cold optimizer
+    # state
+    states_name = local[:-len(".params")] + ".states" \
+        if local.endswith(".params") else local + ".states"
+    with _save_lock_for(prefix):
+        # symbol.json is atomic like .params/.states: a crash mid-write
+        # must not leave a truncated symbol file that breaks every
+        # future resume while latest_checkpoint still reports good epochs
+        _publish("%s-symbol.json" % prefix, symbol.save)
+        if optimizer_states is None:
+            # a states file from an EARLIER run at this prefix/epoch no
+            # longer corresponds to the params about to be published —
+            # left in place, a later resume would silently apply the old
+            # run's momentum/update counts to the new run's params.
+            # One THIS process published stays: that is fit's own
+            # checkpoint branch next to a states-less do_checkpoint
+            # callback on the same prefix, not a stale leftover.
+            if not _is_remote(local) \
+                    and os.path.abspath(states_name) \
+                    not in _STATES_PUBLISHED \
+                    and os.path.exists(states_name):
+                logging.warning("removing stale optimizer-state file %s "
+                                "(this checkpoint has no optimizer "
+                                "state)", states_name)
+                try:
+                    os.remove(states_name)
+                except OSError:
+                    pass
+        else:
+            import pickle
+
+            def _write_states(path):
+                from .stream import open_stream  # URI dispatch, nd.save
+                with open_stream(path, "wb") as f:
+                    pickle.dump(optimizer_states, f,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            _publish(states_name, _write_states)
+            if not _is_remote(local):
+                with _SAVE_LOCKS_GUARD:
+                    _STATES_PUBLISHED.add(os.path.abspath(states_name))
+        _publish(param_name, lambda p: nd.save(p, save_dict))
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 
@@ -434,6 +684,49 @@ def load_checkpoint(prefix, epoch):
         if tp == "aux":
             aux_params[name] = v
     return symbol, arg_params, aux_params
+
+
+def load_optimizer_states(prefix, epoch):
+    """The optimizer-state blob saved next to ``prefix-%04d.params``, or
+    None when that epoch was checkpointed without one (pre-resume
+    checkpoints, or a dist store whose state lives server-side)."""
+    import pickle
+    from .stream import open_stream  # plain paths and URIs alike
+    try:
+        # any open failure (missing local file, absent remote object)
+        # means "no states were saved" — resume degrades to params-only
+        f = open_stream("%s-%04d.states" % (prefix, epoch), "rb")
+    except Exception:
+        return None
+    with f:
+        return pickle.load(f)
+
+
+def latest_checkpoint(prefix):
+    """The largest epoch N for which ``prefix-%04d.params`` exists, or
+    None. In-flight/stale ``.tmp`` files are ignored — only fully
+    published checkpoints count (save_checkpoint's os.replace is the
+    publication point).
+
+    ``file://`` prefixes are searched like plain paths. Remote prefixes
+    (s3://, hdfs://) cannot be listed through this surface and return
+    None — auto-resume does not support them (fit logs this)."""
+    import glob
+    import re
+    prefix = _strip_file_uri(prefix)
+    if _is_remote(prefix):
+        return None
+    best = None
+    pat = re.compile(re.escape(os.path.basename(prefix)) +
+                     r"-(\d{4,})\.params$")  # %04d grows past 9999
+    for path in glob.glob(glob.escape(prefix) + "-*.params"):
+        # anchored match: 'cp-b-cp-0007.params' must not count as
+        # epoch 7 of prefix 'cp' just because the suffix re-embeds it
+        m = pat.match(os.path.basename(path))
+        if m:
+            epoch = int(m.group(1))
+            best = epoch if best is None else max(best, epoch)
+    return best
 
 
 class FeedForward(BASE_ESTIMATOR):
@@ -625,16 +918,79 @@ class FeedForward(BASE_ESTIMATOR):
                                              locals=locals()))
         return eval_metric.get()[1]
 
+    def _resume_from_checkpoint(self, prefix, logger):
+        """Auto-resume: load the latest fully published checkpoint at
+        ``prefix`` (params, plus the optimizer-state blob when one was
+        saved) and fast-forward ``begin_epoch`` so training continues
+        where the dead run stopped. The constructed symbol stays
+        authoritative — only params/state are read. Returns the
+        optimizer-state blob or None."""
+        if _is_remote(_strip_file_uri(prefix)):
+            logger.warning(
+                "fit: auto-resume does not support remote checkpoint "
+                "prefixes (%s) — remote stores cannot be listed through "
+                "this surface; training starts at begin_epoch=%d (pass "
+                "resume=False to silence this)", prefix,
+                self.begin_epoch)
+            return None
+        epoch = latest_checkpoint(prefix)
+        if epoch is None or epoch <= self.begin_epoch:
+            return None
+        logger.info("fit: auto-resuming from \"%s-%04d.params\" "
+                    "(begin_epoch %d -> %d)", prefix, epoch,
+                    self.begin_epoch, epoch)
+        _, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = epoch
+        states = load_optimizer_states(prefix, epoch)
+        if states is None:
+            logger.warning(
+                "fit: no optimizer-state blob next to \"%s-%04d.params\""
+                " — resuming with checkpointed params but FRESH "
+                "optimizer state (momentum/update counts restart cold)",
+                prefix, epoch)
+        return states
+
     def fit(self, X, y=None, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, monitor=None,
-            eval_batch_end_callback=None):
-        """Train (reference model.py:681-767)."""
+            eval_batch_end_callback=None, checkpoint_prefix=None,
+            resume=True):
+        """Train (reference model.py:681-767).
+
+        ``checkpoint_prefix`` turns on crash-resume: every epoch is
+        checkpointed (params + optimizer state, atomically published)
+        under that prefix, and — unless ``resume=False`` — a fresh call
+        first looks for the latest complete ``prefix-%04d.params``,
+        reloads params and optimizer state, and continues from that
+        epoch instead of restarting at ``begin_epoch``. See
+        doc/fault_tolerance.md.
+        """
         if self.num_epoch is None:
             raise ValueError("num_epoch must be set when calling fit "
                              "(pass num_epoch= to FeedForward)")
         data = self._init_iter(X, y, is_train=True)
         eval_data = self._init_eval_iter(eval_data)
+
+        resume_states = None
+        if checkpoint_prefix is not None:
+            _forget_states_published(checkpoint_prefix)
+            if resume:
+                log = logger if logger is not None else logging
+                kv_type = kvstore if isinstance(kvstore, str) \
+                    else getattr(kvstore, "type", "")
+                if "dist" in (kv_type or ""):
+                    # each rank decides begin_epoch from the files IT
+                    # sees; with per-worker disks the ranks would resume
+                    # at different epochs and hang in collectives
+                    log.warning(
+                        "fit: dist auto-resume assumes every worker "
+                        "sees the same checkpoint files (shared "
+                        "filesystem) — ranks resuming at different "
+                        "epochs will desynchronize the job")
+                resume_states = self._resume_from_checkpoint(
+                    checkpoint_prefix, log)
 
         if self.sym_gen:
             self.symbol = self.sym_gen(data.default_bucket_key)
@@ -677,7 +1033,9 @@ class FeedForward(BASE_ESTIMATOR):
                     epoch_end_callback=epoch_end_callback,
                     batch_end_callback=batch_end_callback,
                     kvstore=kvstore, logger=logger,
-                    eval_batch_end_callback=eval_batch_end_callback)
+                    eval_batch_end_callback=eval_batch_end_callback,
+                    checkpoint_prefix=checkpoint_prefix,
+                    resume_states=resume_states)
             else:
                 _train_multi_device(
                     self.symbol, self.ctx, arg_names, param_names, aux_names,
@@ -692,7 +1050,9 @@ class FeedForward(BASE_ESTIMATOR):
                     logger=logger, work_load_list=work_load_list,
                     monitor=monitor,
                     eval_batch_end_callback=eval_batch_end_callback,
-                    sym_gen=self.sym_gen)
+                    sym_gen=self.sym_gen,
+                    checkpoint_prefix=checkpoint_prefix,
+                    resume_states=resume_states)
         finally:
             # drain async checkpoint writers even on error/interrupt so
             # no .params file is left truncated by a dying daemon thread

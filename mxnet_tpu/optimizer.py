@@ -408,15 +408,59 @@ class Test(Optimizer):
         state._set(weight._val)
 
 
+def _state_to_host(state):
+    """Optimizer state -> picklable host structure (NDArray leaves become
+    numpy; tuple/list/None structure is preserved)."""
+    if state is None:
+        return None
+    if isinstance(state, (tuple, list)):
+        return type(state)(_state_to_host(s) for s in state)
+    if isinstance(state, NDArray):
+        return state.asnumpy()
+    return np.asarray(state)
+
+
+def _state_from_host(state):
+    """Inverse of :func:`_state_to_host`."""
+    from . import ndarray as nd
+    if state is None:
+        return None
+    if isinstance(state, (tuple, list)):
+        return type(state)(_state_from_host(s) for s in state)
+    return nd.array(np.asarray(state))
+
+
 def get_updater(optimizer):
     """Close an optimizer into updater(index, grad, weight) with lazily
-    created per-index state (reference optimizer.py get_updater)."""
+    created per-index state (reference optimizer.py get_updater).
+
+    ``get_states()``/``set_states()`` (reference updater.get_states /
+    set_states) snapshot and restore the per-index state PLUS the
+    optimizer's update counts (adam bias correction, lr schedules), so a
+    crash-resumed run continues the exact same optimizer trajectory."""
     states = {}
 
     def updater(index, grad, weight):
         if index not in states:
             states[index] = optimizer.create_state(index, weight)
         optimizer.update(index, weight, grad, states[index])
+
+    def get_states():
+        return {
+            "states": {k: _state_to_host(v) for k, v in states.items()},
+            "update_count": dict(optimizer._index_update_count),
+            "num_update": optimizer.num_update,
+        }
+
+    def set_states(blob):
+        states.clear()
+        states.update({k: _state_from_host(v)
+                       for k, v in blob["states"].items()})
+        optimizer._index_update_count = dict(blob["update_count"])
+        optimizer.num_update = blob["num_update"]
+
     updater.states = states
     updater.optimizer = optimizer
+    updater.get_states = get_states
+    updater.set_states = set_states
     return updater

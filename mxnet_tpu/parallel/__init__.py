@@ -23,7 +23,7 @@ from .graph import make_graph_fn  # noqa: F401
 from .optim import make_functional  # noqa: F401
 from .trainer import ParallelTrainer  # noqa: F401
 from .sp import SequenceParallelTrainer  # noqa: F401
-from .checkpoint import save_sharded, load_sharded  # noqa: F401
+from .checkpoint import save_sharded, load_sharded, latest_step  # noqa: F401
 from . import collectives  # noqa: F401
 from .ring import (ring_attention, blockwise_attention,  # noqa: F401
                    ring_self_attention, striped_ring_attention)

@@ -28,8 +28,8 @@ import os
 import numpy as np
 import jax
 
-__all__ = ["save_sharded", "load_sharded", "flatten_train_state",
-           "restore_opt_state"]
+__all__ = ["save_sharded", "load_sharded", "latest_step",
+           "flatten_train_state", "restore_opt_state"]
 
 
 def _spec_to_list(spec):
@@ -216,6 +216,34 @@ def save_sharded(prefix, params, step=0, extra=None, async_write=False):
             raise err[0]
 
     return finalize
+
+
+def latest_step(prefix):
+    """Crash-resume probe: the step of the checkpoint at ``prefix`` if it
+    is COMPLETE (readable manifest + every shard file the manifest
+    names), else None.
+
+    The write protocol publishes the manifest only after all shard
+    files exist, and every file lands via tmp + os.replace — so either
+    this returns a step whose files are all wholly written, or it
+    returns None and the caller starts fresh. A writer that died
+    mid-save can leave stale ``*.tmp`` files around; they are ignored
+    (and overwritten by the next save)."""
+    try:
+        with open("%s-manifest.json" % prefix) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    step = manifest.get("step")
+    nprocs = manifest.get("nprocs")
+    if step is None or nprocs is None:
+        # foreign or hand-edited manifest: not a resumable checkpoint,
+        # and the completeness check below would be meaningless
+        return None
+    for r in range(nprocs):
+        if not os.path.exists("%s-shards-p%d.npz" % (prefix, r)):
+            return None  # manifest from a save whose shards were lost
+    return step
 
 
 def load_sharded(prefix, mesh, param_specs=None):

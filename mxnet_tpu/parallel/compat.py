@@ -1,0 +1,25 @@
+"""jax API compatibility shims.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` into the
+``jax`` namespace, and its replication-check kwarg was renamed
+``check_rep`` -> ``check_vma`` along the way. The parallel modules write
+the modern spelling; this shim translates when the image pins an older
+jax, so one jax upgrade/downgrade cannot take the whole package's import
+down with it.
+"""
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map_impl
+    _LEGACY_CHECK_KW = False
+except ImportError:  # older jax: experimental namespace, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _LEGACY_CHECK_KW = True
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, **kwargs):
+    if _LEGACY_CHECK_KW and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map_impl(f, **kwargs)

@@ -270,6 +270,10 @@ class ParallelTrainer:
         self._jit_step = None
         self._jit_multi = {}  # num_steps -> compiled scan-of-steps
         self._jit_eval = None
+        # buffer donation for the carried train state; flipped off at
+        # runtime if this jaxlib miscompiles the alias table (see
+        # _disable_donation_or_reraise)
+        self._donate = True
         if initializer is None:
             initializer = Uniform(0.01)
         self._initializer = initializer
@@ -417,7 +421,8 @@ class ParallelTrainer:
                  self._data_sh, self._repl, self._repl, self._repl)
         out_sh = (self._param_sh, self._opt_sh, None, None)
         return jax.jit(self._step_impl, in_shardings=in_sh,
-                       out_shardings=out_sh, donate_argnums=(0, 1, 2))
+                       out_shardings=out_sh,
+                       donate_argnums=(0, 1, 2) if self._donate else ())
 
     def _build_eval(self):
         def run(params, aux, batch, rng):
@@ -515,10 +520,48 @@ class ParallelTrainer:
         # numpy scalars (not jnp) keep this dispatch-only — no eager
         # device ops on the host critical path
         with self.mesh:
-            self.params, self.opt_state, self.aux, outs = self._jit_step(
-                self.params, self.opt_state, self.aux, batch,
-                np.float32(lr), np.int32(self._t), self._rng)
+            try:
+                self.params, self.opt_state, self.aux, outs = \
+                    self._jit_step(self.params, self.opt_state, self.aux,
+                                   batch, np.float32(lr),
+                                   np.int32(self._t), self._rng)
+            except jax.errors.JaxRuntimeError as e:
+                self._disable_donation_or_reraise(e)
+                self._jit_step = self._build_step()
+                self.params, self.opt_state, self.aux, outs = \
+                    self._jit_step(self.params, self.opt_state, self.aux,
+                                   batch, np.float32(lr),
+                                   np.int32(self._t), self._rng)
         return outs
+
+    def _disable_donation_or_reraise(self, err):
+        """Recover from the jaxlib 0.4.x donation-aliasing miscompile.
+
+        On multi-axis meshes where some carried arrays cannot actually
+        be donated (jax warns "Some donated buffers were not usable"),
+        this jaxlib can emit an XLA alias table pairing inputs and
+        outputs of different per-device sizes; the program then fails
+        argument setup with ``INTERNAL: Expected aliased input ...``
+        BEFORE executing, leaving every carried buffer intact. The
+        recovery is to recompile without donation and re-dispatch the
+        same step. Anything else — donation already off, a different
+        error, or a donated buffer actually consumed — re-raises."""
+        carried = list(self.params.values())
+        for s in self.opt_state.values():
+            carried.extend(jax.tree_util.tree_leaves(s))
+        carried.extend(a for a in self.aux if isinstance(a, jax.Array))
+        if (not self._donate or "aliased input" not in str(err)
+                or any(v.is_deleted() for v in carried)):
+            raise err
+        logging.warning(
+            "ParallelTrainer: this jaxlib miscompiled the buffer-"
+            "donation alias table for this sharding layout (%s); "
+            "recompiling the train step without donation (peak memory "
+            "rises by one copy of the train state)",
+            str(err).splitlines()[0])
+        self._donate = False
+        self._jit_step = None
+        self._jit_multi.clear()
 
     def _build_multi_step(self, num_steps):
         def run(params, opt_state, aux, batch, lrs, t0, rng_base):
@@ -539,7 +582,7 @@ class ParallelTrainer:
                  self._repl, self._repl, self._repl)
         out_sh = (self._param_sh, self._opt_sh, None)
         return jax.jit(run, in_shardings=in_sh, out_shardings=out_sh,
-                       donate_argnums=(0, 1, 2))
+                       donate_argnums=(0, 1, 2) if self._donate else ())
 
     def multi_step(self, batch, num_steps):
         """Run ``num_steps`` consecutive train steps on the SAME batch
@@ -565,10 +608,19 @@ class ParallelTrainer:
              else self.optimizer.lr for i in range(num_steps)],
             np.float32)
         with self.mesh:
-            self.params, self.opt_state, self.aux = \
-                self._jit_multi[num_steps](
-                    self.params, self.opt_state, self.aux, batch, lrs,
-                    np.int32(self._t), self._rng)
+            try:
+                self.params, self.opt_state, self.aux = \
+                    self._jit_multi[num_steps](
+                        self.params, self.opt_state, self.aux, batch,
+                        lrs, np.int32(self._t), self._rng)
+            except jax.errors.JaxRuntimeError as e:
+                self._disable_donation_or_reraise(e)
+                self._jit_multi[num_steps] = \
+                    self._build_multi_step(num_steps)
+                self.params, self.opt_state, self.aux = \
+                    self._jit_multi[num_steps](
+                        self.params, self.opt_state, self.aux, batch,
+                        lrs, np.int32(self._t), self._rng)
         self._t += num_steps
 
     def forward(self, batch):
@@ -810,3 +862,68 @@ class ParallelTrainer:
         self.aux = [flat["aux/%s" % n] for n in self.aux_names]
         self._t = step
         return self
+
+    def resume_sharded_checkpoint(self, prefix):
+        """Crash-resume: restore from ``prefix`` if a COMPLETE sharded
+        checkpoint exists there (manifest + every shard file), else
+        leave the trainer untouched. Returns the restored step, or None
+        when there was nothing to resume from — callers use it as the
+        ``begin_epoch``/step offset of the continued run."""
+        from .checkpoint import latest_step
+        step = latest_step(prefix)
+        if step is None:
+            return None
+        self.restore_sharded_checkpoint(prefix)
+        return step
+
+    # -- optimizer-state blobs (FeedForward-style checkpoints) ---------
+    def get_optimizer_states(self):
+        """Picklable host snapshot of optimizer state + step counter —
+        the gather-to-host analogue of the sharded ``opt/`` blobs, saved
+        by ``fit(checkpoint_prefix=...)`` next to the .params file.
+
+        Call from ALL processes (like ``load_sharded``): when state is
+        sharded (zero1/fsdp) the host gather is a collective, and a
+        single process calling alone deadlocks in it."""
+        blob = {"step": int(self._t), "opt": {},
+                # the per-step dropout keys are fold_in(_rng, t): without
+                # the base key a resumed run of a stochastic model draws
+                # different masks than the uninterrupted one
+                "rng": np.asarray(self._rng)}
+        for name, st in self.opt_state.items():
+            blob["opt"][name] = [np.asarray(self._to_host(leaf))
+                                 for leaf in
+                                 jax.tree_util.tree_leaves(st)]
+        return blob
+
+    def set_optimizer_states(self, blob):
+        """Restore a :meth:`get_optimizer_states` snapshot onto an
+        initialized trainer (``init_params`` first — the state STRUCTURE
+        is rebuilt from the optimizer's init on the live params, the
+        same eval_shape trick as ``checkpoint.restore_opt_state``)."""
+        from .checkpoint import restore_opt_state
+        flat = {}
+        for name, param in self.params.items():
+            n_leaves = len(jax.tree_util.tree_leaves(
+                jax.eval_shape(self._opt_init, param)))
+            vals = blob["opt"].get(name)
+            if vals is None or len(vals) != n_leaves:
+                raise MXNetError(
+                    "set_optimizer_states: checkpoint state for %r does "
+                    "not match this trainer's optimizer (saved %s "
+                    "leaves, need %d) — resuming a run under a "
+                    "different optimizer is not supported" %
+                    (name, "no" if vals is None else len(vals),
+                     n_leaves))
+            # place like init_params does (the jit step's in_shardings
+            # expect mesh-placed state; bare host arrays break
+            # multi-process resume)
+            shs = (jax.tree_util.tree_leaves(self._opt_sh[name])
+                   if self._opt_sh is not None else [self._repl] * n_leaves)
+            flat.update({"opt/%s/%d" % (name, i): self._place(v, s)
+                         for i, (v, s) in enumerate(zip(vals, shs))})
+        self.opt_state = restore_opt_state(flat, self.params,
+                                           self._opt_init)
+        self._t = int(blob["step"])
+        if blob.get("rng") is not None:  # pre-rng blobs leave _rng alone
+            self._rng = jnp.asarray(blob["rng"])

@@ -1,0 +1,6 @@
+"""Testing utilities: deterministic fault injection for the distributed
+transport (``mxnet_tpu.testing.faults``). Import cost is near-zero —
+submodules are imported lazily by the tests that need them."""
+from __future__ import annotations
+
+__all__ = ["faults"]

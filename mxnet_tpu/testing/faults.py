@@ -1,0 +1,229 @@
+"""Deterministic fault injection for the ``dist_async`` transport.
+
+The resilient kvstore client (``kvstore_dist.PSBackend._request``) can
+survive dropped frames, severed connections, lost replies, and a
+parameter server that is killed and restarted mid-run — but none of
+those happen on a healthy localhost CI box. This module makes them
+happen ON DEMAND, deterministically, so the retry/reconnect/dedup
+machinery is exercised by fast tier-1 tests instead of only by
+production outages.
+
+Two injection surfaces:
+
+* **Client transport faults** — a :class:`FaultInjector` installs
+  itself as ``kvstore_dist._CLIENT_FAULTS`` while one of its context
+  managers is active. Faults are a FIFO plan of directives consumed one
+  per request attempt, so a test script reads like a fault schedule:
+
+      inj = FaultInjector(seed=7)
+      with inj.sever_connections(1):
+          kv.push(...)        # first attempt severed, retry succeeds
+
+  Randomized schedules (:meth:`FaultInjector.random_faults`) draw from
+  the injector's own seeded RNG — the same seed always yields the same
+  fault sequence, never from global random state.
+
+* **Server crashes** — :func:`kill_server` / :func:`restart_server` /
+  :func:`server_down` stop a live ``_Server`` and bring up a successor
+  on the same port with the predecessor's state (store, updater, and
+  retry-dedup table), the single-process stand-in for a parameter
+  server recovering from its replica.
+
+Every injected fault is appended to ``FaultInjector.log`` as
+``(kind, op)`` so tests can assert the schedule actually fired.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import random
+import threading
+import time
+
+from .. import kvstore_dist as _kd
+
+__all__ = ["FaultInjector", "kill_server", "restart_server",
+           "server_down"]
+
+
+class FaultInjector:
+    """A seeded, FIFO fault plan over the client-side transport.
+
+    Directives (consumed one per ``_request`` send/recv attempt):
+
+    * ``("drop",)``        — swallow the outgoing frame; the client
+      blocks until its socket timeout, then retries (lost-packet path).
+    * ``("delay", s)``     — sleep ``s`` seconds before sending
+      (network stall / slow link).
+    * ``("sever",)``       — close the connection instead of sending
+      (peer reset mid-request; exercises reconnect).
+    * ``("truncate",)``    — send half a length header, then close
+      (connection dies mid-message; exercises the SERVER's half-frame
+      handling too).
+    * ``("drop_reply",)``  — let the request through, then discard the
+      reply and kill the connection (the apply-then-lose-the-ack case
+      that the server's sequence-number dedup exists for).
+    * ``("pass",)``        — no fault (filler for randomized plans).
+    """
+
+    def __init__(self, seed=0):
+        self.rng = random.Random(seed)
+        self.plan = collections.deque()
+        self.log = []          # (kind, op) per injected fault
+        self._depth = 0
+        self._lock = threading.Lock()
+
+    # -- plan construction --------------------------------------------
+    def random_faults(self, n, p_drop=0.0, p_sever=0.2, p_delay=0.0,
+                      delay_s=0.05):
+        """A deterministic (seeded) schedule of ``n`` directives, each
+        independently a drop/sever/delay with the given probabilities
+        (else a no-op). Returns the active context manager."""
+        plan = []
+        for _ in range(n):
+            r = self.rng.random()
+            if r < p_drop:
+                plan.append(("drop",))
+            elif r < p_drop + p_sever:
+                plan.append(("sever",))
+            elif r < p_drop + p_sever + p_delay:
+                plan.append(("delay", delay_s))
+            else:
+                plan.append(("pass",))
+        return self._scheduled(plan)
+
+    def drop_sends(self, n=1):
+        """Swallow the next ``n`` outgoing frames (timeout path)."""
+        return self._scheduled([("drop",)] * n)
+
+    def delay_sends(self, n=1, seconds=0.05):
+        """Stall the next ``n`` sends by ``seconds`` each."""
+        return self._scheduled([("delay", seconds)] * n)
+
+    def sever_connections(self, n=1):
+        """Close the connection instead of the next ``n`` sends."""
+        return self._scheduled([("sever",)] * n)
+
+    def close_mid_message(self, n=1):
+        """Send a truncated frame then close, ``n`` times."""
+        return self._scheduled([("truncate",)] * n)
+
+    def drop_replies(self, n=1):
+        """Lose the reply (after the server applied the request) for
+        the next ``n`` round trips."""
+        return self._scheduled([("drop_reply",)] * n)
+
+    @contextlib.contextmanager
+    def _scheduled(self, directives):
+        with self._lock:
+            self.plan.extend(directives)
+            if self._depth == 0:
+                self._prev = _kd._CLIENT_FAULTS
+                _kd._CLIENT_FAULTS = self
+            self._depth += 1
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._depth -= 1
+                if self._depth == 0:
+                    _kd._CLIENT_FAULTS = self._prev
+                    self.plan.clear()  # unconsumed faults die with scope
+
+    # -- hooks called by kvstore_dist._request ------------------------
+    def before_send(self, server, envelope, conn):
+        """Return False to suppress the real send (frame dropped)."""
+        with self._lock:
+            head = self.plan[0] if self.plan else None
+            if head is None or head[0] == "drop_reply":
+                return True  # drop_reply waits for after_recv
+            self.plan.popleft()
+        op = envelope[3][0]
+        kind = head[0]
+        if kind == "pass":
+            return True
+        self.log.append((kind, op))
+        if kind == "drop":
+            return False
+        if kind == "delay":
+            time.sleep(head[1])
+            return True
+        if kind == "sever":
+            conn.close()
+            raise ConnectionError("fault injection: connection severed "
+                                  "before send")
+        if kind == "truncate":
+            try:
+                conn.sendall(b"\x00\x00\x00\x00")  # half a length prefix
+            finally:
+                conn.close()
+            raise ConnectionError("fault injection: connection closed "
+                                  "mid-message")
+        raise AssertionError("unknown fault directive %r" % (head,))
+
+    def after_recv(self, server, envelope, reply, conn):
+        with self._lock:
+            head = self.plan[0] if self.plan else None
+            if head is None or head[0] != "drop_reply":
+                return
+            self.plan.popleft()
+        self.log.append(("drop_reply", envelope[3][0]))
+        conn.close()
+        raise ConnectionError("fault injection: reply lost")
+
+
+# -- server crash / recovery ------------------------------------------
+
+def kill_server(owner):
+    """Stop a live ``_Server`` (listener + every accepted connection),
+    as a crash would. ``owner`` is a ``PSBackend`` or a ``_Server``;
+    returns the dead server (its in-memory state survives for
+    :func:`restart_server`)."""
+    server = getattr(owner, "server", owner)
+    server.close()
+    return server
+
+
+def restart_server(owner, dead=None):
+    """Bring up a successor ``_Server`` on the dead one's port with its
+    whole state (store, updater, retry-dedup table, and the shared
+    lock/condition, so a predecessor handler still mid-apply publishes
+    where successor waiters can see it) — a parameter server recovering
+    from its replica. Rebinds ``owner.server`` when ``owner`` is a
+    ``PSBackend``. Returns the new server."""
+    old = dead if dead is not None else getattr(owner, "server", owner)
+    new = _kd._Server(old.rank, old.port, predecessor=old)
+    new.start()
+    if hasattr(owner, "server"):
+        owner.server = new
+    return new
+
+
+@contextlib.contextmanager
+def server_down(backend, restart_after=None):
+    """The backend's colocated server is DEAD inside the block.
+
+    With ``restart_after`` set, a timer restarts it that many seconds
+    in — so a client request issued inside the block retries against a
+    refused port and then succeeds against the successor, the
+    kill-and-recover scenario. Without it, the server stays down until
+    the block exits (then it is restarted)."""
+    dead = kill_server(backend)
+    restarted = threading.Event()
+
+    def _revive():
+        restart_server(backend, dead)
+        restarted.set()
+
+    timer = None
+    if restart_after is not None:
+        timer = threading.Timer(restart_after, _revive)
+        timer.daemon = True
+        timer.start()
+    try:
+        yield dead
+    finally:
+        if timer is not None:
+            timer.join()
+        if not restarted.is_set():
+            _revive()

@@ -25,8 +25,8 @@ if os.environ.get("MXNET_TPU_TEST_ON_TPU") != "1":
     jax.config.update("jax_platforms", "cpu")
 
 
-def pytest_configure(config):
-    config.addinivalue_line("markers", "slow: multi-process / long tests")
+# pytest markers ("slow", "faults") are registered once, in
+# pyproject.toml [tool.pytest.ini_options] — not duplicated here.
 
 
 def _needs_native(path, _cache={}):

@@ -154,11 +154,15 @@ def test_ps_dead_server_loud_error(monkeypatch):
     dead_port = probe.getsockname()[1]
     probe.close()
     monkeypatch.setenv("MXNET_KVSTORE_PORT_BASE", str(dead_port))
+    # bounded retry budget: the point here is the ERROR, not recovery
+    monkeypatch.setenv("MXNET_KVSTORE_MAX_RETRIES", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_BACKOFF_MS", "10")
 
     ps = PSBackend.__new__(PSBackend)  # skip __init__ (spawns a server)
     ps.rank, ps.nserv, ps.generation = 0, 1, 1
     ps.hosts = ["127.0.0.1"]
     ps._conns, ps._lock = {}, threading.Lock()
+    ps._client_id, ps._seq = "test-client", 0
     with pytest.raises(mx.base.MXNetError,
                        match="unreachable or died"):
         ps._request(0, ("pull", 1, 0))

@@ -235,12 +235,30 @@ def test_cpp_im2rec(tmp_path):
     cv2 = pytest.importorskip("cv2")
     from mxnet_tpu import recordio as rec
 
+    def _not_runnable(path):
+        """True when the committed binary cannot execute here: dynamic
+        loader exits 127 on unresolvable libs; a wrong-arch binary (or
+        a lost exec bit) raises OSError before it even starts."""
+        try:
+            return subprocess.run([path],
+                                  capture_output=True).returncode == 127
+        except OSError:
+            return True
+
     exe = os.path.join(ROOT, "cpp", "im2rec")
-    if not os.path.exists(exe):
+    if not os.path.exists(exe) or _not_runnable(exe):
+        # missing, or a stale binary from another environment: rebuild
+        # into the test's tmp dir (NOT the tracked path — a rebuild
+        # must not dirty the working tree)
+        exe = str(tmp_path / "im2rec")
         r = subprocess.run(["make", "-C", os.path.join(ROOT, "cpp"),
-                            "im2rec"], capture_output=True, text=True)
+                            "-B", "im2rec", "IM2REC_OUT=%s" % exe],
+                           capture_output=True, text=True)
         if r.returncode != 0:
             pytest.skip("cannot build im2rec: " + r.stderr[-300:])
+        if _not_runnable(exe):
+            pytest.skip("im2rec binary not runnable here (missing "
+                        "shared libraries)")
 
     imgdir = tmp_path / "imgs"
     imgdir.mkdir()

@@ -234,3 +234,122 @@ def test_fit_fused_multi_device_matches_single(monkeypatch):
     for n in single:
         np.testing.assert_allclose(multi[n], single[n], rtol=2e-4,
                                    atol=2e-5, err_msg=n)
+
+
+def test_save_checkpoint_cleans_stale_tmp(tmp_path):
+    """A `.params.tmp` corpse left by a writer that died before its
+    os.replace must not confuse (or survive) the next save — the new
+    checkpoint publishes atomically and the corpse is gone."""
+    import os
+
+    sym = _mlp_symbol()
+    shapes = {"data": (10, 20), "softmax_label": (10,)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    arg = {n: mx.nd.array(np.ones(s, np.float32))
+           for n, s in zip(sym.list_arguments(), arg_shapes)
+           if n not in shapes}
+    prefix = str(tmp_path / "cp")
+    stale = prefix + "-0001.params.tmp"
+    with open(stale, "wb") as f:
+        f.write(b"half-written garbage from a dead writer")
+    mx.model.save_checkpoint(prefix, 1, sym, arg, {})
+    assert not os.path.exists(stale)
+    # .tmp corpses are also invisible to checkpoint discovery
+    with open(prefix + "-0002.params.tmp", "wb") as f:
+        f.write(b"in-flight")
+    assert mx.model.latest_checkpoint(prefix) == 1
+    _, loaded, _ = mx.model.load_checkpoint(prefix, 1)
+    for n in arg:
+        np.testing.assert_array_equal(loaded[n].asnumpy(),
+                                      arg[n].asnumpy())
+
+
+def test_checkpoint_optimizer_states_roundtrip(tmp_path):
+    """save_checkpoint(optimizer_states=...) + load_optimizer_states
+    round-trips the full updater state: per-index arrays (momentum,
+    adam moments), structure (tuples stay tuples), and update counts."""
+    sym = _mlp_symbol()
+    shapes = {"data": (10, 20), "softmax_label": (10,)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    arg = {n: mx.nd.array(np.random.RandomState(0).randn(*s)
+                          .astype(np.float32))
+           for n, s in zip(sym.list_arguments(), arg_shapes)
+           if n not in shapes}
+
+    optimizer = mx.optimizer.create("adam", learning_rate=0.01)
+    updater = mx.optimizer.get_updater(optimizer)
+    for step in range(3):
+        for i, (n, w) in enumerate(sorted(arg.items())):
+            g = mx.nd.array(np.full(w.shape, 0.1, np.float32))
+            updater(i, g, w)
+    blob = updater.get_states()
+    blob["format"] = "updater"
+
+    prefix = str(tmp_path / "opt")
+    mx.model.save_checkpoint(prefix, 3, sym, arg, {},
+                             optimizer_states=blob)
+    loaded = mx.model.load_optimizer_states(prefix, 3)
+    assert loaded["format"] == "updater"
+    assert loaded["update_count"] == blob["update_count"]
+    assert loaded["num_update"] == blob["num_update"]
+    for i, st in blob["states"].items():
+        got = loaded["states"][i]
+        assert type(got) is type(st)
+        for a, b in zip(st, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # and a fresh updater restored from the blob continues identically
+    opt2 = mx.optimizer.create("adam", learning_rate=0.01)
+    up2 = mx.optimizer.get_updater(opt2)
+    up2.set_states(loaded)
+    w1 = {n: mx.nd.array(v.asnumpy()) for n, v in arg.items()}
+    for i, (n, w) in enumerate(sorted(arg.items())):
+        g = mx.nd.array(np.full(w.shape, 0.1, np.float32))
+        updater(i, g, w)
+        up2(i, g, w1[n])
+    for n in arg:
+        np.testing.assert_allclose(w1[n].asnumpy(), arg[n].asnumpy(),
+                                   rtol=0, atol=0, err_msg=n)
+
+
+def test_save_checkpoint_removes_stale_states(tmp_path):
+    """Re-checkpointing an epoch WITHOUT optimizer state must remove a
+    .states file left by a PREVIOUS process at that prefix/epoch
+    (otherwise a later resume pairs the new params with the old run's
+    momentum) — but must KEEP one this process published, which is
+    fit's own checkpoint branch running next to a states-less
+    do_checkpoint callback on the same prefix."""
+    import os
+    import pickle
+
+    sym = _mlp_symbol()
+    shapes = {"data": (10, 20), "softmax_label": (10,)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    arg = {n: mx.nd.array(np.ones(s, np.float32))
+           for n, s in zip(sym.list_arguments(), arg_shapes)
+           if n not in shapes}
+    prefix = str(tmp_path / "cp")
+    # a dead previous run's leftover (written outside save_checkpoint,
+    # like another process would have)
+    stale = prefix + "-0002.states"
+    with open(stale, "wb") as f:
+        pickle.dump({"format": "updater", "states": {},
+                     "update_count": {}, "num_update": 7}, f)
+    mx.model.save_checkpoint(prefix, 2, sym, arg, {})
+    assert not os.path.exists(stale)
+    assert mx.model.load_optimizer_states(prefix, 2) is None
+
+    # this process publishes states, then a states-less writer for the
+    # same epoch (the do_checkpoint-callback combo) must not remove them
+    blob = {"format": "updater", "states": {}, "update_count": {},
+            "num_update": 9}
+    mx.model.save_checkpoint(prefix, 3, sym, arg, {},
+                             optimizer_states=blob)
+    mx.model.save_checkpoint(prefix, 3, sym, arg, {})
+    assert mx.model.load_optimizer_states(prefix, 3)["num_update"] == 9
+
+    # a NEW fit run on the prefix (fit calls _forget_states_published)
+    # makes the old run's blob stale again, even in the same process
+    mx.model._forget_states_published(prefix)
+    mx.model.save_checkpoint(prefix, 3, sym, arg, {})
+    assert mx.model.load_optimizer_states(prefix, 3) is None
