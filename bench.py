@@ -277,6 +277,12 @@ def bench_decode(prompt=64, layers=12, embed=768,
                 if n not in shapes}
 
     params = init_params(sym)
+    # (max_len - prompt) // 2 // 64 * 64 silently floors to 0 when the
+    # prompt nearly fills max_len, and measure() then returns None for
+    # every arm — misconfiguration must fail loudly instead
+    assert max_len - prompt >= 128, (
+        "bench_decode: max_len (%d) must exceed prompt (%d) by >= 128 "
+        "tokens to leave a measurable decode chain" % (max_len, prompt))
     steps_short = (max_len - prompt) // 2 // 64 * 64  # 448 at 1024
     steps_long = max_len                              # 1024 at L4096
 
@@ -404,6 +410,70 @@ def bench_recordio_io():
     return modes, contended
 
 
+def bench_resnet50_from_records(batch=128, workers=2, n_imgs=512):
+    """End-to-end ResNet-50 training fed from packed 480x360 JPEG
+    records through the FULL parallel pipeline (the ISSUE 2 tentpole):
+    num_workers decode pool (uint8 device-augment batches collated in
+    shared memory) → DeviceAugmentIter (crop/flip/normalize on-chip) →
+    staged_batches (batch i+1's h2d dispatched under step i) → fused
+    train step. The number includes real decode, so it is input-bound
+    on this container (2 cores shared with the jax runtime threads) —
+    compare against recordio_io's exclusive-subprocess decode rates and
+    the device-resident resnet50_b256 compute ceiling."""
+    import tempfile
+
+    import cv2
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu import recordio as rec
+    from mxnet_tpu.models import get_resnet
+
+    tmpd = tempfile.mkdtemp(prefix="benchrec_e2e")
+    path = os.path.join(tmpd, "e2e.rec")
+    rng = np.random.RandomState(0)
+    w = rec.MXRecordIO(path, "w")
+    base = (rng.rand(24, 32, 3) * 255).astype(np.uint8)
+    img = cv2.resize(base, (480, 360), interpolation=cv2.INTER_CUBIC)
+    img = cv2.add(img, rng.randint(0, 12, img.shape).astype(np.uint8))
+    for i in range(n_imgs):
+        w.write(rec.pack_img(rec.IRHeader(0, float(i % 1000), i, 0), img,
+                             quality=85))
+    w.close()
+
+    sym = get_resnet(num_classes=1000, num_layers=50)
+    trainer = par.ParallelTrainer(
+        sym, {"data": (batch, 3, 224, 224), "softmax_label": (batch,)},
+        optimizer="sgd", mesh=par.data_parallel_mesh(1),
+        compute_dtype="bfloat16",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    trainer.init_params()
+
+    it = mx.ImageRecordIter(path, (3, 256, 256), batch_size=batch,
+                            resize=256, device_augment=True,
+                            shuffle=True, seed=0, num_workers=workers)
+    dev = mx.DeviceAugmentIter(it, crop_shape=(224, 224), rand_crop=True,
+                               rand_mirror=True, scale=1.0 / 255)
+    staged = trainer.staged_batches(dev, ["data"], ["softmax_label"])
+
+    def epoch_pass():
+        staged.reset()
+        outs, nb = None, 0
+        for _, dev_batch in staged:
+            outs = trainer.step(dev_batch)
+            nb += 1
+        np.asarray(outs[0][(0,) * outs[0].ndim])  # force completion
+        return nb
+
+    try:
+        epoch_pass()  # warmup: pool spin-up, compile, page cache
+        tic = time.perf_counter()
+        nb = epoch_pass() + epoch_pass()
+        dt = time.perf_counter() - tic
+    finally:
+        it.close()
+    return batch * nb / dt
+
+
 def bench_gemm_calibration(steps=8):
     """This chip's PRACTICAL compute ceiling through the relay: chained
     dependent 8192^3 bf16 GEMMs (the best program the chip can run).
@@ -453,6 +523,40 @@ def bench_gemm_calibration(steps=8):
     return 2.0 * n * n * n / sec
 
 
+def _io_pipeline_extra(io_modes, e2e_rec):
+    """BENCH_extra block for the num_workers decode pool: the clean-
+    subprocess img/s-vs-worker-count sweep (tools/bench_io.py) plus the
+    end-to-end from-records ResNet-50 number."""
+    pipe = (io_modes or {}).get("io_pipeline")
+    out = {
+        "resnet50_from_records_img_per_sec":
+            None if e2e_rec is None else round(e2e_rec, 1),
+        "e2e_note": "decode pool (2 workers, u8 shm batches) -> "
+                    "DeviceAugmentIter (on-chip augment) -> staged h2d "
+                    "-> fused step; in-process, so decode contends "
+                    "with the jax runtime threads on this container's "
+                    "2 cores",
+    }
+    if pipe:
+        workers = {k: round(v, 1) for k, v in pipe.items()
+                   if k[0] == "w" and "_" not in k}
+        out["img_per_sec_by_workers"] = workers
+        out["serial_py_img_per_sec"] = round(pipe.get("serial_py", 0), 1)
+        out["u8_device_augment"] = {
+            k: round(v, 1) for k, v in pipe.items() if k.endswith("_u8")}
+        out["ncpu"] = pipe.get("ncpu")
+        if "w4" in workers and "w1" in workers and workers["w1"]:
+            out["speedup_w4_vs_w1"] = round(workers["w4"] / workers["w1"],
+                                            2)
+        out["caveat"] = (
+            "clean-subprocess measurement (no jax threads), same "
+            "discipline as recordio_io; scaling is core-bound — this "
+            "container exposes %s CPUs, so the worker curve saturates "
+            "there and the >=3x-at-4-workers figure needs a >=4-core "
+            "host" % pipe.get("ncpu"))
+    return out
+
+
 def main():
     ceiling = bench_gemm_calibration()
     peak = _peak_flops(__import__("jax").devices()[0])
@@ -493,6 +597,11 @@ def main():
               if v and k.endswith("_b8")]
         return min(b8) if b8 else None
     io_modes, io_contended = bench_recordio_io()
+    try:
+        e2e_rec = bench_resnet50_from_records()
+    except Exception:
+        traceback.print_exc()
+        e2e_rec = None
 
     def vs_ceiling(nominal_mfu):
         if ceiling is None:
@@ -570,6 +679,7 @@ def main():
                                  "above",
             "modes": io_modes,
         },
+        "io_pipeline": _io_pipeline_extra(io_modes, e2e_rec),
     }
     # The driver records only the LAST ~2,000 chars of stdout and parses
     # the final JSON line; round 4's single fat line pushed the headline
@@ -600,6 +710,11 @@ def main():
             "io_img_per_sec":
                 None if io_modes is None
                 else round(io_modes.get("jpeg_scaled", 0), 1),
+            "io_pipeline_w4":
+                None if not (io_modes or {}).get("io_pipeline")
+                else round(io_modes["io_pipeline"].get("w4", 0), 1),
+            "resnet50_from_records":
+                None if e2e_rec is None else round(e2e_rec, 1),
             "gemm_calib_tflops":
                 None if ceiling is None else round(ceiling / 1e12, 1),
             "detail": "BENCH_extra.json",

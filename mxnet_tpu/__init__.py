@@ -58,6 +58,7 @@ from .parallel import ParallelTrainer  # noqa: E402
 from . import recordio  # noqa: E402
 from . import image_io  # noqa: E402
 from .image_io import ImageRecordIter, DeviceAugmentIter  # noqa: E402
+from .io import DevicePrefetchIter  # noqa: E402
 from . import distributed  # noqa: E402
 from . import visualization  # noqa: E402
 # reference short aliases (/root/reference/python/mxnet/__init__.py):

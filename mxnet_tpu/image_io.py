@@ -11,6 +11,10 @@ fallback (cv2-based) keeps unbuilt trees working.
 from __future__ import annotations
 
 import ctypes
+import os
+import queue as _queue
+import threading
+import time as _time
 
 import numpy as np
 
@@ -22,6 +26,51 @@ from . import recordio as rec
 
 __all__ = ["ImageRecordIter", "device_augment_batch",
            "DeviceAugmentIter"]
+
+
+_U64 = (1 << 64) - 1
+
+
+class _LightRNG:
+    """Tiny per-record RNG (splitmix64) for the augmentation draws.
+
+    Constructing a numpy RandomState per record costs ~0.2-0.35 ms —
+    a fifth of the whole 1.5 ms/img decode budget — where this is ~1 µs.
+    Only the two draw kinds the augmenters use exist (numpy-convention
+    ``randint`` with exclusive high, ``uniform``); numpy distribution
+    parity is NOT required because BOTH engines draw from this stream —
+    which is exactly what the byte-identity guarantee rests on."""
+
+    __slots__ = ("_s",)
+
+    def __init__(self, state):
+        self._s = state & _U64
+
+    def _next(self):
+        self._s = (self._s + 0x9E3779B97F4A7C15) & _U64
+        z = self._s
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _U64
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _U64
+        return z ^ (z >> 31)
+
+    def randint(self, low, high=None):
+        if high is None:
+            low, high = 0, low
+        return low + self._next() % (high - low)
+
+    def uniform(self, low, high):
+        return low + (high - low) * (self._next() / float(1 << 64))
+
+
+def _record_rng(seed, epoch, pos):
+    """Per-record RNG for the augmentation draws (crop/mirror/rotate/HSL),
+    keyed by (seed, epoch, position-in-epoch) instead of a sequential
+    stream — so record ``pos``'s augmentation is the same no matter
+    which worker decodes it (or whether any pool exists at all): the
+    foundation of the num_workers byte-identical guarantee."""
+    return _LightRNG((seed & 0xffffffff) * 0x9E3779B97F4A7C15
+                     + (epoch & 0xffffffff) * 0xBF58476D1CE4E5B9
+                     + pos * 0x94D049BB133111EB)
 
 
 def device_augment_batch(data_u8, key=None, crop_shape=None,
@@ -83,6 +132,17 @@ class ImageRecordIter(DataIter):
     crop/flip/normalize — 4x less infeed traffic).
     ``scaled_decode=False`` disables the reduced-DCT JPEG decode
     shortcut (on by default; exact no-op whenever no reduction fits).
+    ``num_workers=N`` (default ``MXNET_IO_NUM_WORKERS``, 0) fans decode
+    over N pool workers — forked processes by default
+    (``worker_mode='thread'`` for debugging), each collating finished
+    batches into shared memory with ``queue_depth`` batches buffered
+    per worker. Epoch contents are byte-identical to the serial engine
+    for any worker count under a fixed seed, a worker crash raises
+    instead of hanging, and batches are served from reused slot
+    buffers (consume or copy before the next iteration — the same
+    contract as ``iter_numpy``). ``path_imgidx`` names the
+    MXIndexedRecordIO sidecar so startup reads offsets from the index
+    instead of scanning the record file. See doc/io_pipeline.md.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
@@ -92,7 +152,9 @@ class ImageRecordIter(DataIter):
                  prefetch_buffer=4, round_batch=True, data_name="data",
                  label_name="softmax_label", mean_img=None,
                  max_rotate_angle=0, random_h=0, random_s=0, random_l=0,
-                 device_augment=False, scaled_decode=True):
+                 device_augment=False, scaled_decode=True,
+                 num_workers=None, worker_mode=None, queue_depth=None,
+                 path_imgidx=None):
         super().__init__()
         if len(data_shape) != 3:
             raise MXNetError("data_shape must be (channels, height, width)")
@@ -110,13 +172,22 @@ class ImageRecordIter(DataIter):
         # compiled step via ``device_augment_batch``. rand_crop /
         # rand_mirror / mean / scale become the DEVICE stage's job.
         self._device_augment = bool(device_augment)
+        if num_workers is None:
+            num_workers = int(os.environ.get("MXNET_IO_NUM_WORKERS",
+                                             "0") or 0)
+        if worker_mode is None:
+            worker_mode = os.environ.get("MXNET_IO_WORKER_MODE",
+                                         "process")
+        self._num_workers = int(num_workers)
 
         # mean-image subtraction (reference iter_normalize.h: load the
         # cached mean file, computing + saving it on first use) and the
         # rotate/HSL augmenters (image_augmenter.h) live in the Python
-        # engine; requesting them routes past the native decoder.
+        # engine; requesting them — or the ``num_workers`` decode pool,
+        # whose workers ARE the parallelism the native engine gets from
+        # its OMP threads — routes past the native decoder.
         extended = (mean_img is not None or max_rotate_angle or random_h
-                    or random_s or random_l)
+                    or random_s or random_l or self._num_workers > 0)
         self._lib = None if extended else get_lib()
         if self._lib is not None:
             self.handle = ctypes.c_void_p()
@@ -147,18 +218,25 @@ class ImageRecordIter(DataIter):
                                        dtype=np.float32)
         else:
             self.handle = None
-            self._py = _PyEngine(path_imgrec, self._data_shape, batch_size,
-                                 label_width, (mean_r, mean_g, mean_b), scale,
-                                 resize,
-                                 rand_crop and not device_augment,
-                                 rand_mirror and not device_augment, shuffle,
-                                 seed, num_parts, part_index, round_batch,
-                                 mean_img=mean_img,
-                                 max_rotate_angle=max_rotate_angle,
-                                 random_h=random_h, random_s=random_s,
-                                 random_l=random_l,
-                                 out_uint8=device_augment,
-                                 scaled_decode=scaled_decode)
+            kwargs = dict(mean_img=mean_img,
+                          max_rotate_angle=max_rotate_angle,
+                          random_h=random_h, random_s=random_s,
+                          random_l=random_l,
+                          out_uint8=device_augment,
+                          scaled_decode=scaled_decode,
+                          path_imgidx=path_imgidx)
+            args = (path_imgrec, self._data_shape, batch_size,
+                    label_width, (mean_r, mean_g, mean_b), scale, resize,
+                    rand_crop and not device_augment,
+                    rand_mirror and not device_augment, shuffle,
+                    seed, num_parts, part_index, round_batch)
+            if self._num_workers > 0:
+                self._py = _ParallelEngine(
+                    *args, num_workers=self._num_workers,
+                    worker_mode=worker_mode, queue_depth=queue_depth,
+                    **kwargs)
+            else:
+                self._py = _PyEngine(*args, **kwargs)
 
     @property
     def provide_data(self):
@@ -210,13 +288,24 @@ class ImageRecordIter(DataIter):
                 return False
             self._pad = pad
             data, label = self._buf_data, self._buf_label
+            reused = True
         else:
             got = self._py.next()
             if got is None:
                 return False
             data, label, self._pad = got
+            reused = getattr(self._py, "reuses_buffers", False)
         if self._label_width == 1:
             label = label.reshape(self.batch_size)
+        if reused:
+            # the DataBatch protocol hands out long-lived arrays, but
+            # jnp.asarray can alias page-aligned host memory ZERO-COPY
+            # on the cpu backend — wrapping a reused decode buffer
+            # (native double buffer, pool shm slot) uncopied would let
+            # later batches mutate earlier ones under the consumer.
+            # iter_numpy stays zero-copy with its documented contract.
+            data = np.array(data)
+            label = np.array(label)
         self._data = nd.array(data)
         self._label = nd.array(label)
         return True
@@ -248,22 +337,39 @@ class ImageRecordIter(DataIter):
     def getpad(self):
         return self._pad
 
-    def __del__(self):
+    def close(self):
+        """Release the native handle / shut down the decode-worker pool
+        (joined and reaped — no stray processes). Idempotent; also runs
+        from ``__del__``."""
         if getattr(self, "_lib", None) is not None and self.handle:
             try:
                 self._lib.MXTImRecIterFree(self.handle)
             except Exception:
                 pass
+            self.handle = None
+        py = getattr(self, "_py", None)
+        if py is not None and hasattr(py, "close"):
+            py.close()
+
+    def __del__(self):
+        self.close()
 
 
 class _PyEngine:
-    """cv2-based fallback with identical semantics (single-threaded)."""
+    """cv2-based fallback with identical semantics (single-threaded).
+
+    Also the decode kernel of the ``num_workers`` pool: each pool worker
+    constructs one of these with pre-sharded ``offsets`` (and the
+    parent's ``mean_arr``) and drives ``load_batch`` directly — the
+    per-record RNG (``_record_rng``) makes any batch reproducible from
+    (seed, epoch, batch index) alone, with no sequential state."""
 
     def __init__(self, path, data_shape, batch_size, label_width, means,
                  scale, resize, rand_crop, rand_mirror, shuffle, seed,
                  num_parts, part_index, round_batch, mean_img=None,
                  max_rotate_angle=0, random_h=0, random_s=0, random_l=0,
-                 out_uint8=False, scaled_decode=True):
+                 out_uint8=False, scaled_decode=True, path_imgidx=None,
+                 offsets=None, mean_arr=None):
         import cv2  # noqa: F401  (validates availability early)
         self.out_uint8 = out_uint8
         self.scaled_decode = scaled_decode
@@ -283,25 +389,23 @@ class _PyEngine:
         self.random_h = random_h
         self.random_s = random_s
         self.random_l = random_l
-        self.mean_arr = None
+        self.mean_arr = mean_arr
         self._mean_img_path = mean_img
         self.part_index = part_index
-        # scan offsets once
-        reader = rec.MXRecordIO(path, "r")
-        offsets = []
-        while True:
-            pos = reader.tell()
-            if reader.read() is None:
-                break
-            offsets.append(pos)
-        reader.close()
-        self._all_offsets = offsets  # every record (mean-img is global)
-        self.offsets = offsets[part_index::num_parts]
+        if offsets is not None:
+            # pool worker: the parent already scanned and sharded
+            self._all_offsets = list(offsets)
+            self.offsets = list(offsets)
+        else:
+            # offsets once, via the .idx sidecar when one exists
+            all_offsets = rec.list_record_offsets(path, path_imgidx)
+            self._all_offsets = all_offsets  # mean-img is global
+            self.offsets = all_offsets[part_index::num_parts]
         if not self.offsets:
             raise MXNetError("empty shard")
         self.epoch = 0
         self.reset()
-        if mean_img is not None:
+        if mean_img is not None and mean_arr is None:
             self._setup_mean_img(mean_img)
 
     def _setup_mean_img(self, path):
@@ -344,8 +448,9 @@ class _PyEngine:
         # silently train on inconsistently normalized data
         total = np.zeros(self.data_shape, np.float64)
         count = 0
+        dummy_rng = _record_rng(0, 0, 0)  # augmentation is off: no draws
         for off in self._all_offsets:
-            img, _ = self._load(off)
+            img, _ = self._load(off, dummy_rng)
             total += img
             count += 1
         self.mean_arr = (total / max(count, 1)).astype(np.float32)
@@ -363,14 +468,29 @@ class _PyEngine:
         self.epoch -= 1
         self.reset()
 
-    def reset(self):
-        self.order = list(self.offsets)
+    def order_for(self, epoch):
+        """Epoch ``epoch``'s record order: the shard's offsets, shuffled
+        under the (seed, epoch) stream. Pure function of its arguments —
+        the pool workers and the consumer derive identical orders from
+        the epoch number alone."""
+        order = list(self.offsets)
         if self.shuffle:
-            rng = np.random.RandomState((self.seed << 10) + self.epoch)
-            rng.shuffle(self.order)
+            rng = np.random.RandomState(
+                ((self.seed << 10) + epoch) & 0xffffffff)
+            rng.shuffle(order)
+        return order
+
+    def num_batches(self):
+        """Batches per epoch (the final partial batch is served padded
+        under round_batch, dropped otherwise)."""
+        full, rem = divmod(len(self.offsets), self.batch_size)
+        return full + (1 if rem and self.round_batch else 0)
+
+    def reset(self):
+        self.cur_epoch = self.epoch
+        self.order = self.order_for(self.cur_epoch)
         self.cursor = 0
         self.epoch += 1
-        self.rng = np.random.RandomState(self.seed + 7919 * self.epoch)
         self.reader = rec.MXRecordIO(self.path, "r")
 
     def _header_label(self, header):
@@ -445,7 +565,7 @@ class _PyEngine:
                 break
         return rec.unpack_img(raw, iscolor)
 
-    def _load(self, offset):
+    def _load(self, offset, rng):
         import cv2
         self.reader.seek(offset)
         raw = self.reader.read()
@@ -459,18 +579,18 @@ class _PyEngine:
             img = cv2.resize(img, (max(img.shape[1], w),
                                    max(img.shape[0], h)))
         if self.rand_crop:
-            y0 = self.rng.randint(0, img.shape[0] - h + 1)
-            x0 = self.rng.randint(0, img.shape[1] - w + 1)
+            y0 = rng.randint(0, img.shape[0] - h + 1)
+            x0 = rng.randint(0, img.shape[1] - w + 1)
         else:
             y0 = (img.shape[0] - h) // 2
             x0 = (img.shape[1] - w) // 2
         img = img[y0:y0 + h, x0:x0 + w]
-        if self.rand_mirror and self.rng.randint(2):
+        if self.rand_mirror and rng.randint(2):
             img = img[:, ::-1]
         if self.max_rotate_angle:
             # works for 2-D grayscale and 3-D color alike
-            angle = self.rng.uniform(-self.max_rotate_angle,
-                                     self.max_rotate_angle)
+            angle = rng.uniform(-self.max_rotate_angle,
+                                self.max_rotate_angle)
             m = cv2.getRotationMatrix2D((w / 2.0, h / 2.0), angle, 1.0)
             img = cv2.warpAffine(np.ascontiguousarray(img), m, (w, h),
                                  borderMode=cv2.BORDER_REFLECT)
@@ -480,9 +600,9 @@ class _PyEngine:
             # noise per channel in HLS space
             hls = cv2.cvtColor(np.ascontiguousarray(img), cv2.COLOR_RGB2HLS)
             hls = hls.astype(np.float32)
-            hls[..., 0] += self.rng.uniform(-self.random_h, self.random_h)
-            hls[..., 1] += self.rng.uniform(-self.random_l, self.random_l)
-            hls[..., 2] += self.rng.uniform(-self.random_s, self.random_s)
+            hls[..., 0] += rng.uniform(-self.random_h, self.random_h)
+            hls[..., 1] += rng.uniform(-self.random_l, self.random_l)
+            hls[..., 2] += rng.uniform(-self.random_s, self.random_s)
             hls[..., 0] %= 180.0
             img = cv2.cvtColor(np.clip(hls, 0, 255).astype(np.uint8),
                                cv2.COLOR_HLS2RGB)
@@ -500,6 +620,35 @@ class _PyEngine:
             out = (out - self.means[:c]) * self.scale
         return out.transpose(2, 0, 1), self._header_label(header)
 
+    def batch_buffers(self):
+        """Freshly allocated (data, label) arrays of one batch's shape —
+        also the slot layout of the worker pool's shared-memory rings."""
+        c, h, w = self.data_shape
+        if self.out_uint8:
+            data = np.zeros((self.batch_size, h, w, c), np.uint8)
+        else:
+            data = np.zeros((self.batch_size, c, h, w), np.float32)
+        label = np.zeros((self.batch_size, self.label_width), np.float32)
+        return data, label
+
+    def load_batch(self, order, epoch, b, data=None, label=None):
+        """Decode epoch ``epoch``'s batch ``b`` of ``order`` into
+        (data, label, pad) — into the caller's buffers when given (the
+        pool's shm slots). Stateless apart from the record reader, so
+        any worker can produce any batch."""
+        n = len(order)
+        start = b * self.batch_size
+        count = min(self.batch_size, n - start)
+        if data is None:
+            data, label = self.batch_buffers()
+        for s in range(self.batch_size):
+            pos = start + s
+            idx = pos % n  # round-over padding
+            data[s], label[s] = self._load(order[idx],
+                                           _record_rng(self.seed, epoch,
+                                                       pos))
+        return data, label, self.batch_size - count
+
     def next(self):
         n = len(self.order)
         if self.cursor >= n:
@@ -507,18 +656,305 @@ class _PyEngine:
         count = min(self.batch_size, n - self.cursor)
         if not self.round_batch and count < self.batch_size:
             return None
-        c, h, w = self.data_shape
-        if self.out_uint8:
-            data = np.zeros((self.batch_size, h, w, c), np.uint8)
-        else:
-            data = np.zeros((self.batch_size, c, h, w), np.float32)
-        label = np.zeros((self.batch_size, self.label_width), np.float32)
-        for s in range(self.batch_size):
-            idx = (self.cursor + s) % n  # round-over padding
-            data[s], label[s] = self._load(self.order[idx])
-        pad = self.batch_size - count
+        out = self.load_batch(self.order, self.cur_epoch,
+                              self.cursor // self.batch_size)
         self.cursor += self.batch_size
+        return out
+
+    def close(self):
+        reader = getattr(self, "reader", None)
+        if reader is not None:
+            reader.close()
+
+
+def _shared_batch_buffers(template, nslots, shared):
+    """``nslots`` (data, label) slot pairs shaped like one batch. With
+    ``shared`` they live in anonymous MAP_SHARED mmaps created BEFORE
+    the fork, so decode workers collate straight into memory the
+    consumer reads — the batch itself never crosses a pipe, only a
+    (epoch, batch, slot, pad) tuple does."""
+    import mmap
+
+    slots = []
+    for _ in range(nslots):
+        data, label = template.batch_buffers()
+        if shared:
+            pair = []
+            for a in (data, label):
+                buf = mmap.mmap(-1, max(a.nbytes, 1))
+                pair.append(np.frombuffer(buf, dtype=a.dtype)
+                            .reshape(a.shape))
+            slots.append(tuple(pair))
+        else:
+            slots.append((data, label))
+    return slots
+
+
+def _decode_worker_main(cfg, mean_arr, wid, num_workers, ctl_q, out_q,
+                        gen, slots, own_process=True):
+    """Decode-worker entry point (forked process, or thread in
+    worker_mode='thread'): wait for an epoch command, decode this
+    worker's round-robin share of the epoch's batches (batch b goes to
+    worker b % num_workers) into the shared slot ring, and announce each
+    as a tiny (epoch, batch_idx, slot, pad) tuple on the bounded queue.
+    A bumped ``gen`` aborts a stale epoch between batches (reset
+    mid-epoch); any exception is reported on the queue — loudly — and
+    ends the worker."""
+    try:
+        if own_process:
+            # the pool IS the parallelism; nested cv2 threads would
+            # oversubscribe the cores. Forked workers only — in thread
+            # mode this global would degrade the PARENT's cv2 too.
+            try:
+                import cv2
+                cv2.setNumThreads(0)
+            except Exception:
+                pass
+        eng = _PyEngine(mean_arr=mean_arr, **cfg)
+        while True:
+            cmd = ctl_q.get()
+            if cmd[0] == "quit":
+                return
+            epoch = cmd[1]
+            order = eng.order_for(epoch)
+            produced = 0
+            for b in range(wid, eng.num_batches(), num_workers):
+                if gen.value != epoch:
+                    break  # epoch superseded by a reset
+                data, label = slots[produced % len(slots)]
+                _, _, pad = eng.load_batch(order, epoch, b, data, label)
+                out_q.put((epoch, b, produced % len(slots), pad))
+                produced += 1
+    except BaseException:
+        import traceback
+        try:
+            out_q.put(("error", traceback.format_exc()))
+        except Exception:
+            pass
+
+
+class _ParallelEngine:
+    """Multi-worker decode pool behind the ``_PyEngine`` interface.
+
+    The epoch's batch list is dealt round-robin across ``num_workers``
+    decode workers (forked processes by default — JPEG decode +
+    augment is CPU-bound Python/cv2 work; ``worker_mode='thread'``
+    keeps everything in-process for debugging). Each worker runs
+    read→decode→augment→collate straight into its shared-memory slot
+    ring and announces finished batches on a bounded queue
+    (``queue_depth`` per worker); the consumer pops worker ``b % W``
+    for batch b, so epoch order is deterministic by construction and
+    byte-identical to the serial engine (same per-record RNG, same
+    per-epoch shuffle).
+
+    Lifecycle: ``reset()`` bumps the shared epoch generation — workers
+    abort a stale epoch at the next batch boundary and pick up the new
+    epoch command; in-flight stale batches are discarded by tag.
+    A worker death (exception OR hard crash) raises MXNetError at the
+    consumer instead of hanging the queue. ``close()`` shuts the pool
+    down and reaps every worker process.
+    """
+
+    #: batches are views of the slot rings — ImageRecordIter.iter_next
+    #: copies before wrapping them in long-lived DataBatch arrays
+    reuses_buffers = True
+
+    def __init__(self, path, data_shape, batch_size, label_width, means,
+                 scale, resize, rand_crop, rand_mirror, shuffle, seed,
+                 num_parts, part_index, round_batch, mean_img=None,
+                 max_rotate_angle=0, random_h=0, random_s=0, random_l=0,
+                 out_uint8=False, scaled_decode=True, path_imgidx=None,
+                 num_workers=1, worker_mode="process", queue_depth=None):
+        if queue_depth is None:
+            queue_depth = int(os.environ.get("MXNET_IO_QUEUE_DEPTH",
+                                             "4") or 4)
+        self.num_workers = int(num_workers)
+        self.queue_depth = max(1, int(queue_depth))
+        if worker_mode not in ("process", "thread"):
+            raise MXNetError("worker_mode must be 'process' or 'thread', "
+                             "got %r" % (worker_mode,))
+        # the template engine scans offsets (via the .idx sidecar when
+        # given), validates the config, and computes/loads the mean
+        # image ONCE in the parent — workers inherit the result
+        self._template = _PyEngine(
+            path, data_shape, batch_size, label_width, means, scale,
+            resize, rand_crop, rand_mirror, shuffle, seed, num_parts,
+            part_index, round_batch, mean_img=mean_img,
+            max_rotate_angle=max_rotate_angle, random_h=random_h,
+            random_s=random_s, random_l=random_l, out_uint8=out_uint8,
+            scaled_decode=scaled_decode, path_imgidx=path_imgidx)
+        self._template.close()  # the parent never decodes
+        self.batch_size = batch_size
+        self._nb = self._template.num_batches()
+        self._timeout = float(os.environ.get("MXNET_IO_WORKER_TIMEOUT",
+                                             "300") or 300)
+
+        use_proc = worker_mode == "process"
+        if use_proc:
+            import multiprocessing as mp
+            try:
+                ctx = mp.get_context("fork")
+            except ValueError:  # no fork on this platform
+                ctx = None
+                use_proc = False
+        self._is_proc = use_proc
+
+        # worker config: pre-sharded offsets, parent's mean, no
+        # mean_img (the parent already resolved it)
+        cfg = dict(path=path, data_shape=data_shape,
+                   batch_size=batch_size, label_width=label_width,
+                   means=tuple(np.asarray(means, np.float32)),
+                   scale=scale, resize=resize, rand_crop=rand_crop,
+                   rand_mirror=rand_mirror, shuffle=shuffle, seed=seed,
+                   num_parts=1, part_index=0, round_batch=round_batch,
+                   max_rotate_angle=max_rotate_angle, random_h=random_h,
+                   random_s=random_s, random_l=random_l,
+                   out_uint8=out_uint8, scaled_decode=scaled_decode,
+                   offsets=self._template.offsets)
+
+        nslots = self.queue_depth + 2  # queue_depth announced + 1 the
+        # consumer is viewing + 1 being written never collide
+        self._slots, self._ctl, self._out, self._workers = [], [], [], []
+        if use_proc:
+            self._gen = ctx.Value("l", 0)
+        else:
+            class _Gen:
+                value = 0
+            self._gen = _Gen()
+        for wid in range(self.num_workers):
+            slots = _shared_batch_buffers(self._template, nslots,
+                                          shared=use_proc)
+            if use_proc:
+                ctl, out = ctx.Queue(), ctx.Queue(maxsize=self.queue_depth)
+                make = ctx.Process
+            else:
+                ctl, out = _queue.Queue(), \
+                    _queue.Queue(maxsize=self.queue_depth)
+                make = threading.Thread
+            w = make(target=_decode_worker_main,
+                     args=(cfg, self._template.mean_arr, wid,
+                           self.num_workers, ctl, out, self._gen, slots,
+                           use_proc),
+                     daemon=True, name="mx-decode-%d" % wid)
+            self._slots.append(slots)
+            self._ctl.append(ctl)
+            self._out.append(out)
+            self._workers.append(w)
+            import warnings
+            with warnings.catch_warnings():
+                # jax warns that os.fork() from its (multithreaded)
+                # process can deadlock; the decode workers never touch
+                # jax — they fork straight into cv2/numpy work, the
+                # standard DataLoader-style arrangement
+                warnings.filterwarnings(
+                    "ignore", message=r".*os\.fork\(\).*",
+                    category=RuntimeWarning)
+                w.start()
+        self._closed = False
+        self.epoch = 0
+        self.reset()
+
+    # -- _PyEngine interface ------------------------------------------
+    @property
+    def offsets(self):
+        return self._template.offsets
+
+    @property
+    def mean_arr(self):
+        return self._template.mean_arr
+
+    def reset(self):
+        """Start the next epoch: bump the generation (workers abort any
+        stale epoch at their next batch boundary) and enqueue the epoch
+        command. Stale in-flight batches are discarded by tag in
+        ``next`` — never served."""
+        if self._closed:
+            raise MXNetError("ImageRecordIter worker pool is closed")
+        self.cur_epoch = self.epoch
+        self.epoch += 1
+        self._gen.value = self.cur_epoch
+        for ctl in self._ctl:
+            ctl.put(("epoch", self.cur_epoch))
+        self._next_b = 0
+
+    def _pop(self, wid):
+        """Next announcement from worker ``wid``'s queue, discarding
+        stale-epoch leftovers; raises on worker failure, death, or
+        timeout instead of hanging."""
+        deadline = _time.time() + self._timeout
+        while True:
+            try:
+                item = self._out[wid].get(timeout=0.2)
+            except _queue.Empty:
+                if not self._workers[wid].is_alive():
+                    self.close()
+                    raise MXNetError(
+                        "decode worker %d died (killed or crashed "
+                        "without a traceback) — batch %d will never "
+                        "arrive" % (wid, self._next_b))
+                if _time.time() > deadline:
+                    self.close()
+                    raise MXNetError(
+                        "decode worker %d produced nothing for %.0f s "
+                        "(MXNET_IO_WORKER_TIMEOUT)"
+                        % (wid, self._timeout))
+                continue
+            if item[0] == "error":
+                self.close()
+                raise MXNetError("decode worker %d failed:\n%s"
+                                 % (wid, item[1]))
+            if item[0] != self.cur_epoch:
+                continue  # leftover from before a reset
+            return item
+
+    def next(self):
+        if self._next_b >= self._nb:
+            return None
+        b = self._next_b
+        wid = b % self.num_workers
+        epoch, got_b, slot, pad = self._pop(wid)
+        if got_b != b:  # pragma: no cover — protocol invariant
+            self.close()
+            raise MXNetError(
+                "decode pool out of order: expected batch %d from "
+                "worker %d, got %d" % (b, wid, got_b))
+        self._next_b += 1
+        data, label = self._slots[wid][slot]
         return data, label, pad
+
+    def close(self):
+        """Shut the pool down: abort in-flight epochs, drain queues so
+        blocked workers can exit, and reap every process."""
+        if getattr(self, "_closed", True):
+            return
+        self._closed = True
+        self._gen.value = -1
+        for ctl in self._ctl:
+            try:
+                ctl.put(("quit",))
+            except Exception:
+                pass
+        deadline = _time.time() + 5.0
+        for wid, w in enumerate(self._workers):
+            while w.is_alive() and _time.time() < deadline:
+                try:  # unblock a worker stuck in a full-queue put
+                    self._out[wid].get_nowait()
+                except _queue.Empty:
+                    pass
+                w.join(timeout=0.05)
+            if self._is_proc and w.is_alive():
+                w.terminate()
+                w.join(timeout=1.0)
+        if self._is_proc:
+            for q in self._ctl + self._out:
+                q.cancel_join_thread()
+                q.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class DeviceAugmentIter(DataIter):

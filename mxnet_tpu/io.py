@@ -26,7 +26,7 @@ from . import ndarray as nd
 from .ndarray import NDArray
 
 __all__ = ["MXDataIter", "DataIter", "DataBatch", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MNISTIter", "CSVIter"]
+           "PrefetchingIter", "DevicePrefetchIter", "MNISTIter", "CSVIter"]
 
 
 class DataBatch:
@@ -239,54 +239,125 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
-class _PipelineWorker(threading.Thread):
-    """Depth-1 producer for one iterator: a request/response channel.
+class _WorkerFailure:
+    """An exception that escaped a pipeline worker, carried through the
+    response queue so the consumer can re-raise it loudly (a silently
+    dead worker would otherwise hang the consumer on an empty queue)."""
 
-    The consumer keeps exactly one fetch request outstanding, so the
-    wrapped iterator's host-side work (decode, augment, collate) runs
-    while the previous batch is being consumed.
+    def __init__(self, exc):
+        import traceback
+        self.exc = exc
+        self.tb = traceback.format_exc()
+
+
+class _PipelineWorker(threading.Thread):
+    """Depth-k producer for one iterator: a request/response channel.
+
+    The consumer keeps up to ``depth`` fetch requests outstanding, so
+    the wrapped iterator's host-side work (decode, augment, collate —
+    plus an optional ``transform``, e.g. the device-staging
+    ``jax.device_put``) runs while previous batches are being consumed.
+    This is the shared queue/lifecycle machinery behind
+    ``PrefetchingIter`` (depth 1, host pipelining) and
+    ``DevicePrefetchIter`` (depth k, host→device staging).
     """
 
-    _FETCH, _QUIT = object(), object()
+    _FETCH, _RESTART, _QUIT = object(), object(), object()
 
-    def __init__(self, it):
+    def __init__(self, it, depth=1, transform=None):
         super().__init__(daemon=True)
         self._it = it
+        self._transform = transform
+        self._depth = max(1, int(depth))
         self._requests = queue.Queue()   # unbounded: posting never blocks
         self._results = queue.Queue()
-        self._pending = True             # a fetch is requested/in flight
+        self._inflight = self._depth     # fetches requested/in flight
+        self._ended = False              # consumer saw the epoch end
         self.start()
-        self._requests.put(self._FETCH)  # pipeline primed at construction
+        for _ in range(self._depth):     # pipeline primed at construction
+            self._requests.put(self._FETCH)
 
     def run(self):
-        while self._requests.get() is not self._QUIT:
+        exhausted = False  # latched at epoch end: with depth > 1 there
+        # are still outstanding fetch requests when StopIteration first
+        # fires, and they must NOT touch the iterator again (NDArrayIter
+        # roll_over cursors would advance twice)
+        while True:
+            req = self._requests.get()
+            if req is self._QUIT:
+                return
+            if req is self._RESTART:
+                exhausted = False
+                continue
+            if exhausted:
+                self._results.put(None)
+                continue
             try:
                 batch = self._it.next()
+                if self._transform is not None:
+                    batch = self._transform(batch)
             except StopIteration:
+                exhausted = True
                 batch = None             # epoch-boundary marker
+            except BaseException as e:   # surfaced, never a hung queue
+                exhausted = True
+                batch = _WorkerFailure(e)
             self._results.put(batch)
 
     def take(self):
-        """Collect the in-flight batch and post the next request — but
-        NOT past an epoch end: after None the wrapped iterator must not
-        be touched again until restart(), or iterators with carry-over
-        state (NDArrayIter roll_over cursors) would advance twice."""
-        if not self._pending:
+        """Collect the oldest in-flight batch and post the next request —
+        but NOT past an epoch end: after None the wrapped iterator must
+        not be touched again until restart()."""
+        if self._ended:
             return None                  # exhausted, awaiting restart()
         batch = self._results.get()
+        if isinstance(batch, _WorkerFailure):
+            self._ended = True
+            self._absorb()
+            raise MXNetError("data pipeline worker failed:\n%s"
+                             % batch.tb) from batch.exc
         if batch is None:
-            self._pending = False
+            self._ended = True
+            # later in-flight results are all None (the run loop latches
+            # at the first StopIteration); absorb them now
+            self._absorb()
         else:
             self._requests.put(self._FETCH)
         return batch
 
+    def _absorb(self, first=None):
+        """Drain in-flight responses down to zero (epoch end / restart);
+        the caller has already taken one of them (``first``). Returns
+        the first _WorkerFailure seen, if any — a failure must not be
+        silently discarded by a reset racing it."""
+        failure = first if isinstance(first, _WorkerFailure) else None
+        drained = 1
+        while drained < self._inflight:
+            got = self._results.get()
+            if failure is None and isinstance(got, _WorkerFailure):
+                failure = got
+            drained += 1
+        self._inflight = 0
+        return failure
+
     def restart(self):
-        """Absorb any in-flight fetch, rewind the iterator, re-prime."""
-        if self._pending:
-            self._results.get()
+        """Absorb in-flight fetches, rewind the iterator, re-prime. A
+        worker failure sitting unconsumed in the response queue is
+        re-raised here rather than swallowed."""
+        failure = None
+        if not self._ended:
+            failure = self._absorb(self._results.get())
+        # the worker is now idle (every request it will ever see has
+        # been answered), so resetting from this thread cannot race it
         self._it.reset()
-        self._pending = True
-        self._requests.put(self._FETCH)
+        self._requests.put(self._RESTART)
+        self._ended = False
+        self._inflight = self._depth
+        for _ in range(self._depth):
+            self._requests.put(self._FETCH)
+        if failure is not None:
+            raise MXNetError("data pipeline worker failed:\n%s"
+                             % failure.tb) from failure.exc
 
     def stop(self):
         self._requests.put(self._QUIT)
@@ -365,6 +436,106 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+def _stage_nd(arr, sharding):
+    """One array to a device/sharding, as an NDArray (async dispatch).
+    Module-level so the staging transform does not capture the iterator
+    (see DevicePrefetchIter.__init__)."""
+    import jax
+
+    ctx = None
+    if isinstance(arr, NDArray):
+        ctx = arr.context
+        arr = arr._val
+    return NDArray._from_jax(jax.device_put(arr, sharding), ctx)
+
+
+class DevicePrefetchIter(DataIter):
+    """Overlapped host→device staging over any DataIter: a pipeline
+    thread pulls batch i+1 from ``base`` and ``jax.device_put``s it
+    (async dispatch) while batch i is being consumed by the train step —
+    the device half of the reference's ``iter_prefetcher.h`` double
+    buffer, with the h2d copy itself moved off the consumer thread.
+
+    ``depth`` batches are kept in flight (2 = classic double buffer).
+    ``sharding`` places each array for the multi-chip path: pass a
+    ``jax.sharding.Sharding`` directly, or ``mesh=`` (with
+    ``data_axis``, default ``"dp"``) to shard dim 0 — the batch axis —
+    across the mesh the way ``ParallelTrainer`` expects its inputs.
+    Default: committed to the first local device.
+
+    Composes on either side of ``DeviceAugmentIter``: wrap the augment
+    iterator and its uint8 h2d + on-device augment both run on the
+    pipeline thread, overlapped with compute. Pad and index propagate
+    through unchanged.
+    """
+
+    def __init__(self, base, depth=2, sharding=None, mesh=None,
+                 data_axis="dp"):
+        import jax
+
+        super().__init__()
+        self._base = base
+        self.batch_size = base.batch_size
+        if sharding is None and mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            sharding = NamedSharding(mesh, PartitionSpec(data_axis))
+        if sharding is None:
+            sharding = jax.devices()[0]
+        self._sharding = sharding
+        self._current = None
+
+        def stage(batch, _sh=sharding):
+            # closes over the sharding only, NOT self: the pipeline
+            # thread holds this transform, and a self-reference would
+            # root the iterator forever — __del__ could never fire and
+            # every dropped iterator would leak its thread (and any
+            # decode pool underneath) until process exit
+            return DataBatch([_stage_nd(d, _sh) for d in batch.data],
+                             [_stage_nd(l, _sh) for l in batch.label],
+                             batch.pad, batch.index)
+
+        self._worker = _PipelineWorker(base, depth=depth, transform=stage)
+
+    def close(self):
+        """Stop the pipeline thread (also run by ``__del__``; the
+        thread itself is a daemon, so this is for promptness, not
+        correctness)."""
+        w = getattr(self, "_worker", None)
+        if w is not None:
+            w.stop()
+
+    def __del__(self):
+        self.close()
+
+    @property
+    def provide_data(self):
+        return self._base.provide_data
+
+    @property
+    def provide_label(self):
+        return self._base.provide_label
+
+    def reset(self):
+        self._worker.restart()
+
+    def iter_next(self):
+        batch = self._worker.take()
+        self._current = batch
+        return batch is not None
+
+    def getdata(self):
+        return self._current.data
+
+    def getlabel(self):
+        return self._current.label
+
+    def getindex(self):
+        return self._current.index
+
+    def getpad(self):
+        return self._current.pad
 
 
 def _read_idx_images(path):

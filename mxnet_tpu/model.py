@@ -429,17 +429,21 @@ def _train_fused(symbol, ctx, arg_params, aux_params, begin_epoch,
         for k, v in xp.items():
             v.copyto(aux_params[k])
 
-    train_data.reset()
+    # staged stream: the consumer thread never blocks on the h2d edge —
+    # batch i+1 is device_put (async, sharded over dp) while step i
+    # runs; with ImageRecordIter(num_workers=N) upstream, decode too is
+    # off this thread (in the pool workers), the reference's threaded
+    # parser + prefetcher stack end to end
+    staged = trainer.staged_batches(train_data, data_names, label_names)
+    staged.reset()
     for epoch in range(begin_epoch, end_epoch):
         tic = time.time()
         eval_metric.reset()
         nbatch = 0
         while True:
             do_reset = True
-            for data_batch in train_data:
-                batch = dict(zip(data_names, data_batch.data))
-                batch.update(zip(label_names, data_batch.label))
-                outs = trainer.step(batch)
+            for data_batch, dev_batch in staged:
+                outs = trainer.step(dev_batch)
                 out_nds = [nd.array(np.asarray(o)) for o in outs]
                 eval_metric.update(data_batch.label, out_nds)
                 nbatch += 1
@@ -454,7 +458,7 @@ def _train_fused(symbol, ctx, arg_params, aux_params, begin_epoch,
                     break
             if do_reset:
                 logger.info("Epoch[%d] Resetting Data Iterator", epoch)
-                train_data.reset()
+                staged.reset()
             if epoch_size is None or nbatch >= epoch_size:
                 break
         toc = time.time()

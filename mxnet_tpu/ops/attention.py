@@ -301,6 +301,12 @@ class MultiHeadAttention(OpSpec):
         impl = p["impl"]
         window = p.get("window", 0)
         if window:
+            # mirror infer_shape's validation: forward can run without
+            # shape inference (direct bind), and a negative window on
+            # the dense path would mask EVERY key — NaN softmax rows
+            if window < 1:
+                raise MXNetError("MultiHeadAttention: window must be "
+                                 ">= 1 (0 disables), got %d" % window)
             if not p["causal"]:
                 raise MXNetError("MultiHeadAttention: window>0 is "
                                  "defined for causal attention only")

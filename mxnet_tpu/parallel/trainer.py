@@ -19,6 +19,7 @@ multi-device loop, bit-for-bit modulo reduction order.
 """
 from __future__ import annotations
 
+import collections
 import functools
 import logging
 import os
@@ -47,6 +48,58 @@ def _as_jnp(v):
     if isinstance(v, NDArray):
         return v._val
     return jnp.asarray(v)
+
+
+class _StagedStream:
+    """Depth-k host→device staging over a DataIter for the fused train
+    loops: batch i+1 is pulled from the iterator and its ``device_put``
+    (async dispatch, sharded over the data axis) runs while batch i's
+    step executes — so the step stream never blocks on the h2d edge.
+    Yields ``(data_batch, device_batch)`` pairs; iteration ends at the
+    iterator's epoch end like the iterator itself would, and batches
+    staged before an ``epoch_size`` break are served when iteration
+    resumes (none are dropped). ``reset()`` forwards to the iterator
+    and discards now-stale staged batches."""
+
+    def __init__(self, trainer, data, data_names, label_names, depth=2):
+        self._trainer = trainer
+        self._data = data
+        self._names = (list(data_names), list(label_names))
+        self._depth = max(1, int(depth))
+        self._queue = collections.deque()
+        self._exhausted = False
+
+    def reset(self):
+        self._queue.clear()  # staged before the reset: stale
+        self._data.reset()
+        self._exhausted = False
+
+    def _place(self, dbatch):
+        data_names, label_names = self._names
+        batch = dict(zip(data_names, dbatch.data))
+        batch.update(zip(label_names, dbatch.label))
+        return dbatch, self._trainer._stage_batch(batch, "staged fit")
+
+    def _fill(self):
+        while not self._exhausted and len(self._queue) < self._depth:
+            try:
+                dbatch = self._data.next()
+            except StopIteration:
+                self._exhausted = True
+                return
+            self._queue.append(self._place(dbatch))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self._fill()
+        if not self._queue:
+            self._exhausted = False  # re-arm: caller resets + re-iterates
+            raise StopIteration
+        out = self._queue.popleft()
+        self._fill()  # dispatch i+1's transfer before handing back i
+        return out
 
 
 class ParallelTrainer:
@@ -177,8 +230,14 @@ class ParallelTrainer:
             from jax.sharding import NamedSharding
             dp = self.mesh.shape["dp"]
             for n in self.param_names:
-                if self._param_sh[n].spec not in (P(), None):
+                spec = self._param_sh[n].spec
+                if spec is not None and any(ax is not None
+                                            for ax in spec):
                     continue  # a tp/custom rule already shards this param
+                # all-None specs (e.g. P(None, None) when a tp rule
+                # didn't fit the mesh) are replicated in effect and
+                # still get the 1/dp treatment — only a spec that
+                # actually names a mesh axis opts a param out
                 shape = self.arg_shapes[n]
                 ax = next((i for i, d in enumerate(shape)
                            if d % dp == 0 and d >= dp), None)
@@ -449,30 +508,48 @@ class ParallelTrainer:
         import collections
         depth = max(1, int(depth))
 
-        def place(batch):
-            # EAGER placement: _shard_batch leaves plain numpy untouched
-            # in single-process mode (deferring h2d to jit dispatch),
-            # which would make prefetching a no-op — force the transfer
-            # to start now
-            out = self._shard_batch(batch, "prefetch")
-            return {k: (v if isinstance(v, jax.Array)
-                        else jax.device_put(v, self._data_sh[k]))
-                    for k, v in out.items()}
-
         queue = collections.deque()
         it = iter(batches)
         try:
             for _ in range(depth):
-                queue.append(place(next(it)))
+                queue.append(self._stage_batch(next(it), "prefetch"))
         except StopIteration:
             pass
         while queue:
             ready = queue.popleft()
             try:
-                queue.append(place(next(it)))
+                queue.append(self._stage_batch(next(it), "prefetch"))
             except StopIteration:
                 pass
             yield ready
+
+    def _stage_batch(self, batch, what):
+        """``_shard_batch`` + EAGER device placement — the one staging
+        primitive behind :meth:`prefetch` and ``_StagedStream``.
+        ``_shard_batch`` leaves plain numpy untouched in single-process
+        mode (deferring h2d to jit dispatch), which would make staging
+        a no-op — force the transfer to start now. Except on the cpu
+        backend: there is no transfer to overlap there, and the
+        per-batch dispatch is pure overhead (the CI path), so jit
+        places lazily."""
+        out = self._shard_batch(batch, what)
+        if jax.default_backend() == "cpu":
+            return out
+        return {k: (v if isinstance(v, jax.Array)
+                    else jax.device_put(v, self._data_sh[k]))
+                for k, v in out.items()}
+
+    def staged_batches(self, data, data_names, label_names, depth=2):
+        """Overlapped host→device staging of a DataIter for a train
+        loop: returns a ``_StagedStream`` yielding ``(data_batch,
+        device_batch)`` with batch i+1's transfer dispatched while i is
+        consumed. Used by :meth:`fit` and ``FeedForward.fit``'s fused
+        path; compose with ``ImageRecordIter(num_workers=N)`` so decode
+        happens in pool workers and the h2d edge overlaps compute —
+        the whole reference prefetcher stack (iter_prefetcher.h), TPU
+        style."""
+        return _StagedStream(self, data, data_names, label_names,
+                             depth=depth)
 
     def _shard_batch(self, batch, what):
         """Place batch arrays onto the mesh (the h2d infeed edge).
@@ -490,11 +567,27 @@ class ParallelTrainer:
                 if isinstance(v, NDArray):
                     v = v._val
                 if multiproc:
-                    out[k] = jax.make_array_from_process_local_data(
-                        self._data_sh[k], np.asarray(v))
+                    if isinstance(v, jax.Array):
+                        # already a GLOBAL array (a staged/prefetched
+                        # batch went through this very branch once) —
+                        # np.asarray on it would try to fetch
+                        # non-addressable shards and throw
+                        out[k] = v
+                    else:
+                        out[k] = jax.make_array_from_process_local_data(
+                            self._data_sh[k], np.asarray(v))
                 elif isinstance(v, jax.Array):
-                    # committed arrays must be resharded explicitly
-                    out[k] = jax.device_put(v, self._data_sh[k])
+                    # committed arrays must be resharded explicitly —
+                    # unless already laid out right (a staged/prefetched
+                    # batch): re-dispatching a device_put per step would
+                    # tax the path staging exists to clear
+                    try:
+                        placed = v.sharding.is_equivalent_to(
+                            self._data_sh[k], v.ndim)
+                    except Exception:
+                        placed = False
+                    out[k] = v if placed \
+                        else jax.device_put(v, self._data_sh[k])
                 else:
                     # hand numpy straight to jit — in_shardings places it
                     # during dispatch, cheaper than an eager device_put
@@ -730,15 +823,17 @@ class ParallelTrainer:
                 dm_kind, dm_k)
 
         self.last_train_metric = None
+        # staged stream: batch i+1's h2d transfer is dispatched while
+        # step i runs — with ImageRecordIter(num_workers=N) upstream,
+        # decode is in pool workers and this loop never blocks on input
+        staged = self.staged_batches(train_data, data_names, label_names)
         for epoch in range(num_epoch):
-            train_data.reset()
+            staged.reset()
             eval_metric.reset()
             acc_state = _zero_state() if device_metric else None
             tic = time.time()
-            for nbatch, dbatch in enumerate(train_data):
-                batch = dict(zip(data_names, dbatch.data))
-                batch.update(zip(label_names, dbatch.label))
-                outs = self.step(batch)
+            for nbatch, (dbatch, dev_batch) in enumerate(staged):
+                outs = self.step(dev_batch)
                 if device_metric:
                     if dm_kind == "loss":
                         # label unused by the accumulator — works for

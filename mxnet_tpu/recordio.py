@@ -20,7 +20,7 @@ from .base import MXNetError
 from .libinfo import get_lib, check_call
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
-           "pack_img", "unpack_img"]
+           "pack_img", "unpack_img", "list_record_offsets"]
 
 _MAGIC = 0xced7230a
 
@@ -232,6 +232,69 @@ class MXIndexedRecordIO(MXRecordIO):
         self.idx[key] = self.tell()
         self.keys.append(key)
         self.write(buf)
+
+
+def list_record_offsets(uri, idx_path=None):
+    """Byte offsets of every record in a RecordIO file, in file order.
+
+    The decode-worker pool shards these offsets into batches
+    (image_io._ParallelEngine); each worker then random-accesses its own
+    records via ``seek``. When the ``MXIndexedRecordIO`` sidecar is
+    named (``idx_path``) and exists, the offsets come from it directly —
+    O(keys) text read instead of decoding every record frame; otherwise
+    the container is scanned once.
+    """
+    if idx_path is not None and os.path.isfile(idx_path):
+        offsets = []
+        try:
+            with open(idx_path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue  # trailing newline etc.
+                    parts = line.strip().split("\t")
+                    if len(parts) != 2:
+                        raise ValueError("malformed index line")
+                    offsets.append(int(parts[1]))
+        except ValueError:
+            # malformed line (a writer died mid-line): fails the sanity
+            # check below, taking the same warn-and-scan degrade path a
+            # stale sidecar does
+            offsets = [-1]
+        # index files follow write order, but sort defensively: the
+        # epoch order must be the file order the scan would produce.
+        # A stale/truncated sidecar (rec regenerated, old idx left
+        # behind, offset digits cut short) would silently shrink or
+        # mis-map the epoch — cheap sanity checks make that loud and
+        # fall back to the scan. The magic probe at the LAST offset
+        # catches numerically-plausible corruption (a truncated offset
+        # still in bounds) without decoding anything.
+        offsets = sorted(offsets)
+        size = os.path.getsize(uri)
+        if offsets:
+            ok = (offsets[0] == 0 and offsets[-1] < size
+                  and all(b > a for a, b in zip(offsets, offsets[1:])))
+            if ok:
+                with open(uri, "rb") as f:
+                    f.seek(offsets[-1])
+                    ok = f.read(4) == struct.pack("<I", _MAGIC)
+            if ok:
+                return offsets
+            import logging
+            logging.warning(
+                "list_record_offsets: index %s does not fit %s "
+                "(stale/truncated sidecar?) — falling back to a full "
+                "scan", idx_path, uri)
+    reader = MXRecordIO(uri, "r")
+    offsets = []
+    try:
+        while True:
+            pos = reader.tell()
+            if reader.read() is None:
+                break
+            offsets.append(pos)
+    finally:
+        reader.close()
+    return offsets
 
 
 # ---------------------------------------------------------------------------
