@@ -348,6 +348,111 @@ def bench_decode(prompt=64, layers=12, embed=768,
     return arms
 
 
+def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
+                  max_len=1024, n_requests=96, seed=0, arrival_ms=1.0):
+    """Continuous-batching serving engine (mxnet_tpu/serving/) under
+    SATURATING load: Poisson arrivals far above service capacity (the
+    queue never empties), mixed prompt lengths across the bucket set
+    and mixed output budgets — the ISSUE 3 headline. Same 124M LM as
+    bench_decode, so ``tokens_per_sec`` reads directly against the
+    static ``full_b8`` arm: the static decoder serves b=8 rectangular
+    batches that stall on their slowest member, the engine keeps
+    ``slots`` sequences resident and refills each slot the moment it
+    frees (iteration-level scheduling).
+
+    Exactly TWO compiled program families run the whole workload (one
+    fused decode step + one prefill per used bucket) — asserted here,
+    not just documented. Latency is reported as per-token DECODE
+    cadence per request, (t_done - t_first)/(n_tokens - 1): the p99
+    tail is what co-residency costs a request, independent of queue
+    wait (which saturating arrivals make unbounded by construction).
+
+    Returns {"tokens_per_sec", "p50_ms_per_token", "p99_ms_per_token",
+    "slots", "requests", "tokens", "compile_programs"}.
+    """
+    import jax.numpy as jnp
+    from mxnet_tpu.models import get_transformer_lm
+    from mxnet_tpu.parallel import Decoder
+    from mxnet_tpu.serving import InferenceEngine
+
+    sym = get_transformer_lm(vocab, num_layers=layers, embed_dim=embed,
+                             num_heads=heads, impl="flash")
+    rng = np.random.RandomState(seed)
+    shapes = {"data": (8, max_len), "softmax_label": (8, max_len)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    params = {n: jnp.asarray(rng.uniform(-0.05, 0.05, sh)
+                             .astype(np.float32))
+              for n, sh in zip(sym.list_arguments(), arg_shapes)
+              if n not in shapes}
+    buckets = (64, 128, 256)
+    dec = Decoder(sym, params, max_len=max_len,
+                  compute_dtype="bfloat16", cache_block=None)
+
+    def workload(n, rs):
+        """(prompt, max_tokens) mix: prompts spread over the bucket
+        set, output budgets 32..160 — deliberately ragged so static
+        batching's stall-on-slowest cost is visible."""
+        out = []
+        for _ in range(n):
+            p = int(rs.choice([24, 48, 96, 120, 200, 256]))
+            t = int(rs.choice([32, 64, 96, 160]))
+            out.append((rs.randint(0, vocab, (p,)), t))
+        return out
+
+    def run(n, rs, engine):
+        reqs = workload(n, rs)
+        # Poisson arrivals, mean interarrival ``arrival_ms``: the 1 ms
+        # default is far above service capacity, so the queue never
+        # empties (saturating regime — the headline criterion);
+        # tools/bench_serving.py sweeps slower rates for the
+        # latency-vs-load curve
+        arrivals = np.cumsum(rs.exponential(arrival_ms * 1e-3, size=n))
+        t0 = time.perf_counter()
+        handles, i = [], 0
+        while i < len(reqs) or not engine.idle:
+            now = time.perf_counter() - t0
+            while i < len(reqs) and arrivals[i] <= now \
+                    and engine.queued() < engine.max_queue:
+                prompt, mt = reqs[i]
+                handles.append(engine.submit(prompt, max_tokens=mt))
+                i += 1
+            engine.step()
+        dt = time.perf_counter() - t0
+        toks = sum(len(h.tokens) for h in handles)
+        tpot = [(h.t_done - h.t_first) / (len(h.tokens) - 1) * 1e3
+                for h in handles if len(h.tokens) > 1]
+        return toks, dt, tpot
+
+    # steps_per_round=8: each dispatched round decodes 8 tokens per
+    # slot inside one lax.scan program, amortizing the relay's
+    # multi-ms per-dispatch overhead (which would otherwise rival the
+    # ~2-5 ms device step and cap the engine below the static arm)
+    engine = InferenceEngine(dec, slots=slots, prefill_buckets=buckets,
+                             max_queue=4 * slots, steps_per_round=8)
+    # warmup compiles BOTH program families for every bucket up front
+    # (one prompt per bucket), so the timed run measures execution only
+    wrs = np.random.RandomState(seed + 1)
+    for b in buckets:
+        engine.submit(wrs.randint(0, vocab, (b - 8,)), max_tokens=8)
+    engine.serve_forever()
+    toks, dt, tpot = run(n_requests, np.random.RandomState(seed + 2),
+                         engine)
+    cc = engine.compile_counts
+    programs = cc["decode"] + sum(cc["prefill"].values())
+    assert cc["decode"] == 1 and all(v == 1
+                                     for v in cc["prefill"].values()), \
+        "compile-count contract violated: %r" % (cc,)
+    return {
+        "tokens_per_sec": round(toks / dt, 0),
+        "p50_ms_per_token": round(float(np.percentile(tpot, 50)), 3),
+        "p99_ms_per_token": round(float(np.percentile(tpot, 99)), 3),
+        "slots": slots,
+        "requests": n_requests,
+        "tokens": toks,
+        "compile_programs": programs,
+    }
+
+
 def bench_recordio_io():
     """C++ ImageRecordIOIter: run tools/bench_io.py in a CLEAN
     subprocess (no jax): on this 1-core container the jax/axon runtime
@@ -590,6 +695,11 @@ def main():
     except Exception:
         traceback.print_exc()
         dec_arms = None
+    try:
+        serving = bench_serving()
+    except Exception:
+        traceback.print_exc()
+        serving = None
     def _dec_best_ms():
         if not dec_arms:
             return None
@@ -634,6 +744,20 @@ def main():
                     "prefix-bounded online-softmax reads "
                     "(cache_block=128); batch sweep on the faster "
                     "variant",
+        },
+        "serving_124M_continuous_batching": None if serving is None else {
+            **serving,
+            "static_full_b8_tokens_per_sec":
+                None if not dec_arms or not dec_arms.get("full_b8")
+                else dec_arms["full_b8"]["tokens_per_sec"],
+            "note": "slot-paged continuous batching (mxnet_tpu/serving) "
+                    "at saturating Poisson load, mixed prompt/output "
+                    "lengths; compare tokens_per_sec against the static "
+                    "full_b8 decode arm (same 124M LM, bf16) — the "
+                    "ISSUE 3 criterion; latency = per-request decode "
+                    "cadence (t_done-t_first)/(n-1), p50/p99 across "
+                    "requests; tools/bench_serving.py sweeps slots and "
+                    "arrival rates",
         },
         "calibration": {
             "gemm_8192_bf16_tflops":
@@ -703,6 +827,10 @@ def main():
             "lm_mfu_nominal":
                 None if lm_mfu is None else round(lm_mfu, 3),
             "decode_b8_ms_per_token": _dec_best_ms(),
+            "serving_tokens_per_sec":
+                None if serving is None else serving["tokens_per_sec"],
+            "serving_p99_ms":
+                None if serving is None else serving["p99_ms_per_token"],
             "cifar10_img_per_sec":
                 None if cifar is None else round(cifar, 1),
             "cifar10_vs_gtx980":

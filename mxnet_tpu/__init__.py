@@ -72,5 +72,7 @@ from . import torch  # noqa: E402
 from . import torch as th  # noqa: E402
 from . import predict  # noqa: E402
 from .predict import Predictor  # noqa: E402
+from . import serving  # noqa: E402
+from .serving import InferenceEngine  # noqa: E402
 
 __version__ = "0.1.0"

@@ -13,6 +13,7 @@ way the reference's dmlc::ThreadedIter prefetcher does
 """
 from __future__ import annotations
 
+import collections
 import gzip
 import os
 import struct
@@ -26,7 +27,8 @@ from . import ndarray as nd
 from .ndarray import NDArray
 
 __all__ = ["MXDataIter", "DataIter", "DataBatch", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "DevicePrefetchIter", "MNISTIter", "CSVIter"]
+           "PrefetchingIter", "DevicePrefetchIter", "StagedStream",
+           "MNISTIter", "CSVIter"]
 
 
 class DataBatch:
@@ -438,6 +440,118 @@ class PrefetchingIter(DataIter):
         return self.current_batch.pad
 
 
+class StagedStream:
+    """THE depth-k staging helper: pull items from a source, run
+    ``place`` on each (typically an async-dispatching
+    ``jax.device_put``, so the transfer proceeds while earlier items
+    are consumed), and keep up to ``depth`` placed items ready ahead
+    of the consumer.
+
+    One implementation behind three consumers (PR 2 recorded the first
+    two as separate copies — debt paid here):
+
+    * ``ParallelTrainer.staged_batches`` — fused train loops
+      (``thread=False``),
+    * ``DevicePrefetchIter`` — DataIter protocol over a pipeline
+      thread (``thread=True``),
+    * the serving engine's prompt stager
+      (``mxnet_tpu/serving/engine.py`` — padded prompt h2d dispatched
+      while decode steps run).
+
+    ``source``: an object with ``.next()`` raising ``StopIteration``
+    at the end and ``.reset()`` (any DataIter qualifies; small
+    adapters suffice elsewhere).
+
+    ``thread=False`` (default): items are pulled and placed inline
+    when the consumer asks for the NEXT item — overlap comes purely
+    from async dispatch, so the source itself must be cheap (host
+    batches already in memory). Iteration ends at source exhaustion
+    and then RE-ARMS (a new for-loop resumes); items staged before a
+    consumer ``break`` are served on resume, never dropped.
+
+    ``thread=True``: pulls + placement run on a ``_PipelineWorker``
+    pipeline thread — for sources that do real host work (decode
+    pools, augmentation). After exhaustion ``next()`` keeps raising
+    ``StopIteration`` until ``reset()`` (DataIter epoch semantics);
+    failures inside the threaded pull surface as ``MXNetError``.
+
+    ``live_source=True`` (inline mode only): the source may GAIN items
+    at any time (the serving engine's pending queue), so exhaustion is
+    never latched — every fill re-probes the source, and a ``next()``
+    right after new items arrive stages them immediately. The default
+    (False) latches until the staged queue drains, which DataIter
+    epoch semantics require: an exhausted epoch iterator must not be
+    pulled again mid-drain (NDArrayIter roll_over cursors would
+    advance twice).
+    """
+
+    def __init__(self, source, place=None, depth=2, thread=False,
+                 live_source=False):
+        self._source = source
+        self._placefn = place if place is not None else (lambda x: x)
+        self._depth = max(1, int(depth))
+        self._live = bool(live_source)
+        self._threaded = bool(thread)
+        if self._threaded:
+            self._worker = _PipelineWorker(source, depth=self._depth,
+                                           transform=self._placefn)
+        else:
+            self._queue = collections.deque()
+            self._exhausted = False
+
+    # -- consumer side --------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        if self._threaded:
+            got = self._worker.take()
+            if got is None:
+                raise StopIteration
+            return got
+        self._fill()
+        if not self._queue:
+            self._exhausted = False  # re-arm: caller resets + re-iterates
+            raise StopIteration
+        out = self._queue.popleft()
+        self._fill()  # dispatch i+1's placement before handing back i
+        return out
+
+    def staged(self):
+        """Items pulled from the source and staged but not yet handed
+        to the consumer (inline mode; the threaded pipeline keeps its
+        own in-flight accounting)."""
+        return 0 if self._threaded else len(self._queue)
+
+    def _fill(self):
+        while not self._exhausted and len(self._queue) < self._depth:
+            try:
+                item = self._source.next()
+            except StopIteration:
+                if not self._live:
+                    self._exhausted = True
+                return
+            self._queue.append(self._placefn(item))
+
+    # -- lifecycle ------------------------------------------------------
+    def reset(self):
+        """Discard staged items (stale after a source rewind) and
+        rewind the source."""
+        if self._threaded:
+            self._worker.restart()   # absorbs in-flight + resets source
+            return
+        self._queue.clear()
+        self._source.reset()
+        self._exhausted = False
+
+    def close(self):
+        if self._threaded:
+            self._worker.stop()
+
+
 def _stage_nd(arr, sharding):
     """One array to a device/sharding, as an NDArray (async dispatch).
     Module-level so the staging transform does not capture the iterator
@@ -496,15 +610,17 @@ class DevicePrefetchIter(DataIter):
                              [_stage_nd(l, _sh) for l in batch.label],
                              batch.pad, batch.index)
 
-        self._worker = _PipelineWorker(base, depth=depth, transform=stage)
+        self._stream = StagedStream(base, place=stage, depth=depth,
+                                    thread=True)
+        self._worker = self._stream._worker  # the pipeline Thread
 
     def close(self):
         """Stop the pipeline thread (also run by ``__del__``; the
         thread itself is a daemon, so this is for promptness, not
         correctness)."""
-        w = getattr(self, "_worker", None)
-        if w is not None:
-            w.stop()
+        s = getattr(self, "_stream", None)
+        if s is not None:
+            s.close()
 
     def __del__(self):
         self.close()
@@ -518,10 +634,13 @@ class DevicePrefetchIter(DataIter):
         return self._base.provide_label
 
     def reset(self):
-        self._worker.restart()
+        self._stream.reset()
 
     def iter_next(self):
-        batch = self._worker.take()
+        try:
+            batch = self._stream.next()
+        except StopIteration:
+            batch = None
         self._current = batch
         return batch is not None
 

@@ -1071,6 +1071,41 @@ class FeedForward(BASE_ESTIMATOR):
         save_checkpoint(prefix, epoch, self.symbol, self.arg_params,
                         self.aux_params)
 
+    def as_serving_engine(self, max_len, slots=8, prefill_buckets=None,
+                          max_queue=256, steps_per_round=1,
+                          **decoder_kwargs):
+        """Trained estimator → continuous-batching inference engine
+        (``mxnet_tpu.serving.InferenceEngine``, doc/serving.md): the
+        online-serving analogue of :meth:`predict`. Works on a fitted
+        model or one built from ``FeedForward.load`` — the same
+        checkpoint-to-engine path ``InferenceEngine.from_checkpoint``
+        takes, minus the file round-trip. ``decoder_kwargs`` reach the
+        underlying ``Decoder`` (``compute_dtype``, ``cache_dtype``,
+        ...)."""
+        from .parallel.decode import Decoder
+        from .serving import InferenceEngine
+
+        if self.symbol is None or not self.arg_params:
+            raise MXNetError(
+                "as_serving_engine needs a trained model: fit() it, "
+                "pass arg_params, or use FeedForward.load")
+
+        def to_np(v):
+            return v.asnumpy() if hasattr(v, "asnumpy") else v
+
+        decoder_kwargs.setdefault("cache_block", None)
+        dec = Decoder(
+            self.symbol,
+            {k: to_np(v) for k, v in self.arg_params.items()},
+            max_len,
+            aux_params={k: to_np(v)
+                        for k, v in (self.aux_params or {}).items()},
+            **decoder_kwargs)
+        return InferenceEngine(dec, slots=slots,
+                               prefill_buckets=prefill_buckets,
+                               max_queue=max_queue,
+                               steps_per_round=steps_per_round)
+
     @staticmethod
     def load(prefix, epoch, ctx=None, **kwargs):
         """Load from checkpoint (reference :793)."""

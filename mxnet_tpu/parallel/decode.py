@@ -292,20 +292,28 @@ class Decoder:
 
     # -- the derived incremental walk -----------------------------------
     def _write_cache(self, entry, k, v, pos):
-        """Insert a [B, C, H, D] K/V chunk at ``pos`` into a cache entry."""
+        """Insert a [B, C, H, D] K/V chunk at ``pos`` into a cache entry.
+
+        Index tuples are uniformly int32: jax 0.4.37's dynamic-slice
+        BATCHING rule concatenates the index scalars without dtype
+        promotion, so a traced per-slot ``pos`` (int32, via
+        ``_run_slots``'s vmap) mixed with python-int literals trips
+        ``lax.concatenate`` otherwise."""
+        z = jnp.int32(0)
+        p = jnp.asarray(pos, jnp.int32)
         if self._cache_int8:
             ck, ks, cv, vs = entry
             k8, ksc = self._quantize_rows(k)
             v8, vsc = self._quantize_rows(v)
-            return (lax.dynamic_update_slice(ck, k8, (0, pos, 0, 0)),
-                    lax.dynamic_update_slice(ks, ksc, (0, pos, 0)),
-                    lax.dynamic_update_slice(cv, v8, (0, pos, 0, 0)),
-                    lax.dynamic_update_slice(vs, vsc, (0, pos, 0)))
+            return (lax.dynamic_update_slice(ck, k8, (z, p, z, z)),
+                    lax.dynamic_update_slice(ks, ksc, (z, p, z)),
+                    lax.dynamic_update_slice(cv, v8, (z, p, z, z)),
+                    lax.dynamic_update_slice(vs, vsc, (z, p, z)))
         ck, cv = entry
         return (lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                         (0, pos, 0, 0)),
+                                         (z, p, z, z)),
                 lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                         (0, pos, 0, 0)))
+                                         (z, p, z, z)))
 
     def _read_cache(self, entry, dtype):
         """Whole-cache K/V for the attention read: dequantized to
@@ -317,7 +325,7 @@ class Decoder:
                     (cv * vs[..., None]).astype(dtype))
         return entry
 
-    def _cached_mha(self, node, ins, entry, pos):
+    def _cached_mha(self, node, ins, entry, pos, valid_len=None):
         from ..ops.attention import MultiHeadAttention as _MHA
 
         x, wqkv, bqkv, wo, bo = ins
@@ -341,7 +349,8 @@ class Decoder:
             k = rope_rotate(k, posv, node.params["rope_base"])
         win = self._node_window(node)
         if win:
-            o, entry = self._window_attn(q, k, v, entry, pos, win)
+            o, entry = self._window_attn(q, k, v, entry, pos, win,
+                                         valid_len)
             return jnp.einsum("bte,fe->btf", o.reshape(b, c, e),
                               wo) + bo, entry
         entry = self._write_cache(entry, k, v, pos)
@@ -373,7 +382,7 @@ class Decoder:
         return jnp.einsum("bte,fe->btf", o.reshape(b, c, e), wo) + bo, \
             entry
 
-    def _window_attn(self, q, k, v, entry, pos, win):
+    def _window_attn(self, q, k, v, entry, pos, win, valid_len=None):
         """Sliding-window attention against a ring-buffer cache.
 
         EXACT for any chunk size: queries score the PRE-CHUNK ring
@@ -384,7 +393,17 @@ class Decoder:
         overwrite the ring. Reading before writing is what makes
         chunked prefill correct — a ring slot a mid-chunk query still
         needs is never clobbered by a later in-chunk key first.
-        Returns (o [B, C, H, D], updated entry)."""
+        Returns (o [B, C, H, D], updated entry).
+
+        ``valid_len`` (traced, optional): only chunk rows with absolute
+        position < valid_len are written to the ring. A RIGHT-PADDED
+        chunk (the serving engine's bucketed prefill) must not let pad
+        rows into the ring: unlike the linear cache — where a pad row
+        sits at a masked future position until decode overwrites it —
+        a ring write at pad position p lands in slot ``p %% win`` and
+        EVICTS the real key living there, which in-window queries still
+        need. Invalid rows scatter to slot index ``win`` (out of
+        bounds) under ``mode="drop"``."""
         b, c, h, d = q.shape
         kvh = k.shape[2]
         g = h // kvh
@@ -427,26 +446,44 @@ class Decoder:
             + jnp.einsum("bhqk,bkhd->bqhd", p[..., nring:], vf)
         o = o.astype(q.dtype)
 
-        # write the chunk tail (the last min(c, win) tokens — earlier
-        # ones would be overwritten within this same chunk anyway)
-        tail = max(0, c - win)
-        ct = c - tail
-        newpos = pos + tail + jnp.arange(ct)
-        slots = newpos % win
-        kt, vt = k[:, tail:], v[:, tail:]
-        posb = jnp.broadcast_to(newpos[None], (b, ct)).astype(jnp.int32)
+        # write the last min(win, #valid) VALID rows of the chunk —
+        # earlier valid rows would be overwritten within this same
+        # chunk anyway. The write set is selected relative to the
+        # VALID length, not the chunk length: a right-padded chunk's
+        # "last win rows" would both push pad keys into the ring
+        # (evicting real in-window keys — ring slots wrap, unlike the
+        # linear cache's masked-until-overwritten pad rows) and skip
+        # real keys displaced before the pad tail. Gather keeps the
+        # write static-shaped ([win] rows); rows before the chunk
+        # scatter out of bounds under mode="drop". valid_len=None
+        # degenerates to the old last-min(c, win)-rows behavior.
+        p32 = jnp.asarray(pos, jnp.int32)
+        if valid_len is None:
+            vc = jnp.int32(c)
+        else:
+            vc = jnp.clip(jnp.asarray(valid_len, jnp.int32) - p32, 0, c)
+        idx = vc - win + jnp.arange(win)       # chunk rows to write
+        valid = idx >= 0
+        gidx = jnp.clip(idx, 0, c - 1)
+        newpos = p32 + gidx
+        slots = jnp.where(valid, newpos % win, win)  # win: dropped
+        kt = jnp.take(k, gidx, axis=1)
+        vt = jnp.take(v, gidx, axis=1)
+        posb = jnp.broadcast_to(newpos[None], (b, win)).astype(jnp.int32)
         if self._cache_int8:
             k8, ksc = self._quantize_rows(kt)
             v8, vsc = self._quantize_rows(vt)
-            entry = (ck.at[:, slots].set(k8),
-                     ks.at[:, slots].set(ksc),
-                     cv.at[:, slots].set(v8),
-                     vs.at[:, slots].set(vsc),
-                     cpos.at[:, slots].set(posb))
+            entry = (ck.at[:, slots].set(k8, mode="drop"),
+                     ks.at[:, slots].set(ksc, mode="drop"),
+                     cv.at[:, slots].set(v8, mode="drop"),
+                     vs.at[:, slots].set(vsc, mode="drop"),
+                     cpos.at[:, slots].set(posb, mode="drop"))
         else:
-            entry = (ck.at[:, slots].set(kt.astype(ck.dtype)),
-                     cv.at[:, slots].set(vt.astype(cv.dtype)),
-                     cpos.at[:, slots].set(posb))
+            entry = (ck.at[:, slots].set(kt.astype(ck.dtype),
+                                         mode="drop"),
+                     cv.at[:, slots].set(vt.astype(cv.dtype),
+                                         mode="drop"),
+                     cpos.at[:, slots].set(posb, mode="drop"))
         return o, entry
 
     def _blocked_attn(self, q, entry, pos):
@@ -515,9 +552,12 @@ class Decoder:
         o = (acc / s[..., None]).astype(q.dtype)   # [b,h,c,d]
         return o.transpose(0, 2, 1, 3)             # [b,c,h,d]
 
-    def _run(self, params, aux, caches, pos, tokens):
+    def _run(self, params, aux, caches, pos, tokens, valid_len=None):
         """One chunk: tokens [B, C] at positions [pos, pos+C) →
-        (logits [B, C, V], updated caches)."""
+        (logits [B, C, V], updated caches). ``valid_len`` marks a
+        right-padded chunk's true length — only windowed ring WRITES
+        honor it (see ``_window_attn``); linear-cache pad rows are
+        self-correcting (masked until decode overwrites them)."""
         env = {}
         new_caches = list(caches)
         mha_i = 0
@@ -532,14 +572,17 @@ class Decoder:
             name = n.spec.name
             if name == "MultiHeadAttention":
                 out, new_caches[mha_i] = self._cached_mha(
-                    n, ins, new_caches[mha_i], pos)
+                    n, ins, new_caches[mha_i], pos, valid_len)
                 mha_i += 1
                 env[(id(n), 0)] = out
                 continue
             if name == "PositionalEmbedding":
                 x, posp = ins
+                # all-int32 indices: see _write_cache on the vmapped
+                # batching rule's strict index dtypes
                 rows = lax.dynamic_slice(
-                    posp, (pos, 0), (x.shape[1], posp.shape[1]))
+                    posp, (jnp.asarray(pos, jnp.int32), jnp.int32(0)),
+                    (x.shape[1], posp.shape[1]))
                 env[(id(n), 0)] = x + rows[None]
                 continue
             if name == "BatchNorm" and ins[0].ndim >= 3:
@@ -562,6 +605,61 @@ class Decoder:
                 env[(id(n), j)] = o
         head, idx = self._heads[0]
         return env[(id(head), idx)], new_caches
+
+    # -- slot-addressed forms (serving engine) --------------------------
+    # The continuous-batching engine (mxnet_tpu/serving/) runs ONE
+    # persistent cache of S slots in which every slot sits at its own
+    # position. These helpers re-express _run and the cache read/write
+    # in slot-addressed form so the engine's two compiled programs can
+    # reuse the exact decode math above (quantized, windowed, GQA, rope
+    # included) with zero duplication.
+
+    def _run_slots(self, params, aux, caches, pos, tokens):
+        """Per-slot-position ``_run``: ``pos`` [S] int32 positions (one
+        per cache slot), ``tokens`` [S, C] → (logits [S, C, V], updated
+        caches). vmap over the slot axis — each lane is a b=1 ``_run``
+        at its own traced position, so cache writes become per-slot
+        scatters and masks follow each slot's own clock."""
+        def one(slot_caches, p, t):
+            # vmap hands each lane the slot's cache WITHOUT its leading
+            # axis; _run wants b=1 buffers — re-add and strip it
+            sub = jax.tree_util.tree_map(lambda c: c[None], slot_caches)
+            logits, sub = self._run(params, aux, sub, p, t[None])
+            return logits[0], jax.tree_util.tree_map(
+                lambda c: c[0], sub)
+
+        return jax.vmap(one, in_axes=(0, 0, 0))(caches, pos, tokens)
+
+    @staticmethod
+    def slot_slice(caches, slot):
+        """View one cache slot (a traced index) as a b=1 cache — the
+        read half of slot addressing; pair with :meth:`slot_update`."""
+        return jax.tree_util.tree_map(
+            lambda c: lax.dynamic_slice_in_dim(c, slot, 1, axis=0),
+            caches)
+
+    @staticmethod
+    def slot_update(caches, slot, sub):
+        """Write a b=1 cache back into ``slot`` of the full S-slot
+        cache (the write half of slot addressing)."""
+        return jax.tree_util.tree_map(
+            lambda full, s: lax.dynamic_update_slice_in_dim(
+                full, s, slot, axis=0),
+            caches, sub)
+
+    def clear_window_positions(self, caches):
+        """Reset the ring-position buffers of windowed attention nodes
+        to -1 (= never written). Slot REUSE needs this: a recycled
+        slot's non-window rows are hidden by the ``key_pos <= pos``
+        mask until overwritten, but ring slots are visible by their
+        STORED positions, so a previous occupant's entries would leak
+        into a new request's window. No-op for non-windowed caches."""
+        out = []
+        for n, entry in zip(self._mha, caches):
+            if self._node_window(n):
+                entry = entry[:-1] + (jnp.full_like(entry[-1], -1),)
+            out.append(entry)
+        return out
 
     # -- user API -------------------------------------------------------
     @staticmethod
@@ -617,8 +715,24 @@ class Decoder:
         and yields the logits for the next position; from there loop
         ``step`` forward as usual (pinned by
         ``tests/test_decode.py::test_generate_resume``). The decode loop
-        is ONE compiled ``lax.scan`` program (per (B, P, num_steps)
-        shape); cache buffers are donated through it.
+        is ONE compiled ``lax.scan`` program; cache buffers are donated
+        through it.
+
+        Compiled-program cache (``_gen_jit``): ``temperature`` is a
+        TRACED scalar operand — sweeping it never recompiles (a
+        ``lax.cond`` picks argmax vs categorical at run time, so
+        greedy runs do not execute the sampling math and stay
+        bit-identical to the old greedy-only program). The remaining
+        cache keys are
+        genuinely SHAPE-keyed and must stay: ``generate`` compiles one
+        program per ``(batch, prompt_len, num_steps)`` — each changes
+        the traced array shapes or the scan trip count — and
+        ``beam_search`` per ``(batch, prompt_len, num_steps,
+        beam_size, eos_id, length_penalty)`` (beam folds into the
+        batch shape; eos/length_penalty alter the traced graph
+        structure). Serving traffic with varying prompt lengths should
+        use ``mxnet_tpu.serving.InferenceEngine``, whose bucketed
+        programs bound the compile count by design (doc/serving.md).
         """
         prompt = jnp.asarray(prompt).astype(jnp.int32)
         b, p = prompt.shape
@@ -632,32 +746,43 @@ class Decoder:
             # reproducibility); greedy decoding ignores the key
             rng = jax.random.PRNGKey(self._auto_key)
             self._auto_key += 1
-        key = (b, p, int(num_steps), float(temperature))
+        key = (b, p, int(num_steps))
         if key not in self._gen_jit:
-            self._gen_jit[key] = self._build_generate(
-                p, int(num_steps), float(temperature))
-        toks, caches = self._gen_jit[key](self._params, self._aux,
-                                          self.init_cache(b), prompt, rng)
+            self._gen_jit[key] = self._build_generate(p, int(num_steps))
+        toks, caches = self._gen_jit[key](
+            self._params, self._aux, self.init_cache(b), prompt, rng,
+            jnp.float32(temperature))
         return (toks, caches) if return_cache else toks
 
-    def _build_generate(self, p, num_steps, temperature):
-        def pick(logits, rng):
-            if temperature <= 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return jax.random.categorical(
-                rng, logits.astype(jnp.float32) / temperature,
-                axis=-1).astype(jnp.int32)
+    def _build_generate(self, p, num_steps):
+        def pick(logits, rng, temperature):
+            # lax.cond, not a select: greedy decoding must not PAY for
+            # the categorical (threefry per step) it will never take —
+            # the traced temperature only chooses the branch at run
+            # time (the safe divisor guards the untaken-branch trace)
+            def sampled(_):
+                t = jnp.where(temperature > 0.0, temperature,
+                              jnp.float32(1.0))
+                return jax.random.categorical(
+                    rng, logits.astype(jnp.float32) / t,
+                    axis=-1).astype(jnp.int32)
 
-        def gen(params, aux, caches, prompt, rng):
+            def greedy(_):
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            return lax.cond(temperature > 0.0, sampled, greedy, None)
+
+        def gen(params, aux, caches, prompt, rng, temperature):
             logits, caches = self._run(params, aux, caches, 0, prompt)
-            tok = pick(logits[:, -1], jax.random.fold_in(rng, 0))
+            tok = pick(logits[:, -1], jax.random.fold_in(rng, 0),
+                       temperature)
 
             def body(carry, i):
                 caches, tok = carry
                 logits, caches = self._run(params, aux, caches,
                                            p + i, tok[:, None])
                 nxt = pick(logits[:, 0],
-                           jax.random.fold_in(rng, i + 1))
+                           jax.random.fold_in(rng, i + 1), temperature)
                 return (caches, nxt), tok
 
             (caches, _), toks = lax.scan(body, (caches, tok),

@@ -59,47 +59,33 @@ class _StagedStream:
     iterator's epoch end like the iterator itself would, and batches
     staged before an ``epoch_size`` break are served when iteration
     resumes (none are dropped). ``reset()`` forwards to the iterator
-    and discards now-stale staged batches."""
+    and discards now-stale staged batches.
+
+    Thin adapter over the unified ``io.StagedStream`` depth-k helper
+    (inline mode — the same machinery behind ``DevicePrefetchIter``
+    and the serving engine's prompt stager)."""
 
     def __init__(self, trainer, data, data_names, label_names, depth=2):
-        self._trainer = trainer
-        self._data = data
-        self._names = (list(data_names), list(label_names))
-        self._depth = max(1, int(depth))
-        self._queue = collections.deque()
-        self._exhausted = False
+        from ..io import StagedStream
+
+        names = (list(data_names), list(label_names))
+
+        def place(dbatch):
+            data_names_, label_names_ = names
+            batch = dict(zip(data_names_, dbatch.data))
+            batch.update(zip(label_names_, dbatch.label))
+            return dbatch, trainer._stage_batch(batch, "staged fit")
+
+        self._stream = StagedStream(data, place=place, depth=depth)
 
     def reset(self):
-        self._queue.clear()  # staged before the reset: stale
-        self._data.reset()
-        self._exhausted = False
-
-    def _place(self, dbatch):
-        data_names, label_names = self._names
-        batch = dict(zip(data_names, dbatch.data))
-        batch.update(zip(label_names, dbatch.label))
-        return dbatch, self._trainer._stage_batch(batch, "staged fit")
-
-    def _fill(self):
-        while not self._exhausted and len(self._queue) < self._depth:
-            try:
-                dbatch = self._data.next()
-            except StopIteration:
-                self._exhausted = True
-                return
-            self._queue.append(self._place(dbatch))
+        self._stream.reset()
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        self._fill()
-        if not self._queue:
-            self._exhausted = False  # re-arm: caller resets + re-iterates
-            raise StopIteration
-        out = self._queue.popleft()
-        self._fill()  # dispatch i+1's transfer before handing back i
-        return out
+        return self._stream.next()
 
 
 class ParallelTrainer:

@@ -19,7 +19,7 @@ import jax
 from .base import MXNetError
 from . import ndarray as nd
 from . import symbol as sym_mod
-from .parallel.graph import make_graph_fn
+from .parallel.graph import make_graph_fn, integer_semantic_inputs
 
 __all__ = ["Predictor"]
 
@@ -116,6 +116,10 @@ class Predictor:
             return outs
 
         self._run = jax.jit(run)
+        # inputs whose values are INDICES in every use (Embedding data,
+        # loss labels): forward keeps their integer dtype — everything
+        # else normalizes to the f32 compute dtype as before
+        self._integer_inputs = set(integer_semantic_inputs(self._symbol))
         self._outputs = None
 
     def forward(self, **inputs):
@@ -129,7 +133,17 @@ class Predictor:
             if tuple(v.shape) != shape:
                 raise MXNetError("input %s: shape %s != bound %s"
                                  % (k, v.shape, shape))
-            arrs[k] = v.astype(np.float32)
+            # INDEX-semantic inputs (token ids into Embedding) keep
+            # their integer dtype — a blanket f32 cast corrupts ids
+            # above 2^24. Everything else normalizes to the f32
+            # compute dtype as it always did, so integer-typed inputs
+            # feeding FLOAT graphs (uint8 image batches into a conv
+            # net) still work. jit dispatch dtype-keys per input, so
+            # mixed-dtype callers compile one program per signature.
+            if k in self._integer_inputs and v.dtype.kind in "iub":
+                arrs[k] = v
+            else:
+                arrs[k] = v.astype(np.float32)
         self._outputs = self._run(arrs)
         return self
 

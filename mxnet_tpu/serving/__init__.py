@@ -1,0 +1,16 @@
+"""Online serving: continuous-batching inference over a slot-paged
+KV cache (doc/serving.md).
+
+The offline :class:`~mxnet_tpu.parallel.Decoder` compiles one program
+per exact ``(batch, prompt_len, num_steps)`` shape and stalls a whole
+batch on its slowest sequence; the :class:`InferenceEngine` here serves
+an arbitrary request mix — mixed prompt lengths, per-request
+``max_tokens``/``eos_id``/temperature, requests arriving mid-stream —
+from exactly two compiled program families (a bucketed prefill and a
+fused all-slots decode step) with iteration-level scheduling between
+device steps (Orca, OSDI '22; slot-structured caches after vLLM's
+PagedAttention, SOSP '23).
+"""
+from .engine import InferenceEngine, Request
+
+__all__ = ["InferenceEngine", "Request"]

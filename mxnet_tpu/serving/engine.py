@@ -1,0 +1,561 @@
+"""Continuous-batching inference engine over a slot-paged KV cache.
+
+Architecture (doc/serving.md has the full story):
+
+* ONE persistent KV cache of ``S`` slots x ``max_len`` — ``Decoder``'s
+  own cache layouts (plain float, int8-quantized scales, sliding-window
+  rings) with the batch axis reinterpreted as a SLOT axis. A request
+  occupies one slot from admission to retirement; a freed slot is
+  recycled without touching the others (stale rows are hidden by the
+  ``key_pos <= pos`` causal mask until overwritten; window rings get
+  their position buffers reset at admission).
+
+* TWO compiled program families serve any request mix, ever:
+
+  - **bucketed prefill** (one program per power-of-2 length bucket):
+    a prompt padded to its bucket is pushed through the derived
+    incremental graph at positions ``[0, P)`` of its assigned slot —
+    slot index, true length, temperature, rng key, eos id and token
+    budget are all traced operands. The first output token is sampled
+    in-program and the per-slot state vectors are scatter-updated, so
+    admission costs zero extra compiled programs.
+  - **fused decode step** (exactly one program): one token for EVERY
+    slot at its own position — per-slot position vector, per-slot
+    temperature/rng sampling, vectorized EOS/length masking. Finished
+    slots freeze (their write is idempotent) until reused.
+
+* a host-side scheduler that admits queued requests into freed slots
+  BETWEEN device steps (iteration-level / continuous batching — Orca,
+  OSDI '22), retires finished sequences, and overlaps host work with
+  device execution twice over: prompt h2d staging rides the unified
+  depth-k ``io.StagedStream`` helper (PR 2's machinery), and output
+  token vectors are drained ``drain_depth`` dispatches behind the
+  device, so the step stream never blocks on either edge.
+
+Determinism guarantees (pinned by tests/test_serving.py): greedy
+(``temperature=0``) outputs are byte-identical to offline
+``Decoder.generate`` per request, regardless of admission order, slot
+assignment, co-resident requests, or bucket padding; sampled outputs
+depend only on ``(seed, position)`` — not on scheduling.
+"""
+from __future__ import annotations
+
+import collections
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..io import StagedStream
+from ..parallel.decode import Decoder
+
+__all__ = ["InferenceEngine", "Request"]
+
+
+class Request:
+    """One submitted generation request (handle returned by
+    :meth:`InferenceEngine.submit`).
+
+    ``tokens`` fills in as output drains: generated ids only (no
+    prompt echo), including ``eos_id`` when hit. ``done`` flips when
+    the sequence retires; ``result()`` returns the tokens as int32
+    numpy. Latency probes: ``t_submit``/``t_first``/``t_done``
+    (perf_counter seconds; first = first token DRAINED, i.e. visible
+    to the caller, not merely computed).
+    """
+
+    def __init__(self, rid, prompt, max_tokens, eos_id, temperature,
+                 seed, limit):
+        self.id = rid
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.eos_id = eos_id
+        self.temperature = temperature
+        self.seed = seed
+        self.limit = limit          # min(max_tokens, max_len - P)
+        self.tokens = []
+        self.done = False
+        self.t_submit = time.perf_counter()
+        self.t_first = None
+        self.t_done = None
+
+    def result(self):
+        if not self.done:
+            raise MXNetError("request %s is not finished" % self.id)
+        return np.asarray(self.tokens, np.int32)
+
+    def __repr__(self):
+        return ("Request(id=%r, prompt_len=%d, max_tokens=%d, done=%s, "
+                "generated=%d)" % (self.id, len(self.prompt),
+                                   self.max_tokens, self.done,
+                                   len(self.tokens)))
+
+
+class _PendingSource:
+    """StagedStream source over the engine's pending deque (empty deque
+    = StopIteration; the stream runs ``live_source`` mode, so submits
+    arriving later are staged by the very next fill)."""
+
+    def __init__(self, dq):
+        self._dq = dq
+
+    def next(self):
+        if not self._dq:
+            raise StopIteration
+        return self._dq.popleft()
+
+    def reset(self):
+        pass
+
+
+def _default_buckets(max_len):
+    out, b = [], 16
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(out)
+
+
+def _raw_key(seed):
+    """threefry PRNGKey layout without dispatching a device op (the
+    compile-count contract stays clean): [hi32, lo32] of the seed."""
+    seed = int(seed) & 0xFFFFFFFFFFFFFFFF
+    return np.array([seed >> 32, seed & 0xFFFFFFFF], np.uint32)
+
+
+class InferenceEngine:
+    """Continuous-batching serving loop over a :class:`Decoder`.
+
+    Parameters
+    ----------
+    decoder : Decoder
+        The derived incremental program (any cache flavor: bf16/int8
+        ``cache_dtype``, sliding-window models, GQA, rope). Build one
+        with ``Decoder(symbol, params, max_len=...)`` or use
+        :meth:`from_checkpoint` / ``FeedForward.as_serving_engine``.
+        ``cache_block`` prefix-bounded reads are not supported under
+        slot addressing (each slot has its own clock) — construct the
+        decoder with ``cache_block=None`` (the engine refuses
+        otherwise rather than silently decoding differently).
+    slots : int
+        ``S``, the resident-sequence capacity — the continuous batch
+        size and the cache's slot-axis length. Throughput knob: decode
+        cost per step is roughly flat until the chip saturates, so
+        more slots = more tokens per step (tools/bench_serving.py
+        sweeps it).
+    prefill_buckets : tuple of int, optional
+        Ascending prompt-padding lengths; a prompt takes the smallest
+        bucket >= its length (default: powers of two from 16, capped
+        at ``max_len``). One prefill program compiles per bucket
+        actually used — the whole compile budget is
+        ``len(buckets) + 1``.
+    max_queue : int
+        Backpressure bound on submitted-but-not-admitted requests;
+        ``submit`` raises ``MXNetError`` beyond it.
+    stage_depth : int
+        Depth of the prompt h2d stager (``io.StagedStream``).
+    drain_depth : int
+        How many step outputs may remain un-drained while work is in
+        flight — the d2h analogue of ``stage_depth``. Retirement is
+        discovered at drain time, so a slot frees at most
+        ``drain_depth`` rounds after its sequence finished (the device
+        freezes finished slots in the meantime).
+    steps_per_round : int
+        Tokens decoded per dispatched round: the decode program is a
+        ``lax.scan`` of this many fused all-slots steps, amortizing
+        the per-dispatch host/relay overhead k-fold (one jit call,
+        one [k, S] output drain per k tokens). Admission/retirement
+        granularity coarsens to k tokens — a slot freed mid-round sits
+        frozen until the round ends, so k should stay well under the
+        typical output length (k=1 is latency-optimal per-token
+        scheduling; the chip-facing bench uses 8). Still ONE compiled
+        decode program either way.
+    """
+
+    def __init__(self, decoder, slots=8, prefill_buckets=None,
+                 max_queue=256, stage_depth=2, drain_depth=2,
+                 steps_per_round=1):
+        if not isinstance(decoder, Decoder):
+            raise MXNetError("InferenceEngine needs a Decoder, got %r"
+                             % type(decoder).__name__)
+        if decoder._cache_block is not None:
+            raise MXNetError(
+                "InferenceEngine: slot-paged decoding does not support "
+                "cache_block prefix-bounded reads (per-slot positions); "
+                "build the Decoder with cache_block=None")
+        self._dec = decoder
+        self.max_len = decoder.max_len
+        self.slots = int(slots)
+        if self.slots < 1:
+            raise MXNetError("InferenceEngine: slots must be >= 1")
+        if prefill_buckets is None:
+            prefill_buckets = _default_buckets(self.max_len)
+        buckets = tuple(int(b) for b in prefill_buckets)
+        if not buckets or list(buckets) != sorted(set(buckets)) \
+                or buckets[0] < 1 or buckets[-1] > self.max_len:
+            raise MXNetError(
+                "InferenceEngine: prefill_buckets must be strictly "
+                "ascending lengths in [1, max_len], got %r" % (buckets,))
+        self.prefill_buckets = buckets
+        self.max_queue = int(max_queue)
+        self._drain_depth = max(0, int(drain_depth))
+        self.steps_per_round = int(steps_per_round)
+        if self.steps_per_round < 1:
+            raise MXNetError("InferenceEngine: steps_per_round must "
+                             "be >= 1")
+
+        # device-resident: the slot-paged cache + per-slot state vectors
+        S = self.slots
+        self._caches = decoder.init_cache(S)
+        self._state = (
+            jnp.zeros((S,), jnp.int32),        # pos: next write position
+            jnp.zeros((S,), jnp.int32),        # tok: last sampled token
+            jnp.zeros((S,), bool),             # live
+            jnp.zeros((S,), jnp.float32),      # temperature
+            jnp.zeros((S, 2), jnp.uint32),     # rng key
+            jnp.full((S,), -1, jnp.int32),     # eos id (-1: none)
+            jnp.zeros((S,), jnp.int32),        # last allowed position
+        )
+
+        # host-side scheduler state
+        self._pending = collections.deque()
+        self._stager = StagedStream(_PendingSource(self._pending),
+                                    place=self._place_prompt,
+                                    depth=stage_depth, live_source=True)
+        self._free = collections.deque(range(S))  # FIFO slot recycling
+        self._mirror = [None] * S   # drain-side view: slot -> Request
+        self._drain = collections.deque()
+        self._next_id = 0
+        self._auto_seed = 0
+        self.stats = {"submitted": 0, "completed": 0, "prefills": 0,
+                      "steps": 0, "tokens": 0}
+
+        # the two compiled program families; the log records one tag
+        # per TRACE (python side effects run at trace time only), so it
+        # IS the compile count — tests pin the contract against it
+        self._compile_log = []
+        self._donate = (2, 3) if jax.default_backend() != "cpu" else ()
+        self._step_fn = jax.jit(self._make_step(),
+                                donate_argnums=self._donate)
+        self._prefill_fns = {}
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, max_len, slots=8,
+                        prefill_buckets=None, max_queue=256,
+                        stage_depth=2, drain_depth=2, steps_per_round=1,
+                        **decoder_kwargs):
+        """Checkpoint → serving engine in one call
+        (``prefix-symbol.json`` + ``prefix-NNNN.params``, the reference
+        format): builds the :class:`Decoder` via
+        ``Decoder.from_checkpoint`` and wraps it. ``decoder_kwargs``
+        reach the decoder (``compute_dtype``, ``cache_dtype``, ...)."""
+        decoder_kwargs.setdefault("cache_block", None)
+        dec = Decoder.from_checkpoint(prefix, epoch, max_len,
+                                      **decoder_kwargs)
+        return cls(dec, slots=slots, prefill_buckets=prefill_buckets,
+                   max_queue=max_queue, stage_depth=stage_depth,
+                   drain_depth=drain_depth,
+                   steps_per_round=steps_per_round)
+
+    # -- compiled programs ----------------------------------------------
+    def _make_step(self):
+        dec = self._dec
+        k_rounds = self.steps_per_round
+
+        def one_step(caches, state, params, aux):
+            pos, tok, live, temp, keys, eos, last = state
+            # write each slot's pending token at ITS position, read
+            # logits for the next one (frozen slots rewrite their last
+            # token in place — idempotent)
+            logits, caches = dec._run_slots(params, aux, caches, pos,
+                                            tok[:, None])
+            logits = logits[:, 0]
+            nxt_pos = pos + 1
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            def with_sampling(_):
+                t = jnp.where(temp > 0.0, temp, jnp.float32(1.0))
+
+                def draw(k, q, row):
+                    return jax.random.categorical(
+                        jax.random.fold_in(k, q), row)
+
+                sampled = jax.vmap(draw)(
+                    keys, nxt_pos,
+                    logits.astype(jnp.float32) / t[:, None]
+                ).astype(jnp.int32)
+                return jnp.where(temp > 0.0, sampled, greedy)
+
+            # all-greedy rounds (the common case) must not pay the
+            # per-slot fold_in + categorical they will never take —
+            # same reasoning as Decoder._build_generate's lax.cond
+            nxt = lax.cond(jnp.any(temp > 0.0), with_sampling,
+                           lambda _: greedy, None)
+            done_now = (nxt == eos) | (nxt_pos >= last)
+            out = jnp.where(live, nxt, -1)     # -1: slot had no token
+            live2 = live & ~done_now
+            pos2 = jnp.where(live, nxt_pos, pos)
+            tok2 = jnp.where(live, nxt, tok)
+            return caches, (pos2, tok2, live2, temp, keys, eos, last), \
+                out
+
+        def step(params, aux, caches, state):
+            self._compile_log.append("decode")  # trace-time, see above
+
+            def body(carry, _):
+                caches, st = carry
+                caches, st, out = one_step(caches, st, params, aux)
+                return (caches, st), out
+
+            (caches, state), outs = lax.scan(body, (caches, state),
+                                             None, length=k_rounds)
+            return caches, state, outs          # outs [k, S]
+
+        return step
+
+    def _prefill_fn(self, bucket):
+        if bucket not in self._prefill_fns:
+            dec = self._dec
+
+            def prefill(params, aux, caches, state, slot, tokens,
+                        true_len, temp, key, eos, max_toks):
+                self._compile_log.append(("prefill", bucket))
+                pos, tok, live, temps, keys, eoss, lasts = state
+                sub = dec.slot_slice(caches, slot)
+                # ring-position reset: a recycled slot must not leak
+                # the previous occupant's window entries
+                sub = dec.clear_window_positions(sub)
+                # valid_len: pad rows must not enter window rings
+                # (they would EVICT real in-window keys — linear cache
+                # rows are masked-until-overwritten, ring slots wrap)
+                logits, sub = dec._run(params, aux, sub, 0, tokens,
+                                       valid_len=true_len)
+                caches = dec.slot_update(caches, slot, sub)
+                v = logits.shape[2]
+                zero = jnp.int32(0)
+                lastlog = lax.dynamic_slice(
+                    logits, (zero, true_len - 1, zero), (1, 1, v))[0, 0]
+                greedy = jnp.argmax(lastlog, -1).astype(jnp.int32)
+                t = jnp.where(temp > 0.0, temp, jnp.float32(1.0))
+                sampled = jax.random.categorical(
+                    jax.random.fold_in(key, true_len),
+                    lastlog.astype(jnp.float32) / t).astype(jnp.int32)
+                t0 = jnp.where(temp > 0.0, sampled, greedy)
+                lastp = jnp.minimum(true_len + max_toks - 1,
+                                    dec.max_len - 1).astype(jnp.int32)
+                done0 = (t0 == eos) | (true_len >= lastp)
+                state2 = (pos.at[slot].set(true_len),
+                          tok.at[slot].set(t0),
+                          live.at[slot].set(~done0),
+                          temps.at[slot].set(temp),
+                          keys.at[slot].set(key),
+                          eoss.at[slot].set(eos),
+                          lasts.at[slot].set(lastp))
+                return caches, state2, t0
+
+            self._prefill_fns[bucket] = jax.jit(
+                prefill, donate_argnums=self._donate)
+        return self._prefill_fns[bucket]
+
+    @property
+    def compile_counts(self):
+        """{'decode': n_traces, 'prefill': {bucket: n_traces}} — the
+        compile-count contract: after any workload, decode == 1 and
+        each USED bucket == 1 (doc/serving.md)."""
+        out = {"decode": 0, "prefill": {}}
+        for tag in self._compile_log:
+            if tag == "decode":
+                out["decode"] += 1
+            else:
+                out["prefill"][tag[1]] = out["prefill"].get(tag[1], 0) + 1
+        return out
+
+    # -- host scheduler -------------------------------------------------
+    def _bucket_for(self, n):
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b
+        raise MXNetError(
+            "InferenceEngine: prompt length %d exceeds the largest "
+            "prefill bucket %d" % (n, self.prefill_buckets[-1]))
+
+    def _place_prompt(self, req):
+        """Stager place fn: pad to the bucket and dispatch the h2d
+        (async) — runs up to stage_depth requests ahead of admission."""
+        p = len(req.prompt)
+        bucket = self._bucket_for(p)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :p] = req.prompt
+        return req, jax.device_put(padded)
+
+    def queued(self):
+        """Requests submitted but not yet admitted to a slot."""
+        return len(self._pending) + self._stager.staged()
+
+    @property
+    def idle(self):
+        return not self._pending and self._stager.staged() == 0 \
+            and len(self._free) == self.slots and not self._drain
+
+    def submit(self, prompt, max_tokens, eos_id=None, temperature=0.0,
+               seed=None, request_id=None):
+        """Queue one generation request; returns its :class:`Request`
+        handle (fills in as the engine steps).
+
+        prompt : 1-D int sequence, ``1 <= len <= max_len - 1`` (and
+        within the largest bucket). ``max_tokens`` is truncated to the
+        cache: at most ``max_len - len(prompt)`` tokens come back.
+        ``eos_id``: generation stops after emitting it (included in
+        the output). ``temperature=0``: greedy, byte-identical to
+        ``Decoder.generate``; > 0 samples with ``seed`` (auto-drawn if
+        omitted) — reproducible and schedule-independent.
+
+        Raises ``MXNetError`` once ``max_queue`` requests are waiting
+        (backpressure — callers drive :meth:`step` to drain).
+        """
+        if self.queued() >= self.max_queue:
+            raise MXNetError(
+                "InferenceEngine: request queue is full (%d waiting; "
+                "max_queue=%d) — step() the engine to drain it"
+                % (self.queued(), self.max_queue))
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise MXNetError("InferenceEngine: empty prompt")
+        if prompt.size > self.max_len - 1:
+            raise MXNetError(
+                "InferenceEngine: prompt length %d leaves no room to "
+                "generate (max_len=%d)" % (prompt.size, self.max_len))
+        self._bucket_for(prompt.size)  # validate against buckets now
+        max_tokens = int(max_tokens)
+        if max_tokens < 1:
+            raise MXNetError("InferenceEngine: max_tokens must be >= 1")
+        if seed is None:
+            seed = self._auto_seed
+            self._auto_seed += 1
+        rid = request_id
+        if rid is None:
+            rid = self._next_id
+            self._next_id += 1
+        limit = min(max_tokens, self.max_len - prompt.size)
+        req = Request(rid, prompt, max_tokens, eos_id,
+                      float(temperature), seed, limit)
+        self._pending.append(req)
+        self.stats["submitted"] += 1
+        return req
+
+    def _admit(self):
+        """Fill freed slots from the staged queue: one prefill dispatch
+        per admission, between device steps (iteration-level
+        scheduling)."""
+        params, aux = self._dec._params, self._dec._aux
+        while self._free:
+            try:
+                req, dev = self._stager.next()
+            except StopIteration:
+                break
+            slot = self._free.popleft()
+            bucket = int(dev.shape[1])
+            fn = self._prefill_fn(bucket)
+            self._caches, self._state, t0 = fn(
+                params, aux, self._caches, self._state,
+                np.int32(slot), dev, np.int32(len(req.prompt)),
+                np.float32(req.temperature), _raw_key(req.seed),
+                np.int32(-1 if req.eos_id is None else req.eos_id),
+                np.int32(req.limit))
+            self._drain.append(("prefill", req, slot, t0))
+            self.stats["prefills"] += 1
+
+    def _busy(self):
+        return (self.slots - len(self._free)) > 0 or bool(self._pending) \
+            or self._stager.staged() > 0
+
+    def _push_token(self, req, slot, t, done_now):
+        assert t >= 0, "drained a token from a device-dead slot"
+        now = time.perf_counter()
+        req.tokens.append(int(t))
+        if req.t_first is None:
+            req.t_first = now
+        self.stats["tokens"] += 1
+        if (req.eos_id is not None and t == req.eos_id) \
+                or len(req.tokens) >= req.limit:
+            req.done = True
+            req.t_done = now
+            self._mirror[slot] = None
+            self._free.append(slot)
+            self.stats["completed"] += 1
+            done_now.append(req)
+
+    def _drain_one(self, done_now):
+        entry = self._drain.popleft()
+        if entry[0] == "prefill":
+            _, req, slot, t0 = entry
+            self._mirror[slot] = req
+            self._push_token(req, slot, int(np.asarray(t0)), done_now)
+        else:
+            rounds = np.asarray(entry[1])        # [steps_per_round, S]
+            for row in rounds:
+                for s in range(self.slots):
+                    req = self._mirror[s]
+                    if req is not None:
+                        self._push_token(req, s, int(row[s]), done_now)
+
+    def step(self):
+        """One scheduling round: admit staged requests into free slots,
+        dispatch ONE decode round (``steps_per_round`` fused all-slot
+        steps) if any slot is occupied, then drain output vectors that
+        are ``drain_depth`` dispatches old (all of them once nothing
+        is in flight). Returns the requests COMPLETED by this round,
+        in completion order."""
+        done_now = []
+        self._admit()
+        if (self.slots - len(self._free)) > 0:
+            self._caches, self._state, out = self._step_fn(
+                self._dec._params, self._dec._aux,
+                self._caches, self._state)
+            self._drain.append(("step", out))
+            self.stats["steps"] += 1
+        while len(self._drain) > (self._drain_depth if self._busy()
+                                  else 0):
+            self._drain_one(done_now)
+        return done_now
+
+    def serve_forever(self, requests=None):
+        """Drive the loop to completion: pull submissions from
+        ``requests`` (optional iterable — dict kwargs for
+        :meth:`submit`, a ``(prompt, kwargs)`` pair, a bare prompt
+        array, or ``None`` meaning "nothing has arrived yet", which
+        lets a generator pace an online arrival process), stepping
+        continuously; between pulls the engine keeps serving whatever
+        is resident. Returns all completed requests in completion
+        order. With ``requests=None`` it serves what was already
+        submitted and returns when idle."""
+        completed = []
+        src = iter(requests) if requests is not None else None
+        exhausted = src is None
+        while True:
+            # ingest until backpressure or a pacing None — one item per
+            # round would starve free slots while the source has ready
+            # requests
+            while not exhausted and self.queued() < self.max_queue:
+                try:
+                    item = next(src)
+                except StopIteration:
+                    exhausted = True
+                    break
+                if item is None:
+                    break              # nothing ready yet: go decode
+                if isinstance(item, dict):
+                    self.submit(**item)
+                elif isinstance(item, tuple) and len(item) == 2 \
+                        and isinstance(item[1], dict):
+                    self.submit(item[0], **item[1])
+                else:
+                    self.submit(item, max_tokens=self.max_len)
+            completed.extend(self.step())
+            if exhausted and self.idle:
+                return completed

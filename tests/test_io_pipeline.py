@@ -430,6 +430,61 @@ def test_device_prefetch_composes_with_device_augment(tmp_path):
     base.close()
 
 
+def test_staged_stream_inline_mode_generic():
+    """io.StagedStream inline mode — the ONE depth-k staging helper
+    behind staged_batches, DevicePrefetchIter, and the serving prompt
+    stager: depth-k lookahead through `place`, re-arm at exhaustion,
+    reset() rewinds the source and discards staleness."""
+    pulls = []
+
+    class Src:
+        def __init__(self):
+            self.i = 0
+
+        def next(self):
+            if self.i >= 5:
+                raise StopIteration
+            self.i += 1
+            pulls.append(self.i)
+            return self.i
+
+        def reset(self):
+            self.i = 0
+
+    s = mx.io.StagedStream(Src(), place=lambda x: x * 10, depth=2)
+    assert s.next() == 10
+    # depth-2 lookahead: items 2 and 3 were pulled before the consumer
+    # asked for them (1 handed out, 2 refilled behind it)
+    assert pulls == [1, 2, 3]
+    assert s.staged() == 2
+    assert [x for x in s] == [20, 30, 40, 50]
+    assert list(s) == []          # re-armed, but the source is spent
+    s.reset()
+    assert list(s) == [10, 20, 30, 40, 50]
+
+    # live_source mode: exhaustion never latches, so items that appear
+    # AFTER an empty probe stage on the very next pull (the serving
+    # engine's pending queue)
+    import collections
+
+    dq = collections.deque()
+
+    class Live:
+        def next(self):
+            if not dq:
+                raise StopIteration
+            return dq.popleft()
+
+        def reset(self):
+            pass
+
+    ls = mx.io.StagedStream(Live(), depth=2, live_source=True)
+    with pytest.raises(StopIteration):
+        ls.next()
+    dq.extend([1, 2])
+    assert ls.next() == 1 and ls.next() == 2
+
+
 def test_staged_stream_preserves_epoch_size_semantics():
     """ParallelTrainer.staged_batches: batches staged before an
     epoch_size break are served when iteration resumes — none dropped,

@@ -60,6 +60,57 @@ def test_predictor(tmp_path):
                                atol=1e-6)
 
 
+def test_predictor_preserves_integer_inputs(tmp_path):
+    """Predictor.forward must not blanket-cast inputs to float32: an
+    LM predictor's token ids reach the graph at their integer dtype
+    (f32 would silently round ids above 2^24); only float inputs are
+    normalized to the f32 compute dtype."""
+    from mxnet_tpu.models import get_transformer_lm
+
+    vocab, t = 17, 8
+    sym = get_transformer_lm(vocab, num_layers=1, embed_dim=8,
+                             num_heads=2, impl="dense")
+    shapes = {"data": (1, t), "softmax_label": (1, t)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    rng = np.random.RandomState(0)
+    params = {"arg:%s" % n: mx.nd.array(
+        rng.uniform(-0.3, 0.3, s).astype(np.float32))
+        for n, s in zip(sym.list_arguments(), arg_shapes)
+        if n not in shapes}
+    logits = sym.get_internals()["lm_head_output"]
+    pred = predict.Predictor(logits.tojson(), params, {"data": (1, t)})
+
+    seen = {}
+    orig = pred._run
+    pred._run = lambda arrs: (seen.update(arrs), orig(arrs))[1]
+    ids = rng.randint(0, vocab, (1, t)).astype(np.int64)
+    out_int = pred.forward(data=ids).get_output(0)
+    assert seen["data"].dtype.kind in "iu"      # ids NOT cast to float
+    out_f32 = pred.forward(data=ids.astype(np.float32)).get_output(0)
+    assert seen["data"].dtype == np.float32
+    np.testing.assert_allclose(out_int, out_f32, rtol=1e-6)
+    out_f64 = pred.forward(data=ids.astype(np.float64)).get_output(0)
+    assert seen["data"].dtype == np.float32     # floats normalize to f32
+    np.testing.assert_allclose(out_f64, out_f32, rtol=1e-6)
+
+    # the flip side: integer-typed inputs into a FLOAT graph (uint8
+    # image batches into an FC/conv net) must still be normalized to
+    # f32 — only INDEX-semantic inputs keep their dtype
+    fsym = _net()
+    fshapes = {"data": (2, 8), "softmax_label": (2,)}
+    exe = fsym.simple_bind(mx.cpu(), grad_req="null", **fshapes)
+    fparams = {}
+    for name, arr in exe.arg_dict.items():
+        if name not in fshapes:
+            v = rng.uniform(-0.3, 0.3, arr.shape).astype(np.float32)
+            fparams["arg:" + name] = mx.nd.array(v)
+    fpred = predict.Predictor(fsym.tojson(), fparams, {"data": (2, 8)})
+    u8 = rng.randint(0, 255, (2, 8)).astype(np.uint8)
+    out_u8 = fpred.forward(data=u8).get_output(0)      # must not crash
+    out_ff = fpred.forward(data=u8.astype(np.float32)).get_output(0)
+    np.testing.assert_allclose(out_u8, out_ff, rtol=1e-6)
+
+
 def test_pallas_op_push():
     def scale_kernel(x_ref, o_ref):
         o_ref[:] = x_ref[:] * 2.0

@@ -1,0 +1,411 @@
+"""Continuous-batching serving engine (mxnet_tpu/serving/): the oracle
+is the offline KV-cache Decoder itself — greedy engine outputs must be
+BYTE-IDENTICAL per request to ``Decoder.generate`` regardless of
+admission order, slot assignment, bucket padding, or co-resident
+requests, across every cache flavor. Also pins the compile-count
+contract (one decode program + one prefill program per used bucket) and
+the PR's decode-cache satellite (temperature is a traced operand).
+
+Runtime discipline: every distinct ``(prompt_len, num_steps)`` oracle
+call and every engine compiles programs, which dominates this file on
+CPU — workloads reuse a small set of shapes, oracle outputs are cached,
+and one default-config engine is shared by the tests that only READ
+behavior (each still drains to idle)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models import get_transformer_lm
+from mxnet_tpu.parallel import Decoder
+from mxnet_tpu.serving import InferenceEngine
+
+# 1 layer keeps this file's compile bill inside the tier-1 budget; the
+# multi-node cache-list plumbing the engine reuses is pinned offline by
+# test_decode.py (2 layers), and every identity oracle here is
+# layer-count-agnostic
+VOCAB, LAYERS, EMBED, HEADS = 17, 1, 16, 2
+T = 16  # max_len everywhere here
+
+
+def _lm(**kw):
+    return get_transformer_lm(VOCAB, num_layers=LAYERS, embed_dim=EMBED,
+                              num_heads=HEADS, impl="dense", **kw)
+
+
+def _init_params(sym, rng):
+    shapes = {"data": (2, T), "softmax_label": (2, T)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    return {n: jnp.asarray(rng.uniform(-0.3, 0.3, s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(), arg_shapes)
+            if n not in shapes}
+
+
+@pytest.fixture(scope="module")
+def lm():
+    rng = np.random.RandomState(0)
+    sym = _lm()
+    params = _init_params(sym, rng)
+    return sym, params, Decoder(sym, params, max_len=T)
+
+
+def _engine(sym, params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("prefill_buckets", (4, 8))
+    return InferenceEngine(Decoder(sym, params, max_len=T,
+                                   cache_block=None), **kw)
+
+
+@pytest.fixture(scope="module")
+def shared_engine(lm):
+    """One default-config engine reused by read-only behavior tests
+    (each drains it back to idle); tests asserting per-engine stats or
+    compile logs build their own."""
+    sym, params, _ = lm
+    return _engine(sym, params)
+
+
+@pytest.fixture(scope="module")
+def second_engine(lm):
+    """A SECOND independent default-config engine, for tests comparing
+    two admission schedules against each other."""
+    sym, params, _ = lm
+    return _engine(sym, params)
+
+
+_ORACLE = {}
+
+
+def _oracle(dec, prompt, n):
+    """Offline greedy continuation, truncated the way the engine
+    truncates (at the cache end); memoized — repeated shapes must not
+    recompile or re-run the scan program."""
+    prompt = np.asarray(prompt)
+    n = min(n, T - len(prompt))
+    key = (id(dec), prompt.tobytes(), len(prompt), n)
+    if key not in _ORACLE:
+        _ORACLE[key] = np.asarray(
+            dec.generate(prompt[None], num_steps=n))[0, len(prompt):]
+    return _ORACLE[key]
+
+
+def test_engine_mixed_lengths_slot_reuse_byte_identical(lm):
+    """More requests than slots, mixed prompt/output lengths: every
+    request byte-matches offline greedy decode; slots are recycled; the
+    whole run (and a SECOND wave on the same engine) compiles exactly
+    one decode program + one prefill program per used bucket."""
+    sym, params, dec = lm
+    rng = np.random.RandomState(1)
+    eng = _engine(sym, params)
+    cases = [(2, 5), (4, 6), (7, 3), (4, 6), (2, 5), (7, 3), (6, 2)]
+    reqs = [(p, n, eng.submit(p, max_tokens=n))
+            for pl, n in cases
+            for p in [rng.randint(0, VOCAB, (pl,))]]
+    done = eng.serve_forever()
+    assert len(done) == len(cases)
+    assert eng.stats["prefills"] == len(cases) > eng.slots  # slot reuse
+    for p, n, r in reqs:
+        np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
+    assert eng.compile_counts == {"decode": 1, "prefill": {4: 1, 8: 1}}
+
+    # second wave on the SAME engine: zero new compiles, still exact
+    wave2 = [(p, n, eng.submit(p, max_tokens=n))
+             for pl, n in [(2, 5), (4, 6), (7, 3)]
+             for p in [rng.randint(0, VOCAB, (pl,))]]
+    eng.serve_forever()
+    for p, n, r in wave2:
+        np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
+    assert eng.compile_counts == {"decode": 1, "prefill": {4: 1, 8: 1}}
+    assert eng.idle
+
+
+def test_engine_multi_step_rounds_byte_identical(lm):
+    """steps_per_round>1 (the dispatch-amortized decode round, one
+    lax.scan program) changes scheduling granularity only: outputs
+    stay byte-identical, including requests that retire MID-round
+    (budgets deliberately not multiples of the round length)."""
+    sym, params, dec = lm
+    rng = np.random.RandomState(11)
+    eng = _engine(sym, params, steps_per_round=3)
+    reqs = [(p, n, eng.submit(p, max_tokens=n))
+            for pl, n in [(2, 5), (6, 2), (2, 5), (6, 2), (4, 1)]
+            for p in [rng.randint(0, VOCAB, (pl,))]]
+    eng.serve_forever()
+    for p, n, r in reqs:
+        np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
+    assert eng.compile_counts["decode"] == 1
+    assert eng.idle
+
+
+def test_engine_admission_order_and_midstream_submit(lm, shared_engine,
+                                                     second_engine):
+    """Per-request outputs are independent of admission order and of
+    requests submitted MID-STREAM while others are decoding."""
+    sym, params, dec = lm
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, VOCAB, (pl,)) for pl in (3, 6, 2, 3, 6)]
+
+    # order A: all up front, on the shared engine
+    ra = [shared_engine.submit(p, max_tokens=5) for p in prompts]
+    shared_engine.serve_forever()
+
+    # order B: independent engine, reversed, trickled in mid-decode
+    eng_b = second_engine
+    rb = {}
+    rb[4] = eng_b.submit(prompts[4], max_tokens=5)
+    for _ in range(3):
+        eng_b.step()                      # decoding is underway
+    for i in (3, 2):
+        rb[i] = eng_b.submit(prompts[i], max_tokens=5)
+    eng_b.step()
+    for i in (1, 0):
+        rb[i] = eng_b.submit(prompts[i], max_tokens=5)
+    eng_b.serve_forever()
+
+    for i, p in enumerate(prompts):
+        want = _oracle(dec, p, 5)
+        np.testing.assert_array_equal(ra[i].result(), want)
+        np.testing.assert_array_equal(rb[i].result(), want)
+
+
+def test_engine_eos_limits_and_truncation(lm, shared_engine):
+    """eos_id retires a sequence the moment it appears (eos included in
+    the output); max_tokens=1 retires at prefill; an over-long token
+    budget is truncated at the cache end — all byte-equal to the
+    offline continuation's prefix."""
+    sym, params, dec = lm
+    rng = np.random.RandomState(3)
+    p = rng.randint(0, VOCAB, (4,))
+    full = _oracle(dec, p, T - len(p))   # the longest continuation
+
+    eos = int(full[3])
+    r_eos = shared_engine.submit(p, max_tokens=12, eos_id=eos)
+    r_one = shared_engine.submit(p, max_tokens=1)
+    r_cap = shared_engine.submit(p, max_tokens=100)  # > room: truncated
+    shared_engine.serve_forever()
+
+    stop = int(np.where(full == eos)[0][0])
+    np.testing.assert_array_equal(r_eos.result(), full[:stop + 1])
+    np.testing.assert_array_equal(r_one.result(), full[:1])
+    assert len(r_cap.tokens) == T - len(p)
+    np.testing.assert_array_equal(r_cap.result(), full)
+
+
+def test_engine_backpressure(lm):
+    """max_queue bounds submitted-but-not-admitted requests: submit
+    raises MXNetError when full and succeeds again once the engine
+    drains."""
+    sym, params, dec = lm
+    rng = np.random.RandomState(4)
+    # 1 slot + queue 2: a third WAITING request must bounce
+    eng = _engine(sym, params, slots=1, max_queue=2, stage_depth=1)
+    held = [eng.submit(rng.randint(0, VOCAB, (4,)), max_tokens=6)
+            for _ in range(2)]  # queue at capacity (admission is lazy)
+    extra = rng.randint(0, VOCAB, (4,))
+    with pytest.raises(MXNetError, match="queue is full"):
+        eng.submit(extra, max_tokens=2)
+    eng.step()                  # admits one into the slot: room again
+    held.append(eng.submit(rng.randint(0, VOCAB, (4,)), max_tokens=6))
+    with pytest.raises(MXNetError, match="queue is full"):
+        eng.submit(extra, max_tokens=2)
+    eng.serve_forever()
+    assert all(r.done for r in held)
+    late = eng.submit(extra, max_tokens=2)  # drained: accepted again
+    eng.serve_forever()
+    np.testing.assert_array_equal(late.result(), _oracle(dec, extra, 2))
+
+
+@pytest.mark.parametrize("flavor", ["int8", "window"])
+def test_engine_cache_flavors_match_offline(flavor):
+    """The slot-paged engine reuses the Decoder's cache layouts
+    verbatim: int8-quantized entries and sliding-window rings (with
+    rope, plus the ring-position reset on slot reuse) both byte-match
+    their own offline decoder."""
+    rng = np.random.RandomState(5)
+    if flavor == "int8":
+        sym, deckw = _lm(), dict(cache_dtype="int8")
+    else:
+        sym, deckw = _lm(window=6, pos_encoding="rope"), {}
+    params = _init_params(sym, rng)
+    dec = Decoder(sym, params, max_len=T, cache_block=None, **deckw)
+    eng = InferenceEngine(
+        Decoder(sym, params, max_len=T, cache_block=None, **deckw),
+        slots=2, prefill_buckets=(4, 8))
+    reqs = [(p, n, eng.submit(p, max_tokens=n))
+            for pl, n in [(3, 5), (6, 4), (3, 5), (6, 4), (3, 5)]
+            for p in [rng.randint(0, VOCAB, (pl,))]]
+    eng.serve_forever()
+    assert eng.stats["prefills"] > eng.slots  # reuse exercised the reset
+    for p, n, r in reqs:
+        np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
+
+
+def test_window_prefill_pad_rows_do_not_corrupt_ring():
+    """Bucketed prefill on a WINDOWED model: the ring write must honor
+    the true prompt length, not the padded chunk length. Two distinct
+    failure modes hide behind argmax (review finding — the flavor test
+    above can pass by luck): pad rows wrapping into ``p % win`` slots
+    EVICT real in-window keys, and the last-win-chunk-rows tail SKIPS
+    real keys displaced before the pad tail. Compare the padded
+    ``valid_len`` prefill against the exact-length prefill: ring
+    positions, ring K/V, and last-real-position logits must all match
+    exactly (not just the argmax)."""
+    import jax.numpy as jnp_
+
+    rng = np.random.RandomState(12)
+    win = 4
+    sym = _lm(window=win, pos_encoding="rope")
+    params = _init_params(sym, rng)
+    dec = Decoder(sym, params, max_len=T, cache_block=None)
+    P, L = 6, 8                   # 2 pad rows; win < P: both modes bite
+    toks = rng.randint(0, VOCAB, (1, P)).astype(np.int32)
+    padded = np.zeros((1, L), np.int32)
+    padded[0, :P] = toks
+
+    want_logits, want_caches = dec._run(
+        dec._params, dec._aux, dec.init_cache(1), 0,
+        jnp_.asarray(toks))
+    got_logits, got_caches = dec._run(
+        dec._params, dec._aux, dec.init_cache(1), 0,
+        jnp_.asarray(padded), valid_len=jnp_.int32(P))
+
+    np.testing.assert_array_equal(np.asarray(got_logits)[0, P - 1],
+                                  np.asarray(want_logits)[0, P - 1])
+    for want_e, got_e in zip(want_caches, got_caches):
+        # (ck, cv, cpos) float layout under the default cache dtype
+        np.testing.assert_array_equal(np.asarray(got_e[-1]),
+                                      np.asarray(want_e[-1]))  # cpos
+        np.testing.assert_array_equal(np.asarray(got_e[0]),
+                                      np.asarray(want_e[0]))   # K ring
+        np.testing.assert_array_equal(np.asarray(got_e[1]),
+                                      np.asarray(want_e[1]))   # V ring
+
+
+def test_engine_sampling_schedule_independent(lm, shared_engine,
+                                              second_engine):
+    """Sampled outputs depend only on (seed, position): the same
+    request draws the same tokens whatever else is resident and
+    whenever it is admitted (both engines carry different prior slot
+    churn from earlier tests — which must not matter either)."""
+    sym, params, _ = lm
+    rng = np.random.RandomState(6)
+    p = rng.randint(0, VOCAB, (4,))
+    noise = [rng.randint(0, VOCAB, (5,)) for _ in range(2)]
+
+    def run(eng, order):
+        h = None
+        for tag in order:
+            if tag == "x":
+                h = eng.submit(p, max_tokens=6, temperature=0.9, seed=42)
+            else:
+                eng.submit(noise[tag], max_tokens=4, temperature=0.5,
+                           seed=100 + tag)
+            eng.step()
+        eng.serve_forever()
+        return h.result()
+
+    a = run(shared_engine, ["x", 0, 1])
+    b = run(second_engine, [0, 1, "x"])
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (6,) and (a >= 0).all() and (a < VOCAB).all()
+
+
+def test_engine_from_checkpoint_and_estimator(lm, tmp_path):
+    """Checkpoint → engine (InferenceEngine.from_checkpoint) and
+    estimator → engine (FeedForward.as_serving_engine) both serve
+    byte-identically to the offline decoder built from the same
+    weights."""
+    sym, params, dec = lm
+    rng = np.random.RandomState(7)
+    prefix = str(tmp_path / "lm")
+    mx.model.save_checkpoint(
+        prefix, 3, sym,
+        {k: mx.nd.array(np.asarray(v)) for k, v in params.items()}, {})
+    p = rng.randint(0, VOCAB, (4,))
+    want = _oracle(dec, p, 5)
+
+    eng = InferenceEngine.from_checkpoint(prefix, 3, max_len=T, slots=2,
+                                          prefill_buckets=(4, 8))
+    r = eng.submit(p, max_tokens=5)
+    eng.serve_forever()
+    np.testing.assert_array_equal(r.result(), want)
+
+    ff = mx.FeedForward.load(prefix, 3)
+    eng2 = ff.as_serving_engine(max_len=T, slots=2,
+                                prefill_buckets=(4, 8))
+    r2 = eng2.submit(p, max_tokens=5)
+    eng2.serve_forever()
+    np.testing.assert_array_equal(r2.result(), want)
+
+
+def test_engine_serve_forever_arrival_stream(lm, shared_engine):
+    """serve_forever drives an ONLINE arrival process: a generator may
+    yield None ("nothing arrived yet") between submissions and the
+    engine keeps serving residents meanwhile."""
+    sym, params, dec = lm
+    rng = np.random.RandomState(8)
+    prompts = [rng.randint(0, VOCAB, (pl,)) for pl in (3, 6, 2)]
+
+    def arrivals():
+        yield dict(prompt=prompts[0], max_tokens=5)
+        for _ in range(3):
+            yield None                     # engine steps in between
+        yield dict(prompt=prompts[1], max_tokens=5)
+        yield None
+        yield (prompts[2], dict(max_tokens=5))
+
+    done = shared_engine.serve_forever(arrivals())
+    assert len(done) == 3
+    by_len = {len(r.prompt): r for r in done}
+    for p in prompts:
+        np.testing.assert_array_equal(by_len[len(p)].result(),
+                                      _oracle(dec, p, 5))
+
+
+def test_engine_validation(lm, shared_engine):
+    sym, params, dec = lm
+    eng = shared_engine
+    with pytest.raises(MXNetError, match="needs a Decoder"):
+        InferenceEngine(object())
+    with pytest.raises(MXNetError, match="cache_block"):
+        InferenceEngine(Decoder(sym, params, max_len=T, cache_block=8))
+    with pytest.raises(MXNetError, match="ascending"):
+        _engine(sym, params, prefill_buckets=(8, 4))
+    with pytest.raises(MXNetError, match="empty prompt"):
+        eng.submit([], max_tokens=2)
+    with pytest.raises(MXNetError, match="no room"):
+        eng.submit(np.zeros(T, np.int32), max_tokens=2)
+    with pytest.raises(MXNetError, match="largest .* bucket"):
+        eng.submit(np.zeros(9, np.int32), max_tokens=2)  # buckets (4,8)
+    with pytest.raises(MXNetError, match="max_tokens"):
+        eng.submit([1, 2], max_tokens=0)
+    with pytest.raises(MXNetError, match="not finished"):
+        eng.submit([1, 2], max_tokens=2).result()
+    eng.serve_forever()  # leave the shared engine idle
+
+
+def test_generate_temperature_is_traced_operand(lm):
+    """PR satellite: Decoder._gen_jit no longer keys on temperature —
+    a temperature sweep reuses ONE compiled program per
+    (batch, prompt, steps) shape, and the traced greedy path stays
+    byte-identical to before (the offline oracle of every other test
+    here)."""
+    sym, params, dec = lm   # the module decoder: its cache counts too
+    rng = np.random.RandomState(9)
+    p = rng.randint(0, VOCAB, (2, 4))
+    key = jax.random.PRNGKey(0)
+    before = len(dec._gen_jit)
+    greedy = np.asarray(dec.generate(p, 5, temperature=0.0))
+    for temp in (0.5, 2.0):
+        out = np.asarray(dec.generate(p, 5, rng=key, temperature=temp))
+        assert out.shape == greedy.shape
+    assert len(dec._gen_jit) == before + 1  # one new shape, any temp
+    # same key+temperature reproduces; temperature 0 re-matches greedy
+    a = np.asarray(dec.generate(p, 5, rng=key, temperature=0.7))
+    b = np.asarray(dec.generate(p, 5, rng=key, temperature=0.7))
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(
+        greedy, np.asarray(dec.generate(p, 5, temperature=0.0)))

@@ -1,0 +1,96 @@
+"""Serving-engine sweep: slot counts x arrival rates.
+
+Drives ``bench.bench_serving`` (the continuous-batching engine under
+Poisson arrivals with mixed prompt/output lengths) over a grid of
+``slots`` and mean interarrival times, with the same spread-reporting
+discipline as bench_decode: each cell runs ``--reps`` times, reports
+the MEDIAN tokens/s and the relative spread ``(max-min)/median`` —
+a cell whose spread exceeds ~0.2 is dispatch-jitter, not signal
+(doc/performance.md has the relay-measurement story).
+
+Run from the repo root::
+
+    python tools/bench_serving.py                      # 124M, chip
+    python tools/bench_serving.py --layers 2 --embed 64 \
+        --heads 2 --vocab 256 --max-len 256 --requests 24   # smoke/CPU
+
+Prints one JSON dict::
+
+  {"s<slots>_a<arrival_ms>": {"tokens_per_sec": median over reps,
+                              "spread": (max-min)/median,
+                              "p50_ms_per_token": ..., "p99_ms_per_token": ...,
+                              "compile_programs": ...},
+   ..., "config": {...}}
+
+The slot sweep is the capacity knob (decode cost per step is nearly
+flat until the chip saturates, so tokens/s should climb with slots);
+the arrival sweep shows the latency/throughput trade: saturating rates
+maximize tokens/s, sub-saturating rates buy back p99 decode cadence.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, nargs="+", default=[8, 16, 32])
+    ap.add_argument("--arrival-ms", type=float, nargs="+",
+                    default=[1.0, 20.0],
+                    help="mean Poisson interarrival per rate arm "
+                         "(1 ms saturates; larger trades throughput "
+                         "for tail latency)")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--embed", type=int, default=768)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--max-len", type=int, default=1024)
+    ap.add_argument("--requests", type=int, default=96)
+    args = ap.parse_args()
+
+    import bench
+
+    out = {"config": {"layers": args.layers, "embed": args.embed,
+                      "heads": args.heads, "vocab": args.vocab,
+                      "max_len": args.max_len,
+                      "requests": args.requests, "reps": args.reps}}
+    for slots in args.slots:
+        for arrival in args.arrival_ms:
+            reps = []
+            for rep in range(args.reps):
+                # fresh seed per rep: the relay elides value-identical
+                # dispatches (bench.py GEMM-calibration lesson), so a
+                # repeated workload under-measures
+                reps.append(bench.bench_serving(
+                    slots=slots, layers=args.layers, embed=args.embed,
+                    heads=args.heads, vocab=args.vocab,
+                    max_len=args.max_len, n_requests=args.requests,
+                    seed=17 * rep + 3, arrival_ms=arrival))
+            tps = sorted(r["tokens_per_sec"] for r in reps)
+            med = tps[len(tps) // 2]
+            cell = {
+                "tokens_per_sec": med,
+                "spread": None if med == 0
+                else round((tps[-1] - tps[0]) / med, 3),
+                "p50_ms_per_token": float(np.median(
+                    [r["p50_ms_per_token"] for r in reps])),
+                "p99_ms_per_token": float(np.median(
+                    [r["p99_ms_per_token"] for r in reps])),
+                "compile_programs": reps[0]["compile_programs"],
+            }
+            out["s%d_a%g" % (slots, arrival)] = cell
+            print("s%d_a%g: %r" % (slots, arrival, cell),
+                  file=sys.stderr)
+    print(json.dumps(out, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()
