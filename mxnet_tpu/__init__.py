@@ -39,6 +39,7 @@ from . import initializer  # noqa: E402
 from .initializer import Uniform, Normal, Orthogonal, Xavier, MSRAPrelu  # noqa: E402
 from . import lr_scheduler  # noqa: E402
 from . import misc  # noqa: E402
+from . import telemetry  # noqa: E402
 from . import profiler  # noqa: E402
 from . import io  # noqa: E402
 from . import kvstore  # noqa: E402

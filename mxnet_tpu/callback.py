@@ -9,7 +9,11 @@ import logging
 import math
 import time
 
+from . import telemetry as _telemetry
+
 __all__ = ["do_checkpoint", "log_train_metric", "Speedometer", "ProgressBar"]
+
+_TM_SAMPLES_PER_SEC = _telemetry.gauge("train.samples_per_sec")
 
 
 def do_checkpoint(prefix, async_write=False):
@@ -77,6 +81,14 @@ class Speedometer:
     A batch count lower than the previous call means a new epoch
     started; the timer re-arms rather than reporting a bogus speed
     across the epoch boundary.
+
+    Timing uses ``time.perf_counter()`` — ``time.time()`` is wall
+    clock, which can jump (NTP slew/step) and report negative or
+    wildly wrong speeds. A zero elapsed interval (coarse clocks, or a
+    callback invoked twice for one batch) skips the report instead of
+    raising ``ZeroDivisionError``. The measured rate is also published
+    as the ``train.samples_per_sec`` telemetry gauge
+    (doc/observability.md) whenever telemetry is enabled.
     """
 
     def __init__(self, batch_size, frequent=50):
@@ -88,7 +100,7 @@ class Speedometer:
 
     def _rearm(self):
         self.init = True
-        self.tic = time.time()
+        self.tic = time.perf_counter()
 
     def __call__(self, param):
         count = param.nbatch
@@ -100,7 +112,12 @@ class Speedometer:
             return
         if count % self.frequent:
             return
-        speed = self.frequent * self.batch_size / (time.time() - self.tic)
+        elapsed = time.perf_counter() - self.tic
+        if elapsed <= 0:
+            self._rearm()
+            return
+        speed = self.frequent * self.batch_size / elapsed
+        _TM_SAMPLES_PER_SEC.set(speed)
         if param.eval_metric is None:
             logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
                          param.epoch, count, speed)
@@ -113,7 +130,13 @@ class Speedometer:
 
 
 class ProgressBar:
-    """Text progress bar per epoch (reference :92)."""
+    """Text progress bar per epoch (reference :92).
+
+    ``total=0`` (an empty epoch — e.g. a discard-tail iterator whose
+    data is smaller than one batch) draws a full bar instead of
+    dividing by zero, and an overrun count (epoch_size semantics can
+    serve more batches than ``total`` predicted) clamps the bar at
+    ``bar_len`` characters while the percentage keeps counting."""
 
     def __init__(self, total, length=80):
         self.bar_len = length
@@ -121,7 +144,8 @@ class ProgressBar:
 
     def __call__(self, param):
         count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
+        frac = 1.0 if self.total <= 0 else count / float(self.total)
+        filled_len = min(self.bar_len, int(round(self.bar_len * frac)))
+        percents = math.ceil(100.0 * frac)
         prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
         logging.info("[%s] %s%s\r", prog_bar, percents, "%")

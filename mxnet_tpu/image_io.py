@@ -21,8 +21,19 @@ import numpy as np
 from .base import MXNetError
 from .libinfo import get_lib, check_call
 from . import ndarray as nd
+from . import telemetry as tele
 from .io import DataIter, DataBatch
 from . import recordio as rec
+
+# decode-pool metrics (doc/observability.md "IO pipeline"). The
+# per-batch decode time is measured WORKER-side and rides the existing
+# (epoch, batch, slot, pad) announcement tuple back to the consumer —
+# no new shared state; only the consumer process feeds the registry.
+_TM_DECODE_MS = tele.histogram("io.decode_batch_ms")
+_TM_POOL_WAIT_MS = tele.histogram("io.pool_wait_ms")
+_TM_POOL_STARVED = tele.counter("io.pool_starved")
+_TM_POOL_BATCHES = tele.counter("io.pool_batches")
+_TM_POOL_QDEPTH = tele.gauge("io.pool_queue_depth")
 
 __all__ = ["ImageRecordIter", "device_augment_batch",
            "DeviceAugmentIter"]
@@ -696,7 +707,8 @@ def _decode_worker_main(cfg, mean_arr, wid, num_workers, ctl_q, out_q,
     worker_mode='thread'): wait for an epoch command, decode this
     worker's round-robin share of the epoch's batches (batch b goes to
     worker b % num_workers) into the shared slot ring, and announce each
-    as a tiny (epoch, batch_idx, slot, pad) tuple on the bounded queue.
+    as a tiny (epoch, batch_idx, slot, pad, decode_seconds) tuple on the
+    bounded queue.
     A bumped ``gen`` aborts a stale epoch between batches (reset
     mid-epoch); any exception is reported on the queue — loudly — and
     ends the worker."""
@@ -722,8 +734,12 @@ def _decode_worker_main(cfg, mean_arr, wid, num_workers, ctl_q, out_q,
                 if gen.value != epoch:
                     break  # epoch superseded by a reset
                 data, label = slots[produced % len(slots)]
+                tic = _time.perf_counter()
                 _, _, pad = eng.load_batch(order, epoch, b, data, label)
-                out_q.put((epoch, b, produced % len(slots), pad))
+                # decode seconds ride the existing slot message — the
+                # consumer process observes them into io.decode_batch_ms
+                out_q.put((epoch, b, produced % len(slots), pad,
+                           _time.perf_counter() - tic))
                 produced += 1
     except BaseException:
         import traceback
@@ -882,6 +898,7 @@ class _ParallelEngine:
         stale-epoch leftovers; raises on worker failure, death, or
         timeout instead of hanging."""
         deadline = _time.time() + self._timeout
+        tic = _time.perf_counter()
         while True:
             try:
                 item = self._out[wid].get(timeout=0.2)
@@ -905,6 +922,10 @@ class _ParallelEngine:
                                  % (wid, item[1]))
             if item[0] != self.cur_epoch:
                 continue  # leftover from before a reset
+            wait = _time.perf_counter() - tic
+            _TM_POOL_WAIT_MS.observe(wait * 1e3)
+            if wait > 1e-3:  # the pool starved the consumer
+                _TM_POOL_STARVED.inc()
             return item
 
     def next(self):
@@ -912,12 +933,20 @@ class _ParallelEngine:
             return None
         b = self._next_b
         wid = b % self.num_workers
-        epoch, got_b, slot, pad = self._pop(wid)
+        epoch, got_b, slot, pad, decode_s = self._pop(wid)
         if got_b != b:  # pragma: no cover — protocol invariant
             self.close()
             raise MXNetError(
                 "decode pool out of order: expected batch %d from "
                 "worker %d, got %d" % (b, wid, got_b))
+        _TM_DECODE_MS.observe(decode_s * 1e3)
+        _TM_POOL_BATCHES.inc()
+        try:
+            # ready batches still queued behind this one (worker-local
+            # view; a healthy pool keeps this near queue_depth)
+            _TM_POOL_QDEPTH.set(self._out[wid].qsize())
+        except NotImplementedError:  # qsize absent on some platforms
+            pass
         self._next_b += 1
         data, label = self._slots[wid][slot]
         return data, label, pad

@@ -19,12 +19,23 @@ import os
 import struct
 import queue
 import threading
+import time
 
 import numpy as np
 
 from .base import MXNetError
 from . import ndarray as nd
 from .ndarray import NDArray
+from . import telemetry as tele
+
+# pipeline-thread metrics (doc/observability.md "IO pipeline"): fetch =
+# host work done ON the pipeline thread (decode/augment/collate +
+# transform, e.g. the staging device_put dispatch); wait = what the
+# CONSUMER paid because that work wasn't ready — starvation
+_TM_FETCH_MS = tele.histogram("io.pipeline_fetch_ms")
+_TM_WAIT_MS = tele.histogram("io.pipeline_wait_ms")
+_TM_STARVED = tele.counter("io.pipeline_starved")
+_TM_BATCHES = tele.counter("io.pipeline_batches")
 
 __all__ = ["MXDataIter", "DataIter", "DataBatch", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "DevicePrefetchIter", "StagedStream",
@@ -295,9 +306,11 @@ class _PipelineWorker(threading.Thread):
                 self._results.put(None)
                 continue
             try:
+                tic = time.perf_counter()
                 batch = self._it.next()
                 if self._transform is not None:
                     batch = self._transform(batch)
+                _TM_FETCH_MS.observe((time.perf_counter() - tic) * 1e3)
             except StopIteration:
                 exhausted = True
                 batch = None             # epoch-boundary marker
@@ -312,7 +325,17 @@ class _PipelineWorker(threading.Thread):
         not be touched again until restart()."""
         if self._ended:
             return None                  # exhausted, awaiting restart()
+        tic = time.perf_counter()
         batch = self._results.get()
+        if batch is not None and not isinstance(batch, _WorkerFailure):
+            # real batches only: waiting on the epoch-end None marker
+            # (or a failure) is not input starvation — same exemption
+            # the trainer-side input_wait probe applies to StopIteration
+            wait = time.perf_counter() - tic
+            _TM_WAIT_MS.observe(wait * 1e3)
+            if wait > 1e-3:              # consumer actually stalled
+                _TM_STARVED.inc()
+            _TM_BATCHES.inc()
         if isinstance(batch, _WorkerFailure):
             self._ended = True
             self._absorb()

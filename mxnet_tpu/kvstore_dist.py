@@ -42,9 +42,24 @@ import time
 import numpy as np
 
 from .base import MXNetError
+from . import telemetry as tele
 from .kvstore import _bigarray_bound  # single source for the threshold
 
 __all__ = ["PSBackend"]
+
+# transport health metrics (doc/observability.md "kvstore_dist"): a
+# retry storm, a flapping server or a half-open peer shows up here
+# long before the bounded-retry MXNetError does
+_TM_PUSHES = tele.counter("kvstore.pushes")
+_TM_PULLS = tele.counter("kvstore.pulls")
+_TM_PUSH_BYTES = tele.counter("kvstore.push_bytes")
+_TM_PULL_BYTES = tele.counter("kvstore.pull_bytes")
+_TM_RETRIES = tele.counter("kvstore.retries")
+_TM_RECONNECTS = tele.counter("kvstore.reconnects")
+_TM_TIMEOUTS = tele.counter("kvstore.timeouts")
+_TM_DEDUP_HITS = tele.counter("kvstore.dedup_hits")
+_TM_PING_MS = tele.histogram("kvstore.ping_rtt_ms")
+_TM_REQUEST_MS = tele.histogram("kvstore.request_ms")
 
 _LEN = struct.Struct("!Q")
 
@@ -279,6 +294,7 @@ class _Server(threading.Thread):
                     # late): the client only advances seq after its
                     # previous mutating request was applied, so this is
                     # an already-applied duplicate — ack, never re-run
+                    _TM_DEDUP_HITS.inc()
                     return ("ok",)
                 if hit is None or hit[0] != seq:
                     self._dedup[client] = (seq, None)  # ours to execute
@@ -286,6 +302,7 @@ class _Server(threading.Thread):
                         threading.current_thread()
                     return None
                 if hit[1] is not None:
+                    _TM_DEDUP_HITS.inc()
                     return hit[1]  # duplicate of an applied request
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -530,6 +547,7 @@ class PSBackend:
     def _drop_conn_locked(self, server):
         stale = self._conns.pop(server, None)
         if stale is not None:
+            _TM_RECONNECTS.inc()  # next _conn_locked dials fresh
             try:
                 stale.close()
             except OSError:
@@ -543,11 +561,15 @@ class PSBackend:
         if timeout is None:
             timeout = min(5.0, _request_timeout())
         try:
+            tic = time.perf_counter()
             with socket.create_connection(
                     (self.hosts[server], self._port(server)),
                     timeout=timeout) as c:
                 _send_msg(c, ("ping",))
-                return _recv_msg(c)[0] == "ok"
+                ok = _recv_msg(c)[0] == "ok"
+            if ok:
+                _TM_PING_MS.observe((time.perf_counter() - tic) * 1e3)
+            return ok
         except (OSError, EOFError, MXNetError):
             return False
 
@@ -574,6 +596,7 @@ class PSBackend:
         """
         retries = _max_retries()
         backoff = _backoff_base_s()
+        req_t0 = time.perf_counter()
         with self._lock:  # one in-flight request per worker (like the
             self._seq += 1  # engine var serializing pushes)
             envelope = ("req", self._client_id, self._seq, msg)
@@ -594,6 +617,10 @@ class PSBackend:
                     break
                 except (ConnectionError, socket.timeout, OSError) as e:
                     last_err = e
+                    if isinstance(e, socket.timeout):
+                        _TM_TIMEOUTS.inc()
+                    if attempt < retries:
+                        _TM_RETRIES.inc()  # about to resend
                     self._drop_conn_locked(server)
                     # a timeout on an ESTABLISHED connection may just be
                     # a slow server: the heartbeat tells us which
@@ -610,6 +637,7 @@ class PSBackend:
                                        10.0))
             else:  # pragma: no cover - loop always breaks or raises
                 self._raise_dead(server, retries + 1, False, last_err)
+        _TM_REQUEST_MS.observe((time.perf_counter() - req_t0) * 1e3)
         if reply[0] != "ok":
             raise MXNetError("parameter server: %s" % (reply[1],))
         return reply[1] if len(reply) > 1 else None
@@ -663,10 +691,17 @@ class PSBackend:
         value = np.asarray(value)
         for part, (server, sl) in enumerate(self._layout[key]):
             self._request(server, ("push", key, part, value[sl]))
+        # counted after the part loop, like pull: both op/byte counters
+        # mean COMPLETED operations (a push that exhausts its retries
+        # raises without being counted)
+        _TM_PUSHES.inc()
+        _TM_PUSH_BYTES.inc(value.nbytes)
 
     def pull(self, key):
         parts = [self._request(server, ("pull", key, part))
                  for part, (server, _) in enumerate(self._layout[key])]
+        _TM_PULLS.inc()
+        _TM_PULL_BYTES.inc(sum(p.nbytes for p in parts))
         if len(parts) == 1:
             return parts[0]
         return np.concatenate(parts, axis=0)

@@ -23,6 +23,7 @@ from .base import MXNetError
 from . import ndarray as nd
 from .ndarray import NDArray
 from . import symbol as sym
+from . import telemetry as tele
 from .context import Context, cpu, current_context
 from . import optimizer as opt
 from . import metric
@@ -39,6 +40,9 @@ __all__ = ["FeedForward", "save_checkpoint", "load_checkpoint",
 BASE_ESTIMATOR = object
 BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
+
+_TM_DEVICE_MS = tele.histogram("train.device_wait_ms")
+_TM_CKPT_MS = tele.histogram("checkpoint.write_ms")
 
 
 def _create_kvstore(kvstore, num_device, arg_params):
@@ -438,13 +442,19 @@ def _train_fused(symbol, ctx, arg_params, aux_params, begin_epoch,
     staged.reset()
     for epoch in range(begin_epoch, end_epoch):
         tic = time.time()
+        ep_t0 = time.perf_counter()
         eval_metric.reset()
         nbatch = 0
         while True:
             do_reset = True
             for data_batch, dev_batch in staged:
                 outs = trainer.step(dev_batch)
+                # blocked-on-device: the host stalls HERE, fetching the
+                # step's outputs for the metric (step() itself only
+                # dispatched)
+                fw_t0 = time.perf_counter()
                 out_nds = [nd.array(np.asarray(o)) for o in outs]
+                _TM_DEVICE_MS.observe((time.perf_counter() - fw_t0) * 1e3)
                 eval_metric.update(data_batch.label, out_nds)
                 nbatch += 1
                 if batch_end_callback is not None:
@@ -461,6 +471,9 @@ def _train_fused(symbol, ctx, arg_params, aux_params, begin_epoch,
                 staged.reset()
             if epoch_size is None or nbatch >= epoch_size:
                 break
+        tele.trace_complete("train.epoch", ep_t0,
+                            time.perf_counter() - ep_t0,
+                            args={"epoch": epoch})
         toc = time.time()
         logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
 
@@ -635,6 +648,7 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     # state
     states_name = local[:-len(".params")] + ".states" \
         if local.endswith(".params") else local + ".states"
+    ckpt_t0 = time.perf_counter()
     with _save_lock_for(prefix):
         # symbol.json is atomic like .params/.states: a crash mid-write
         # must not leave a truncated symbol file that breaks every
@@ -672,6 +686,10 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                 with _SAVE_LOCKS_GUARD:
                     _STATES_PUBLISHED.add(os.path.abspath(states_name))
         _publish(param_name, lambda p: nd.save(p, save_dict))
+    ckpt_dt = time.perf_counter() - ckpt_t0
+    _TM_CKPT_MS.observe(ckpt_dt * 1e3)
+    tele.trace_complete("checkpoint.save", ckpt_t0, ckpt_dt,
+                        args={"epoch": epoch})
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 

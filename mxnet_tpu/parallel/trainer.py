@@ -35,6 +35,7 @@ from .. import ndarray as nd
 from ..ndarray import NDArray
 from .. import optimizer as opt_mod
 from .. import metric as metric_mod
+from .. import telemetry as tele
 from ..initializer import Uniform
 from .graph import make_graph_fn, integer_semantic_inputs
 from .mesh import local_mesh
@@ -42,6 +43,16 @@ from .shard import ShardingRules, P
 from .optim import make_functional
 
 __all__ = ["ParallelTrainer"]
+
+# pre-resolved telemetry handles (doc/observability.md "trainer"): the
+# per-event cost on the hot step path is one flag check + one lock'd add
+_TM_STEPS = tele.counter("train.steps")
+_TM_STEP_MS = tele.histogram("train.step_ms")          # dispatch (+device
+# time on backends where dispatch blocks, e.g. the cpu CI mesh)
+_TM_INPUT_MS = tele.histogram("train.input_wait_ms")   # blocked-on-input
+_TM_DEVICE_MS = tele.histogram("train.device_wait_ms")  # blocked-on-device
+_TM_H2D_BYTES = tele.counter("train.h2d_bytes")
+_TM_COMPILES = tele.counter("train.compiles")
 
 
 def _as_jnp(v):
@@ -85,7 +96,15 @@ class _StagedStream:
         return self
 
     def __next__(self):
-        return self._stream.next()
+        # blocked-on-input: everything the consumer thread waits on for
+        # the next staged batch (decode pool, host collate, h2d
+        # dispatch). Epoch ends (StopIteration) are not a wait sample.
+        t0 = time.perf_counter()
+        out = self._stream.next()
+        dt = time.perf_counter() - t0
+        _TM_INPUT_MS.observe(dt * 1e3)
+        tele.trace_complete("io.input_wait", t0, dt, cat="io")
+        return out
 
 
 class ParallelTrainer:
@@ -315,6 +334,7 @@ class ParallelTrainer:
         self._jit_step = None
         self._jit_multi = {}  # num_steps -> compiled scan-of-steps
         self._jit_eval = None
+        self._h2d_batch_bytes = None  # telemetry: computed on first stage
         # buffer donation for the carried train state; flipped off at
         # runtime if this jaxlib miscompiles the alias table (see
         # _disable_donation_or_reraise)
@@ -461,7 +481,19 @@ class ParallelTrainer:
             new_state[name] = s
         return new_params, new_state, list(new_aux), list(outs)
 
+    def _shape_key(self):
+        """Stable signature of the inputs this trainer compiles for —
+        the recompile discriminator surfaced on compile events."""
+        return ",".join("%s:%s" % (k, "x".join(map(str, v)))
+                        for k, v in sorted(self.input_shapes.items()))
+
+    def _note_compile(self, kind, **extra):
+        _TM_COMPILES.inc()
+        tele.mark("train.compile", kind=kind, shapes=self._shape_key(),
+                  **extra)
+
     def _build_step(self):
+        self._note_compile("step")
         in_sh = (self._param_sh, self._opt_sh, None,
                  self._data_sh, self._repl, self._repl, self._repl)
         out_sh = (self._param_sh, self._opt_sh, None, None)
@@ -470,6 +502,8 @@ class ParallelTrainer:
                        donate_argnums=(0, 1, 2) if self._donate else ())
 
     def _build_eval(self):
+        self._note_compile("eval")
+
         def run(params, aux, batch, rng):
             vals = [params[n] if n in params else batch[n]
                     for n in self.arg_names]
@@ -519,6 +553,15 @@ class ParallelTrainer:
         per-batch dispatch is pure overhead (the CI path), so jit
         places lazily."""
         out = self._shard_batch(batch, what)
+        # bytes handed to the h2d edge (staged now, or lazily placed at
+        # jit dispatch on the cpu backend — either way infeed traffic).
+        # Computed once: batch geometry is fixed per trainer, and
+        # jax.Array.nbytes costs ~12 µs per array — per-step that would
+        # dwarf every other probe on this path
+        if self._h2d_batch_bytes is None:
+            self._h2d_batch_bytes = sum(getattr(v, "nbytes", 0)
+                                        for v in out.values())
+        _TM_H2D_BYTES.inc(self._h2d_batch_bytes)
         if jax.default_backend() == "cpu":
             return out
         return {k: (v if isinstance(v, jax.Array)
@@ -597,7 +640,10 @@ class ParallelTrainer:
         else:
             lr = self.optimizer.lr
         # numpy scalars (not jnp) keep this dispatch-only — no eager
-        # device ops on the host critical path
+        # device ops on the host critical path; the telemetry probe is
+        # two perf_counter reads + one histogram add (host-side, no
+        # sync), pinned < 2% by bench.py's overhead arm
+        t0 = time.perf_counter()
         with self.mesh:
             try:
                 self.params, self.opt_state, self.aux, outs = \
@@ -611,6 +657,10 @@ class ParallelTrainer:
                     self._jit_step(self.params, self.opt_state, self.aux,
                                    batch, np.float32(lr),
                                    np.int32(self._t), self._rng)
+        dt = time.perf_counter() - t0
+        _TM_STEPS.inc()
+        _TM_STEP_MS.observe(dt * 1e3)
+        tele.trace_complete("train.step", t0, dt)
         return outs
 
     def _disable_donation_or_reraise(self, err):
@@ -643,6 +693,8 @@ class ParallelTrainer:
         self._jit_multi.clear()
 
     def _build_multi_step(self, num_steps):
+        self._note_compile("multi_step", num_steps=num_steps)
+
         def run(params, opt_state, aux, batch, lrs, t0, rng_base):
             def body(carry, lr_i):
                 p, s, a = carry
@@ -818,6 +870,7 @@ class ParallelTrainer:
             eval_metric.reset()
             acc_state = _zero_state() if device_metric else None
             tic = time.time()
+            ep_t0 = time.perf_counter()
             for nbatch, (dbatch, dev_batch) in enumerate(staged):
                 outs = self.step(dev_batch)
                 if device_metric:
@@ -859,12 +912,20 @@ class ParallelTrainer:
                                 "if the symbol emits raw logits.",
                                 float(row.sum()))
                 else:
+                    # this fetch is where the host actually BLOCKS on
+                    # the device finishing step nbatch
+                    fw_t0 = time.perf_counter()
                     out_nds = [nd.array(np.asarray(o)) for o in outs]
+                    _TM_DEVICE_MS.observe(
+                        (time.perf_counter() - fw_t0) * 1e3)
                     eval_metric.update(dbatch.label, out_nds)
                 if batch_end_callback is not None:
                     _run_callbacks(batch_end_callback, BatchEndParam(
                         epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
                         locals=locals()))
+            tele.trace_complete("train.epoch", ep_t0,
+                                time.perf_counter() - ep_t0,
+                                args={"epoch": epoch})
             if device_metric:
                 msum, total = (float(acc_state[0]),
                                float(acc_state[1]))  # ONE host sync

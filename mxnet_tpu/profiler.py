@@ -21,6 +21,8 @@ import contextlib
 
 import jax
 
+from . import telemetry
+
 _state = {"dir": None, "running": False}
 
 
@@ -48,9 +50,15 @@ def stop():
 
 @contextlib.contextmanager
 def scope(name: str):
-    """Annotate a named region; nests inside an active trace."""
+    """Annotate a named region: an XLA ``TraceAnnotation`` (shows up in
+    the ``mx.profiler.start``/TensorBoard device trace) AND an
+    ``mx.telemetry`` span (shows up in the ``MXNET_TRACE_DIR``
+    host-side Chrome trace) — one ``with`` statement marks the region
+    in both captures, so device and host timelines can be lined up in
+    Perfetto by name. See doc/observability.md."""
     with jax.profiler.TraceAnnotation(name):
-        yield
+        with telemetry.span(name, cat="profiler.scope"):
+            yield
 
 
 def device_memory_profile() -> bytes:

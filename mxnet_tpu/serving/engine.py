@@ -49,10 +49,35 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..base import MXNetError
+from .. import telemetry as tele
 from ..io import StagedStream
 from ..parallel.decode import Decoder
 
 __all__ = ["InferenceEngine", "Request"]
+
+# per-request serving stats (doc/observability.md "serving"): all
+# host-side perf_counter arithmetic on values the scheduler already
+# tracks — nothing new crosses the device boundary
+_TM_QUEUE_WAIT_MS = tele.histogram("serving.queue_wait_ms")
+_TM_TTFT_MS = tele.histogram("serving.ttft_ms")
+_TM_CADENCE_MS = tele.histogram("serving.token_cadence_ms")
+_TM_TOKENS = tele.counter("serving.tokens")
+_TM_COMPLETED = tele.counter("serving.completed")
+_TM_RETIRED_EOS = tele.counter("serving.retired_eos")
+_TM_RETIRED_LENGTH = tele.counter("serving.retired_length")
+_TM_ROUNDS = tele.counter("serving.rounds")
+_TM_PREFILLS = tele.counter("serving.prefills")
+_TM_ADMITTED = tele.histogram(
+    "serving.admitted_per_round", buckets=(0, 1, 2, 4, 8, 16, 32, 64))
+_TM_SLOTS_BUSY = tele.histogram(
+    "serving.slots_busy_per_round",
+    buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128))
+_TM_OCCUPANCY = tele.gauge("serving.slot_occupancy")
+# compile_counts re-exported as telemetry: the in-engine log stays the
+# tested contract; these make recompiles visible in ONE snapshot next
+# to everything else
+_TM_COMPILE_DECODE = tele.counter("serving.compiles_decode")
+_TM_COMPILE_PREFILL = tele.counter("serving.compiles_prefill")
 
 
 class Request:
@@ -62,9 +87,13 @@ class Request:
     ``tokens`` fills in as output drains: generated ids only (no
     prompt echo), including ``eos_id`` when hit. ``done`` flips when
     the sequence retires; ``result()`` returns the tokens as int32
-    numpy. Latency probes: ``t_submit``/``t_first``/``t_done``
-    (perf_counter seconds; first = first token DRAINED, i.e. visible
-    to the caller, not merely computed).
+    numpy. Latency probes: ``t_submit``/``t_admit``/``t_first``/
+    ``t_done`` (perf_counter seconds; admit = slot assigned + prefill
+    dispatched; first = first token DRAINED, i.e. visible to the
+    caller, not merely computed). ``retire_reason`` is ``"eos"`` or
+    ``"length"`` once done. The same breakdown feeds the
+    ``serving.*`` telemetry histograms (queue wait / TTFT / per-token
+    cadence — doc/observability.md).
     """
 
     def __init__(self, rid, prompt, max_tokens, eos_id, temperature,
@@ -79,8 +108,10 @@ class Request:
         self.tokens = []
         self.done = False
         self.t_submit = time.perf_counter()
+        self.t_admit = None
         self.t_first = None
         self.t_done = None
+        self.retire_reason = None
 
     def result(self):
         if not self.done:
@@ -306,6 +337,7 @@ class InferenceEngine:
 
         def step(params, aux, caches, state):
             self._compile_log.append("decode")  # trace-time, see above
+            _TM_COMPILE_DECODE.inc()
 
             def body(carry, _):
                 caches, st = carry
@@ -325,6 +357,7 @@ class InferenceEngine:
             def prefill(params, aux, caches, state, slot, tokens,
                         true_len, temp, key, eos, max_toks):
                 self._compile_log.append(("prefill", bucket))
+                _TM_COMPILE_PREFILL.inc()
                 pos, tok, live, temps, keys, eoss, lasts = state
                 sub = dec.slot_slice(caches, slot)
                 # ring-position reset: a recycled slot must not leak
@@ -451,8 +484,9 @@ class InferenceEngine:
     def _admit(self):
         """Fill freed slots from the staged queue: one prefill dispatch
         per admission, between device steps (iteration-level
-        scheduling)."""
+        scheduling). Returns how many requests were admitted."""
         params, aux = self._dec._params, self._dec._aux
+        admitted = 0
         while self._free:
             try:
                 req, dev = self._stager.next()
@@ -461,14 +495,22 @@ class InferenceEngine:
             slot = self._free.popleft()
             bucket = int(dev.shape[1])
             fn = self._prefill_fn(bucket)
-            self._caches, self._state, t0 = fn(
-                params, aux, self._caches, self._state,
-                np.int32(slot), dev, np.int32(len(req.prompt)),
-                np.float32(req.temperature), _raw_key(req.seed),
-                np.int32(-1 if req.eos_id is None else req.eos_id),
-                np.int32(req.limit))
+            req.t_admit = time.perf_counter()
+            _TM_QUEUE_WAIT_MS.observe(
+                (req.t_admit - req.t_submit) * 1e3)
+            with tele.span("serving.prefill", cat="serving",
+                           bucket=bucket, slot=slot):
+                self._caches, self._state, t0 = fn(
+                    params, aux, self._caches, self._state,
+                    np.int32(slot), dev, np.int32(len(req.prompt)),
+                    np.float32(req.temperature), _raw_key(req.seed),
+                    np.int32(-1 if req.eos_id is None else req.eos_id),
+                    np.int32(req.limit))
             self._drain.append(("prefill", req, slot, t0))
             self.stats["prefills"] += 1
+            _TM_PREFILLS.inc()
+            admitted += 1
+        return admitted
 
     def _busy(self):
         return (self.slots - len(self._free)) > 0 or bool(self._pending) \
@@ -480,11 +522,20 @@ class InferenceEngine:
         req.tokens.append(int(t))
         if req.t_first is None:
             req.t_first = now
+            _TM_TTFT_MS.observe((now - req.t_submit) * 1e3)
         self.stats["tokens"] += 1
-        if (req.eos_id is not None and t == req.eos_id) \
-                or len(req.tokens) >= req.limit:
+        _TM_TOKENS.inc()
+        hit_eos = req.eos_id is not None and t == req.eos_id
+        if hit_eos or len(req.tokens) >= req.limit:
             req.done = True
             req.t_done = now
+            req.retire_reason = "eos" if hit_eos else "length"
+            (_TM_RETIRED_EOS if hit_eos else _TM_RETIRED_LENGTH).inc()
+            _TM_COMPLETED.inc()
+            if len(req.tokens) > 1:
+                _TM_CADENCE_MS.observe(
+                    (req.t_done - req.t_first)
+                    / (len(req.tokens) - 1) * 1e3)
             self._mirror[slot] = None
             self._free.append(slot)
             self.stats["completed"] += 1
@@ -512,13 +563,25 @@ class InferenceEngine:
         is in flight). Returns the requests COMPLETED by this round,
         in completion order."""
         done_now = []
-        self._admit()
-        if (self.slots - len(self._free)) > 0:
-            self._caches, self._state, out = self._step_fn(
-                self._dec._params, self._dec._aux,
-                self._caches, self._state)
+        admitted = self._admit()
+        busy = self.slots - len(self._free)
+        _TM_OCCUPANCY.set(busy)
+        if admitted or busy:
+            # zero-admission rounds COUNT while work is resident (they
+            # are what admission starvation looks like — the histogram's
+            # 0 bucket exists for them); only fully-idle polls are
+            # not a scheduling round
+            _TM_ADMITTED.observe(admitted)
+        if busy > 0:
+            with tele.span("serving.decode_round", cat="serving",
+                           slots_busy=busy):
+                self._caches, self._state, out = self._step_fn(
+                    self._dec._params, self._dec._aux,
+                    self._caches, self._state)
             self._drain.append(("step", out))
             self.stats["steps"] += 1
+            _TM_ROUNDS.inc()
+            _TM_SLOTS_BUSY.observe(busy)
         while len(self._drain) > (self._drain_depth if self._busy()
                                   else 0):
             self._drain_one(done_now)

@@ -47,16 +47,24 @@ def backend(monkeypatch):
 def test_ping_heartbeat(backend):
     """The ping op answers while the server lives and stops answering
     the instant it is killed — the dead-vs-slow discriminator."""
+    rtt0 = mx.telemetry.histogram("kvstore.ping_rtt_ms").count
     assert backend._ping(0)
     with faults.server_down(backend):
         assert not backend._ping(0)
     assert backend._ping(0)  # successor answers again
+    # only the SUCCESSFUL probes record a heartbeat RTT sample
+    assert mx.telemetry.histogram("kvstore.ping_rtt_ms").count \
+        == rtt0 + 2
 
 
 def test_sever_reconnect_retry(backend):
     """A connection severed mid-request is transparently reconnected
-    and the request retried — exactly once applied."""
+    and the request retried — exactly once applied. The retry storm is
+    visible in telemetry (ISSUE 4 acceptance: a fault-injection run
+    produces a non-trivial kvstore snapshot)."""
     import pickle
+    retries0 = mx.telemetry.counter("kvstore.retries").value
+    reconn0 = mx.telemetry.counter("kvstore.reconnects").value
     backend.init(1, np.zeros(4))
     backend.set_optimizer(pickle.dumps(_accumulate))
     inj = faults.FaultInjector(seed=1)
@@ -64,6 +72,11 @@ def test_sever_reconnect_retry(backend):
         backend.push(1, np.ones(4))
     assert [k for k, _ in inj.log] == ["sever"]
     np.testing.assert_allclose(backend.pull(1), 1.0)
+    snap = mx.telemetry.snapshot()["kvstore"]
+    assert snap["retries"] > retries0
+    assert snap["reconnects"] > reconn0
+    assert snap["pushes"] >= 1 and snap["push_bytes"] >= 4 * 8
+    assert snap["pulls"] >= 1 and snap["pull_bytes"] > 0
 
 
 def test_dropped_frame_times_out_then_retries(backend):
@@ -71,6 +84,7 @@ def test_dropped_frame_times_out_then_retries(backend):
     resends and the value lands once."""
     backend.init(2, np.zeros(3))
     inj = faults.FaultInjector(seed=2)
+    timeouts0 = mx.telemetry.counter("kvstore.timeouts").value
     t0 = time.time()
     with inj.drop_sends(1):
         backend.push(2, np.full(3, 7.0))
@@ -78,6 +92,7 @@ def test_dropped_frame_times_out_then_retries(backend):
     assert time.time() - t0 >= 1.0
     assert ("drop", "push") in inj.log
     np.testing.assert_allclose(backend.pull(2), 7.0)
+    assert mx.telemetry.counter("kvstore.timeouts").value > timeouts0
 
 
 def test_lost_reply_not_double_applied(backend):
@@ -88,10 +103,13 @@ def test_lost_reply_not_double_applied(backend):
     backend.init(3, np.zeros(5))
     backend.set_optimizer(pickle.dumps(_accumulate))
     inj = faults.FaultInjector(seed=3)
+    dedup0 = mx.telemetry.counter("kvstore.dedup_hits").value
     with inj.drop_replies(1):
         backend.push(3, np.ones(5))
     assert ("drop_reply", "push") in inj.log
     np.testing.assert_allclose(backend.pull(3), 1.0)
+    # the retried request was answered from the dedup cache — counted
+    assert mx.telemetry.counter("kvstore.dedup_hits").value > dedup0
 
 
 _SLOW_CALLS = []

@@ -111,6 +111,24 @@ def test_engine_mixed_lengths_slot_reuse_byte_identical(lm):
         np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
     assert eng.compile_counts == {"decode": 1, "prefill": {4: 1, 8: 1}}
 
+    # PR 4 (telemetry): the per-request latency breakdown is fully
+    # populated and ordered; every request here retires on its token
+    # budget. The registry (global, shared across tests) must carry a
+    # non-trivial serving snapshot — lower bounds, not exact counts.
+    for p, n, r in reqs:
+        assert r.t_admit is not None and r.retire_reason == "length"
+        assert r.t_submit <= r.t_admit <= r.t_first <= r.t_done
+    snap = mx.telemetry.snapshot()["serving"]
+    assert snap["ttft_ms"]["count"] >= len(cases)
+    assert snap["queue_wait_ms"]["count"] >= len(cases)
+    assert snap["token_cadence_ms"]["count"] >= 1
+    assert snap["tokens"] >= sum(n for _, n in cases)
+    assert snap["retired_length"] >= len(cases)
+    assert snap["slots_busy_per_round"]["count"] >= 1
+    # compile_counts re-exported as telemetry (trace-time increments)
+    assert snap["compiles_decode"] >= 1
+    assert snap["compiles_prefill"] >= 2     # buckets 4 and 8
+
     # second wave on the SAME engine: zero new compiles, still exact
     wave2 = [(p, n, eng.submit(p, max_tokens=n))
              for pl, n in [(2, 5), (4, 6), (7, 3)]
@@ -192,6 +210,10 @@ def test_engine_eos_limits_and_truncation(lm, shared_engine):
     np.testing.assert_array_equal(r_one.result(), full[:1])
     assert len(r_cap.tokens) == T - len(p)
     np.testing.assert_array_equal(r_cap.result(), full)
+    # telemetry satellite: the retirement reason names WHY each ended
+    assert r_eos.retire_reason == "eos"
+    assert r_one.retire_reason == "length"
+    assert r_cap.retire_reason == "length"
 
 
 def test_engine_backpressure(lm):

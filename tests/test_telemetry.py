@@ -1,0 +1,349 @@
+"""Unified runtime telemetry (mxnet_tpu/telemetry.py): registry
+semantics (counter/gauge/histogram), snapshot/prometheus shapes, Chrome
+trace_event capture validity + span nesting, thread safety, and the
+observability satellites (Speedometer/ProgressBar robustness,
+EvalMetric.get on an empty accumulator).
+
+Everything here is host-side; the single compiled program in this file
+is ONE tiny fused-trainer fit (the acceptance capture: trainer + IO
+pipeline spans nested in one trace) — the registry itself never touches
+the device. The registry is process-global and other test files feed it
+too, so assertions are delta-based or lower bounds, never exact totals.
+"""
+import json
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry as tele
+from mxnet_tpu.base import MXNetError
+
+
+# -- registry semantics ------------------------------------------------
+
+def test_counter_gauge_histogram_semantics():
+    c = tele.counter("t9.count")
+    v0 = c.value
+    c.inc()
+    c.inc(41)
+    assert c.value == v0 + 42
+    assert tele.counter("t9.count") is c  # get-or-create returns THE one
+
+    g = tele.gauge("t9.gauge")
+    g.set(3)
+    g.set(2.5)
+    assert g.value == 2.5
+
+    h = tele.histogram("t9.hist", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 5000.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(5055.5)
+    snap = h._snap()
+    assert snap["min"] == 0.5 and snap["max"] == 5000.0
+    # le semantics: 0.5→le=1, 5→le=10, 50→le=100, 5000→+Inf
+    assert snap["buckets"] == {"1": 1, "10": 1, "100": 1, "+Inf": 1}
+    assert h.percentile(0.5) == 10.0        # bucket upper bound
+    assert h.percentile(0.99) == 5000.0     # +inf bucket reports max
+
+
+def test_registry_type_conflict_raises():
+    tele.counter("t9.conflict")
+    with pytest.raises(MXNetError, match="already registered"):
+        tele.gauge("t9.conflict")
+
+
+def test_enable_disable_is_a_no_op_switch():
+    c = tele.counter("t9.toggle")
+    v0 = c.value
+    try:
+        tele.enable(False)
+        assert not tele.enabled()
+        c.inc(100)
+        tele.gauge("t9.toggle_g").set(7)
+        h = tele.histogram("t9.toggle_h")
+        h.observe(1.0)
+        assert c.value == v0                  # nothing recorded
+        assert tele.gauge("t9.toggle_g").value == 0.0
+        assert h.count == 0
+    finally:
+        tele.enable(True)
+    c.inc()
+    assert c.value == v0 + 1                  # collection resumed
+
+
+def test_thread_safety_counter_and_histogram():
+    c = tele.counter("t9.mt_count")
+    h = tele.histogram("t9.mt_hist")
+    v0, n0 = c.value, h.count
+    N, T = 5000, 8
+
+    def work():
+        for i in range(N):
+            c.inc()
+            h.observe(i % 7)
+
+    threads = [threading.Thread(target=work) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # CPython += is NOT atomic across threads — the per-metric lock is
+    # what makes these exact
+    assert c.value == v0 + N * T
+    assert h.count == n0 + N * T
+
+
+# -- snapshot / prometheus shapes --------------------------------------
+
+def test_snapshot_nested_shape():
+    tele.counter("t9.snapshot.a").inc(3)
+    tele.gauge("t9.snapshot.b").set(1.5)
+    tele.histogram("t9.snapshot.c").observe(2.0)
+    snap = tele.snapshot()
+    node = snap["t9"]["snapshot"]
+    assert node["a"] >= 3
+    assert node["b"] == 1.5
+    assert node["c"]["count"] >= 1
+    assert set(node["c"]) >= {"count", "sum", "mean", "min", "max",
+                              "p50", "p99", "buckets"}
+
+
+def test_snapshot_name_collisions_fall_back_to_flat_keys():
+    """A metric whose dotted name extends ANOTHER metric's name must
+    not merge into that metric's snapshot dict (review finding: a
+    histogram's snap is a dict, and naive traversal descended into
+    it)."""
+    h = tele.histogram("t9.coll.y")
+    h.observe(1.0)
+    tele.counter("t9.coll.y.z").inc(5)
+    snap = tele.snapshot()
+    y = snap["t9"]["coll"]["y"]
+    assert "z" not in y                   # histogram left unpolluted
+    assert y["count"] >= 1
+    assert snap["t9.coll.y.z"] == 5       # flat-key fallback
+
+
+def test_start_trace_rejects_file_path_without_crashing_import(
+        tmp_path):
+    """start_trace on a path occupied by a plain file raises a clear
+    MXNetError (review finding: os.makedirs raised a bare
+    FileExistsError, and via MXNET_TRACE_DIR that aborted
+    `import mxnet_tpu` itself — the import-time arm now guards)."""
+    f = tmp_path / "taken"
+    f.write_text("not a directory")
+    with pytest.raises(MXNetError, match="not a directory"):
+        tele.start_trace(str(f))
+    assert not tele.tracing()
+
+
+def test_to_prometheus_exposition():
+    tele.counter("t9.prom.events").inc(2)
+    tele.gauge("t9.prom.depth").set(4)
+    tele.histogram("t9.prom.lat_ms").observe(3.0)
+    text = tele.to_prometheus()
+    assert "# TYPE mxnet_t9_prom_events_total counter" in text
+    assert "# TYPE mxnet_t9_prom_depth gauge" in text
+    assert "# TYPE mxnet_t9_prom_lat_ms histogram" in text
+    assert 'mxnet_t9_prom_lat_ms_bucket{le="+Inf"}' in text
+    assert "mxnet_t9_prom_lat_ms_count" in text
+    # bucket series must be CUMULATIVE: +Inf equals _count
+    lines = dict(l.rsplit(" ", 1) for l in text.splitlines()
+                 if l.startswith("mxnet_t9_prom_lat_ms"))
+    assert lines['mxnet_t9_prom_lat_ms_bucket{le="+Inf"}'] == \
+        lines["mxnet_t9_prom_lat_ms_count"]
+
+
+# -- trace capture -----------------------------------------------------
+
+def test_trace_file_is_valid_chrome_trace_with_nesting(tmp_path):
+    path = tele.start_trace(str(tmp_path))
+    try:
+        with tele.span("t9.outer", cat="test"):
+            with tele.span("t9.inner", cat="test", hist=None, tag=1):
+                time.sleep(0.001)
+        tele.mark("t9.point", cat="test", detail="x")
+    finally:
+        out = tele.stop_trace()
+    assert out == path
+    doc = json.load(open(out))          # hard JSON validity
+    evs = doc["traceEvents"]
+    by_name = {e["name"]: e for e in evs}
+    assert {"t9.outer", "t9.inner", "t9.point"} <= set(by_name)
+    for e in evs:
+        assert e["ph"] in ("X", "i")
+        assert e["ts"] >= 0 and "pid" in e and "tid" in e
+    outer, inner = by_name["t9.outer"], by_name["t9.inner"]
+    assert inner["ph"] == "X" and outer["ph"] == "X"
+    # positional nesting: inner's [ts, ts+dur] inside outer's, same tid
+    assert inner["tid"] == outer["tid"]
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+    assert by_name["t9.point"]["ph"] == "i"
+    assert by_name["t9.inner"]["args"] == {"tag": 1}
+    # disarmed: spans are no-ops again
+    with tele.span("t9.after"):
+        pass
+    assert not tele.tracing()
+
+
+def test_span_feeds_histogram_and_profiler_scope_combines():
+    h = tele.histogram("t9.span_ms")
+    n0 = h.count
+    with tele.span("t9.timed", hist=h):
+        time.sleep(0.002)
+    assert h.count == n0 + 1
+    assert h.sum >= 1.0  # slept ~2ms, recorded in ms
+    # profiler.scope is now a combined XLA-annotation + telemetry span:
+    # under an armed capture it must land in the trace buffer
+    tele.start_trace(str(__import__("tempfile").mkdtemp()))
+    try:
+        with mx.profiler.scope("t9.scope_region"):
+            pass
+        names = [e["name"] for e in tele._state.trace_events]
+        assert "t9.scope_region" in names
+    finally:
+        tele.stop_trace()
+
+
+def test_reporter_logs_summaries():
+    log = logging.getLogger("t9.reporter")
+    records = []
+
+    class _Grab(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    grab = _Grab()
+    log.addHandler(grab)
+    log.setLevel(logging.INFO)
+    tele.counter("t9.reporter_events").inc(5)
+    try:
+        tele.start_reporter(0.02, logger=log)
+        deadline = time.time() + 2.0
+        while not records and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        tele.stop_reporter()
+        log.removeHandler(grab)
+    assert records and "t9.reporter_events=5" in records[0]
+
+
+# -- the acceptance capture: trainer + IO pipeline in ONE trace --------
+
+def test_fused_trainer_capture_has_nested_train_and_io_spans(tmp_path):
+    """ISSUE 4 acceptance: one capture around a fused-trainer fit
+    contains train.epoch/train.step spans AND io.input_wait spans from
+    the staged input stream, positionally nested inside the epoch span
+    — and the registry holds a non-trivial trainer breakdown (steps,
+    input-wait vs device-wait, h2d bytes, compile events)."""
+    from mxnet_tpu import parallel as par
+
+    data = mx.symbol.Variable("data")
+    fc = mx.symbol.FullyConnected(data=data, num_hidden=3, name="fc")
+    sym = mx.symbol.SoftmaxOutput(data=fc, name="softmax")
+    X = np.random.RandomState(0).rand(16, 4).astype(np.float32)
+    y = (np.arange(16) % 3).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+
+    steps0 = tele.counter("train.steps").value
+    h2d0 = tele.counter("train.h2d_bytes").value
+    compiles0 = tele.counter("train.compiles").value
+    inw0 = tele.histogram("train.input_wait_ms").count
+    devw0 = tele.histogram("train.device_wait_ms").count
+
+    path = tele.start_trace(str(tmp_path))
+    try:
+        trainer = par.ParallelTrainer(
+            sym, {"data": (8, 4), "softmax_label": (8,)},
+            optimizer="sgd", mesh=par.data_parallel_mesh(1))
+        trainer.init_params()
+        trainer.fit(it, num_epoch=1)
+    finally:
+        tele.stop_trace()
+
+    # snapshot: the per-step wall split the ISSUE names
+    assert tele.counter("train.steps").value == steps0 + 2
+    assert tele.counter("train.h2d_bytes").value > h2d0
+    assert tele.counter("train.compiles").value > compiles0
+    assert tele.histogram("train.input_wait_ms").count >= inw0 + 2
+    assert tele.histogram("train.device_wait_ms").count >= devw0 + 2
+
+    doc = json.load(open(path))
+    evs = doc["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert {"train.epoch", "train.step", "io.input_wait"} <= names
+    assert "train.compile" in names          # compile event w/ shape key
+    comp = next(e for e in evs if e["name"] == "train.compile")
+    assert "data:8x4" in comp["args"]["shapes"]
+    epoch = next(e for e in evs if e["name"] == "train.epoch")
+
+    def nested(e):
+        return (e["tid"] == epoch["tid"] and e["ts"] >= epoch["ts"]
+                and e["ts"] + e["dur"] <= epoch["ts"] + epoch["dur"])
+
+    assert any(nested(e) for e in evs if e["name"] == "io.input_wait")
+    assert any(nested(e) for e in evs if e["name"] == "train.step")
+
+
+# -- satellites: callback + metric robustness --------------------------
+
+def _bep(nbatch, eval_metric=None):
+    return mx.model.BatchEndParam(epoch=0, nbatch=nbatch,
+                                  eval_metric=eval_metric, locals={})
+
+
+def test_speedometer_uses_perf_counter_and_guards_zero_elapsed(
+        monkeypatch):
+    from mxnet_tpu import callback
+    s = callback.Speedometer(batch_size=10, frequent=1)
+    s(_bep(1))                     # arms the timer
+    # freeze the clock: elapsed becomes exactly 0 — the old
+    # time.time() code divided by it (ZeroDivisionError under coarse
+    # clocks / NTP jumps); now the report is skipped and re-armed
+    frozen = time.perf_counter()
+    monkeypatch.setattr(callback.time, "perf_counter", lambda: frozen)
+    s(_bep(2))                     # must not raise
+    monkeypatch.undo()
+    time.sleep(0.002)
+    s(_bep(3))                     # real elapsed: reports + telemetry
+    assert tele.gauge("train.samples_per_sec").value > 0
+
+
+def test_speedometer_rearms_across_epochs():
+    from mxnet_tpu import callback
+    s = callback.Speedometer(batch_size=4, frequent=2)
+    s(_bep(2))
+    s(_bep(4))
+    s(_bep(1))   # nbatch went BACKWARD: new epoch, no bogus report
+    assert s.init  # re-armed, not reporting across the boundary
+
+
+def test_progress_bar_guards_zero_total_and_overrun(caplog):
+    from mxnet_tpu import callback
+    with caplog.at_level(logging.INFO):
+        callback.ProgressBar(total=0, length=20)(_bep(5))   # no divide
+        callback.ProgressBar(total=4, length=20)(_bep(9))   # overrun
+    bars = [r.getMessage() for r in caplog.records if "[" in
+            r.getMessage()]
+    assert len(bars) == 2
+    for msg in bars:
+        bar = msg[msg.index("[") + 1:msg.index("]")]
+        assert len(bar) == 20                 # never longer than bar_len
+        assert bar.count("=") <= 20
+
+
+def test_eval_metric_get_returns_nan_before_any_update():
+    for m in (mx.metric.create("acc"), mx.metric.create("mse"),
+              mx.metric.create("ce"),
+              mx.metric.np(lambda label, pred: 1.0, name="custom1")):
+        name, value = m.get()                 # num_inst == 0: no raise
+        assert np.isnan(value), name
+    acc = mx.metric.create("acc")
+    acc.update([mx.nd.array(np.array([1.0]))],
+               [mx.nd.array(np.array([[0.1, 0.9]]))])
+    assert acc.get()[1] == 1.0                # real updates unaffected

@@ -1,6 +1,7 @@
 """Tests for the tools suite: make_list, parse_log, caffe converter
 (prototxt + binary caffemodel wire parsing), AccNN low-rank surgery —
 the reference's tools/ directory rebuilt (SURVEY.md §2.9)."""
+import json
 import os
 import struct
 import subprocess
@@ -294,3 +295,29 @@ def test_cpp_im2rec(tmp_path):
     it.reset()
     batches = sum(1 for _ in it)
     assert batches == 2
+
+
+def test_dump_telemetry_snapshot_and_trace(tmp_path, capsys):
+    """tools/dump_telemetry.py: pretty-prints a snapshot tree and
+    summarizes a Chrome trace file (auto-detected), so benchmark /
+    fault-injection artifacts are inspectable offline."""
+    from tools import dump_telemetry
+    from mxnet_tpu import telemetry as tele
+
+    tele.counter("t10.tool_events").inc(3)
+    tele.histogram("t10.tool_ms").observe(2.0)
+    snap_path = tmp_path / "snap.json"
+    snap_path.write_text(json.dumps(tele.snapshot()))
+    dump_telemetry.main([str(snap_path)])
+    out = capsys.readouterr().out
+    assert "tool_events" in out and "tool_ms" in out and "count=1" in out
+
+    tele.start_trace(str(tmp_path / "tr"))
+    with tele.span("t10.region"):
+        pass
+    tele.mark("t10.event")
+    trace_path = tele.stop_trace()
+    dump_telemetry.main([str(trace_path)])
+    out = capsys.readouterr().out
+    assert "t10.region" in out and "t10.event" in out
+    assert "trace events" in out

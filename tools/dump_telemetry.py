@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Inspect telemetry artifacts offline: pretty-print a snapshot JSON
+(what ``mx.telemetry.snapshot()`` returns — e.g. the ``telemetry``
+block bench.py writes into BENCH_extra.json) or summarize a Chrome
+``trace_event`` file captured via ``MXNET_TRACE_DIR``.
+
+Usage::
+
+    python tools/dump_telemetry.py BENCH_extra.json      # snapshot tree
+    python tools/dump_telemetry.py /tmp/tr/mx_trace_1.json  # trace table
+    python tools/dump_telemetry.py trace.json --names io. train.
+
+The file kind is auto-detected (a trace has a ``traceEvents`` list).
+Snapshot histograms print as one ``count/mean/p50/p99 [min..max]``
+line; traces print a per-span-name table (count, total/mean/max ms)
+plus instant-event counts — the quick "where did the time go" read
+for benchmark and fault-injection runs without opening Perfetto.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _fmt_hist(d):
+    return ("count=%d mean=%.3g p50=%s p99=%s [%.3g..%.3g] sum=%.6g"
+            % (d["count"], d.get("mean", 0), d.get("p50"), d.get("p99"),
+               d.get("min", 0), d.get("max", 0), d.get("sum", 0)))
+
+
+def _is_histogram(v):
+    return isinstance(v, dict) and "count" in v and (
+        "buckets" in v or set(v) == {"count"})
+
+
+def print_snapshot(snap, indent=0, out=sys.stdout):
+    pad = "  " * indent
+    for key in sorted(snap):
+        v = snap[key]
+        if _is_histogram(v):
+            if v["count"]:
+                out.write("%s%-28s %s\n" % (pad, key, _fmt_hist(v)))
+            else:
+                out.write("%s%-28s (empty)\n" % (pad, key))
+        elif isinstance(v, dict):
+            out.write("%s%s:\n" % (pad, key))
+            print_snapshot(v, indent + 1, out)
+        elif isinstance(v, float):
+            out.write("%s%-28s %.6g\n" % (pad, key, v))
+        else:
+            out.write("%s%-28s %s\n" % (pad, key, v))
+
+
+def print_trace(doc, name_filters=(), out=sys.stdout):
+    evs = doc.get("traceEvents", [])
+    spans, instants = {}, {}
+    for e in evs:
+        name = e.get("name", "?")
+        if name_filters and not any(name.startswith(f)
+                                    for f in name_filters):
+            continue
+        if e.get("ph") == "X":
+            agg = spans.setdefault(name, [0, 0.0, 0.0])  # n, sum, max
+            dur_ms = e.get("dur", 0) / 1e3
+            agg[0] += 1
+            agg[1] += dur_ms
+            agg[2] = max(agg[2], dur_ms)
+        elif e.get("ph") == "i":
+            instants[name] = instants.get(name, 0) + 1
+    out.write("%d trace events\n" % len(evs))
+    if doc.get("mxnetDroppedEvents"):
+        out.write("WARNING: %d events dropped at the buffer cap\n"
+                  % doc["mxnetDroppedEvents"])
+    if spans:
+        out.write("\n%-28s %8s %12s %10s %10s\n"
+                  % ("span", "count", "total_ms", "mean_ms", "max_ms"))
+        for name in sorted(spans, key=lambda n: -spans[n][1]):
+            n, total, mx_ = spans[name]
+            out.write("%-28s %8d %12.3f %10.3f %10.3f\n"
+                      % (name, n, total, total / n, mx_))
+    if instants:
+        out.write("\n%-28s %8s\n" % ("instant event", "count"))
+        for name in sorted(instants):
+            out.write("%-28s %8d\n" % (name, instants[name]))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Pretty-print a telemetry snapshot / summarize a "
+                    "Chrome trace file (doc/observability.md)")
+    ap.add_argument("file", help="snapshot JSON or trace_event JSON")
+    ap.add_argument("--names", nargs="*", default=(),
+                    help="only trace spans whose name starts with one "
+                         "of these prefixes (e.g. --names io. train.)")
+    args = ap.parse_args(argv)
+    with open(args.file) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("traceEvents"),
+                                            list):
+        print_trace(doc, tuple(args.names))
+        return
+    # snapshot, possibly wrapped (BENCH_extra.json carries it under
+    # the "telemetry" key)
+    if isinstance(doc, dict) and "telemetry" in doc \
+            and isinstance(doc["telemetry"], dict):
+        doc = doc["telemetry"]
+    print_snapshot(doc)
+
+
+if __name__ == "__main__":
+    main()
