@@ -387,7 +387,10 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
                              .astype(np.float32))
               for n, sh in zip(sym.list_arguments(), arg_shapes)
               if n not in shapes}
-    buckets = (64, 128, 256)
+    # capped at max_len so smoke geometries below the chip-default
+    # 256 top bucket stay constructible (identical at the default)
+    buckets = tuple(b for b in (64, 128, 256) if b <= max_len) \
+        or (max_len,)
     dec = Decoder(sym, params, max_len=max_len,
                   compute_dtype="bfloat16", cache_block=None)
 
@@ -397,7 +400,8 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
         batching's stall-on-slowest cost is visible."""
         out = []
         for _ in range(n):
-            p = int(rs.choice([24, 48, 96, 120, 200, 256]))
+            p = min(int(rs.choice([24, 48, 96, 120, 200, 256])),
+                    buckets[-1], max_len - 1)  # no-op at the default
             t = int(rs.choice([32, 64, 96, 160]))
             out.append((rs.randint(0, vocab, (p,)), t))
         return out
@@ -429,9 +433,13 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
     # steps_per_round=8: each dispatched round decodes 8 tokens per
     # slot inside one lax.scan program, amortizing the relay's
     # multi-ms per-dispatch overhead (which would otherwise rival the
-    # ~2-5 ms device step and cap the engine below the static arm)
+    # ~2-5 ms device step and cap the engine below the static arm).
+    # Prefix cache OFF here: this arm is the raw continuous-batching
+    # headline (comparable across rounds); bench_serving_prefix
+    # measures the cache and chunking on a workload built for them.
     engine = InferenceEngine(dec, slots=slots, prefill_buckets=buckets,
-                             max_queue=4 * slots, steps_per_round=8)
+                             max_queue=4 * slots, steps_per_round=8,
+                             prefix_cache_mb=0, prefill_chunk=0)
     # warmup compiles BOTH program families for every bucket up front
     # (one prompt per bucket), so the timed run measures execution only
     wrs = np.random.RandomState(seed + 1)
@@ -443,7 +451,8 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
     cc = engine.compile_counts
     programs = cc["decode"] + sum(cc["prefill"].values())
     assert cc["decode"] == 1 and all(v == 1
-                                     for v in cc["prefill"].values()), \
+                                     for v in cc["prefill"].values()) \
+        and not cc["copy"], \
         "compile-count contract violated: %r" % (cc,)
     return {
         "tokens_per_sec": round(toks / dt, 0),
@@ -453,6 +462,136 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
         "requests": n_requests,
         "tokens": toks,
         "compile_programs": programs,
+    }
+
+
+def bench_serving_prefix(slots=16, layers=12, embed=768, heads=12,
+                         vocab=32000, max_len=1024, n_requests=48,
+                         seed=0, arrival_ms=6.0, hit_rate=0.9,
+                         shared_len=192, tail_len=32, long_frac=0.25,
+                         long_len=512, out_tokens=(32, 48, 64),
+                         chunk=0, prefix_cache_mb=256,
+                         steps_per_round=8):
+    """ONE serving-engine config under a shared-system-prompt workload
+    (the ISSUE 5 arm): a ``hit_rate`` fraction of requests start with
+    the same ``shared_len``-token system prompt (unique ``tail_len``
+    tails), the rest are unique — and ``long_frac`` of THOSE are
+    ``long_len``-token prompts, the chunked-prefill stressor (a
+    monolithic long prefill stalls every resident decode slot; chunked,
+    the stall is bounded by one ``chunk``). Arrivals are Poisson at a
+    SUB-saturating ``arrival_ms`` so TTFT measures prefill work, not
+    unbounded queue wait.
+
+    Called with cache on vs off (same workload, same seed) the TTFT
+    delta is the prefix cache's saved prefill FLOPs; with ``chunk`` on
+    vs off the cadence p99 delta is what long-prompt admission costs
+    co-resident requests. ``tools/bench_serving.py`` sweeps
+    hit-rate x chunk over this same function.
+
+    ``prefix_cache_mb`` defaults to 256 HERE (not the engine's 64):
+    one pool slot of the 124M/max_len-1024 bf16 geometry is ~37 MiB,
+    and a 1-slot pool would measure eviction churn (every unique-
+    prompt retention evicts the shared entry), not steady-state hits.
+
+    Returns {"ttft_p50_ms", "ttft_mean_ms", "cadence_p50_ms",
+    "cadence_p99_ms", "tokens_per_sec", "prefix_hit_tokens",
+    "prefill_chunks", "compile_programs", ...config echo}.
+    """
+    import jax.numpy as jnp
+    from mxnet_tpu.models import get_transformer_lm
+    from mxnet_tpu.parallel import Decoder
+    from mxnet_tpu.serving import InferenceEngine
+
+    sym = get_transformer_lm(vocab, num_layers=layers, embed_dim=embed,
+                             num_heads=heads, impl="flash")
+    rng = np.random.RandomState(seed)
+    shapes = {"data": (8, max_len), "softmax_label": (8, max_len)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    params = {n: jnp.asarray(rng.uniform(-0.05, 0.05, sh)
+                             .astype(np.float32))
+              for n, sh in zip(sym.list_arguments(), arg_shapes)
+              if n not in shapes}
+    # power-of-2 buckets capped at max_len (smoke configs shrink
+    # max_len below the chip-default 512 top bucket)
+    buckets = tuple(b for b in (64, 128, 256, 512) if b <= max_len)
+    if not buckets or buckets[-1] < min(max_len, 512):
+        buckets += (max_len,)
+    dec = Decoder(sym, params, max_len=max_len,
+                  compute_dtype="bfloat16", cache_block=None)
+    engine = InferenceEngine(dec, slots=slots, prefill_buckets=buckets,
+                             max_queue=4 * slots,
+                             steps_per_round=steps_per_round,
+                             prefix_cache_mb=prefix_cache_mb,
+                             prefill_chunk=chunk)
+
+    wl_rng = np.random.RandomState(seed + 1)
+    shared = wl_rng.randint(0, vocab, (shared_len,))
+
+    def workload(n, rs):
+        out = []
+        for _ in range(n):
+            if rs.uniform() < hit_rate:
+                p = np.concatenate(
+                    [shared, rs.randint(0, vocab, (tail_len,))])
+            elif rs.uniform() < long_frac:
+                p = rs.randint(0, vocab, (long_len,))
+            else:
+                p = rs.randint(0, vocab, (shared_len + tail_len,))
+            out.append((p, int(rs.choice(out_tokens))))
+        return out
+
+    # warmup: compile every program family this workload can touch
+    # (prefill buckets, decode, and — cache on — the hit/retention
+    # copies, by serving the shared prefix twice) and leave the cache
+    # in steady state so the timed run measures hits, not cold misses
+    wrs = np.random.RandomState(seed + 2)
+    for p, t in workload(6, wrs) + [
+            (np.concatenate([shared, wrs.randint(0, vocab,
+                                                 (tail_len,))]), 8),
+            (wrs.randint(0, vocab, (long_len,)), 8)]:
+        engine.submit(p, max_tokens=t)
+    engine.serve_forever()
+
+    hit0 = engine.stats["prefix_hit_tokens"]
+    chunks0 = engine.stats["prefill_chunks"]
+    reqs = workload(n_requests, np.random.RandomState(seed + 3))
+    arrivals = np.cumsum(
+        np.random.RandomState(seed + 4).exponential(
+            arrival_ms * 1e-3, size=n_requests))
+    t0 = time.perf_counter()
+    handles, i = [], 0
+    while i < len(reqs) or not engine.idle:
+        now = time.perf_counter() - t0
+        while i < len(reqs) and arrivals[i] <= now \
+                and engine.queued() < engine.max_queue:
+            prompt, mt = reqs[i]
+            handles.append(engine.submit(prompt, max_tokens=mt))
+            i += 1
+        engine.step()
+    dt = time.perf_counter() - t0
+    toks = sum(len(h.tokens) for h in handles)
+    ttft = [(h.t_first - h.t_submit) * 1e3 for h in handles]
+    tpot = [(h.t_done - h.t_first) / (len(h.tokens) - 1) * 1e3
+            for h in handles if len(h.tokens) > 1]
+    cc = engine.compile_counts
+    assert cc["decode"] == 1 \
+        and all(v == 1 for v in cc["prefill"].values()) \
+        and all(v == 1 for v in cc["copy"].values()), \
+        "compile-count contract violated: %r" % (cc,)
+    return {
+        "ttft_p50_ms": round(float(np.percentile(ttft, 50)), 3),
+        "ttft_mean_ms": round(float(np.mean(ttft)), 3),
+        "cadence_p50_ms": round(float(np.percentile(tpot, 50)), 3),
+        "cadence_p99_ms": round(float(np.percentile(tpot, 99)), 3),
+        "tokens_per_sec": round(toks / dt, 0),
+        "prefix_hit_tokens": engine.stats["prefix_hit_tokens"] - hit0,
+        "prefill_chunks": engine.stats["prefill_chunks"] - chunks0,
+        "compile_programs": cc["decode"] + sum(cc["prefill"].values())
+                            + sum(cc["copy"].values()),
+        "hit_rate": hit_rate,
+        "chunk": chunk,
+        "prefix_cache_mb": engine.prefix_cache_mb,
+        "requests": n_requests,
     }
 
 
@@ -784,6 +923,35 @@ def main():
     except Exception:
         traceback.print_exc()
         serving = None
+    # prefix-cache + chunked-prefill A/B (ISSUE 5): same workload,
+    # same seeds — cache on vs off moves TTFT (saved prefill FLOPs),
+    # chunking on vs off moves cadence p99 (bounded decode stalls
+    # under long-prompt admission)
+    try:
+        pfx_on = bench_serving_prefix(prefix_cache_mb=256, chunk=0)
+        pfx_off = bench_serving_prefix(prefix_cache_mb=0, chunk=0)
+        pfx_chunked = bench_serving_prefix(prefix_cache_mb=0, chunk=128)
+        serving_prefix = {
+            "cache_on": pfx_on,
+            "cache_off": pfx_off,
+            "chunked_128": pfx_chunked,
+            "ttft_speedup": None if not pfx_on["ttft_p50_ms"]
+            else round(pfx_off["ttft_p50_ms"] / pfx_on["ttft_p50_ms"],
+                       2),
+            "note": "shared-system-prompt workload (90% of requests "
+                    "share a 192-token prefix; 25% of the rest are "
+                    "512-token long prompts), sub-saturating Poisson "
+                    "arrivals; ttft_speedup = cache-off p50 TTFT / "
+                    "cache-on (prefix K/V row copies replace prefill "
+                    "FLOPs); chunked_128 bounds each decode stall to "
+                    "one 128-token prefill piece — compare its "
+                    "cadence_p99_ms against cache_off's (both cache-"
+                    "off, chunking isolated); "
+                    "tools/bench_serving.py sweeps hit-rate x chunk",
+        }
+    except Exception:
+        traceback.print_exc()
+        serving_prefix = None
     def _dec_best_ms():
         if not dec_arms:
             return None
@@ -850,6 +1018,7 @@ def main():
                     "requests; tools/bench_serving.py sweeps slots and "
                     "arrival rates",
         },
+        "serving_prefix_cache_chunked_prefill": serving_prefix,
         "calibration": {
             "gemm_8192_bf16_tflops":
                 None if ceiling is None else round(ceiling / 1e12, 1),
@@ -932,6 +1101,12 @@ def main():
                 None if serving is None else serving["tokens_per_sec"],
             "serving_p99_ms":
                 None if serving is None else serving["p99_ms_per_token"],
+            "serving_prefix_ttft_speedup":
+                None if serving_prefix is None
+                else serving_prefix["ttft_speedup"],
+            "serving_chunked_p99_ms":
+                None if serving_prefix is None
+                else serving_prefix["chunked_128"]["cadence_p99_ms"],
             "cifar10_img_per_sec":
                 None if cifar is None else round(cifar, 1),
             "cifar10_vs_gtx980":

@@ -942,9 +942,11 @@ class _ParallelEngine:
         _TM_DECODE_MS.observe(decode_s * 1e3)
         _TM_POOL_BATCHES.inc()
         try:
-            # ready batches still queued behind this one (worker-local
-            # view; a healthy pool keeps this near queue_depth)
-            _TM_POOL_QDEPTH.set(self._out[wid].qsize())
+            # ready batches still queued across the WHOLE pool (a
+            # healthy pool keeps this near num_workers * queue_depth;
+            # a worker-local qsize would under-report W-fold and hide
+            # a single straggler behind its siblings)
+            _TM_POOL_QDEPTH.set(sum(q.qsize() for q in self._out))
         except NotImplementedError:  # qsize absent on some platforms
             pass
         self._next_b += 1

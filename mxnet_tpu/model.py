@@ -43,6 +43,11 @@ BatchEndParam = namedtuple("BatchEndParams",
 
 _TM_DEVICE_MS = tele.histogram("train.device_wait_ms")
 _TM_CKPT_MS = tele.histogram("checkpoint.write_ms")
+# same registry objects the fused ParallelTrainer feeds — the legacy
+# per-device executor loop reports under the SAME names so one
+# snapshot covers whichever loop ran (doc/observability.md)
+_TM_TRAIN_STEPS = tele.counter("train.steps")
+_TM_TRAIN_STEP_MS = tele.histogram("train.step_ms")
 
 
 def _create_kvstore(kvstore, num_device, arg_params):
@@ -263,6 +268,7 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
             executor_manager.load_data_batch(data_batch)
             if monitor is not None:
                 monitor.tic()
+            step_t0 = time.perf_counter()
             executor_manager.forward(is_train=True)
             executor_manager.backward()
             if update_on_kvstore:
@@ -274,6 +280,13 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
                                executor_manager.grad_arrays,
                                updater=updater, num_device=len(ctx),
                                kvstore=kvstore)
+            # forward+backward+update = one training step: the legacy
+            # loop's dispatch is host-blocking per phase, so this wall
+            # time is the honest per-batch cost (the fused loop's
+            # step/input/device split needs its staged stream)
+            _TM_TRAIN_STEPS.inc()
+            _TM_TRAIN_STEP_MS.observe(
+                (time.perf_counter() - step_t0) * 1e3)
             if monitor is not None:
                 monitor.toc_print()
             executor_manager.update_metric(eval_metric, data_batch.label)
@@ -1091,6 +1104,7 @@ class FeedForward(BASE_ESTIMATOR):
 
     def as_serving_engine(self, max_len, slots=8, prefill_buckets=None,
                           max_queue=256, steps_per_round=1,
+                          prefix_cache_mb=None, prefill_chunk=None,
                           **decoder_kwargs):
         """Trained estimator → continuous-batching inference engine
         (``mxnet_tpu.serving.InferenceEngine``, doc/serving.md): the
@@ -1122,7 +1136,9 @@ class FeedForward(BASE_ESTIMATOR):
         return InferenceEngine(dec, slots=slots,
                                prefill_buckets=prefill_buckets,
                                max_queue=max_queue,
-                               steps_per_round=steps_per_round)
+                               steps_per_round=steps_per_round,
+                               prefix_cache_mb=prefix_cache_mb,
+                               prefill_chunk=prefill_chunk)
 
     @staticmethod
     def load(prefix, epoch, ctx=None, **kwargs):

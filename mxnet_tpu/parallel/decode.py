@@ -647,19 +647,63 @@ class Decoder:
                 full, s, slot, axis=0),
             caches, sub)
 
-    def clear_window_positions(self, caches):
+    def clear_window_positions(self, caches, only_if=None):
         """Reset the ring-position buffers of windowed attention nodes
         to -1 (= never written). Slot REUSE needs this: a recycled
         slot's non-window rows are hidden by the ``key_pos <= pos``
         mask until overwritten, but ring slots are visible by their
         STORED positions, so a previous occupant's entries would leak
-        into a new request's window. No-op for non-windowed caches."""
+        into a new request's window. No-op for non-windowed caches.
+
+        ``only_if`` (traced bool, optional): reset only when true —
+        the serving engine's chunked prefill runs every chunk through
+        ONE compiled program per bucket, and only the FIRST chunk of a
+        recycled slot (traced ``start == 0``) may wipe the ring; later
+        chunks must keep the positions their predecessors wrote."""
         out = []
         for n, entry in zip(self._mha, caches):
             if self._node_window(n):
-                entry = entry[:-1] + (jnp.full_like(entry[-1], -1),)
+                wiped = jnp.full_like(entry[-1], -1)
+                if only_if is not None:
+                    wiped = jnp.where(only_if, wiped, entry[-1])
+                entry = entry[:-1] + (wiped,)
             out.append(entry)
         return out
+
+    @staticmethod
+    def slot_prefix_rows(caches, slot, length):
+        """Read rows ``[0, length)`` of one cache slot as a b=1 tree:
+        the read half of the serving engine's prefix-cache copy
+        (``length`` is STATIC — the engine buckets it like prefill, so
+        one program serves every copy of that bucket; ``slot`` is a
+        traced int32 index). Rows past the true cached length ride
+        along as junk — in the destination they sit at positions the
+        ``key_pos <= pos`` mask hides until the suffix prefill
+        overwrites them, the same argument that makes right-padded
+        bucketed prefill exact. NOT valid for windowed ring caches
+        (ring rows are addressed by wrapped absolute position, not by
+        prefix row index) — the engine bypasses the prefix cache for
+        windowed models."""
+        def read(c):
+            s = lax.dynamic_slice_in_dim(c, jnp.asarray(slot, jnp.int32),
+                                         1, axis=0)
+            return lax.slice_in_dim(s, 0, length, axis=1)
+
+        return jax.tree_util.tree_map(read, caches)
+
+    @staticmethod
+    def slot_write_prefix_rows(caches, slot, rows):
+        """Write a ``slot_prefix_rows`` result into rows ``[0, C)`` of
+        ``slot`` (traced int32) — the write half of the slot-to-slot
+        prefix copy. Index tuples are uniformly int32 (see
+        ``_write_cache`` on jax 0.4.37's strict index dtypes)."""
+        def write(full, r):
+            idx = (jnp.asarray(slot, jnp.int32),) \
+                + (jnp.int32(0),) * (full.ndim - 1)
+            return lax.dynamic_update_slice(full, r.astype(full.dtype),
+                                            idx)
+
+        return jax.tree_util.tree_map(write, caches, rows)
 
     # -- user API -------------------------------------------------------
     @staticmethod

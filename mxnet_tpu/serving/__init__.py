@@ -6,11 +6,14 @@ per exact ``(batch, prompt_len, num_steps)`` shape and stalls a whole
 batch on its slowest sequence; the :class:`InferenceEngine` here serves
 an arbitrary request mix — mixed prompt lengths, per-request
 ``max_tokens``/``eos_id``/temperature, requests arriving mid-stream —
-from exactly two compiled program families (a bucketed prefill and a
-fused all-slots decode step) with iteration-level scheduling between
-device steps (Orca, OSDI '22; slot-structured caches after vLLM's
-PagedAttention, SOSP '23).
+from three compiled program families (a bucketed prefill that also
+serves chunked prefill, a fused all-slots decode step, and a bucketed
+prefix-cache row copy) with iteration-level scheduling between device
+steps (Orca, OSDI '22; slot-structured caches after vLLM's
+PagedAttention, SOSP '23; prefix reuse after RadixAttention and
+chunk-interleaved prefill after Sarathi-Serve).
 """
 from .engine import InferenceEngine, Request
+from .prefix import PrefixCache
 
-__all__ = ["InferenceEngine", "Request"]
+__all__ = ["InferenceEngine", "Request", "PrefixCache"]

@@ -10,19 +10,38 @@ Architecture (doc/serving.md has the full story):
   ``key_pos <= pos`` causal mask until overwritten; window rings get
   their position buffers reset at admission).
 
-* TWO compiled program families serve any request mix, ever:
+* THREE compiled program families serve any request mix, ever:
 
   - **bucketed prefill** (one program per power-of-2 length bucket):
-    a prompt padded to its bucket is pushed through the derived
-    incremental graph at positions ``[0, P)`` of its assigned slot —
-    slot index, true length, temperature, rng key, eos id and token
-    budget are all traced operands. The first output token is sampled
-    in-program and the per-slot state vectors are scatter-updated, so
-    admission costs zero extra compiled programs.
+    a prompt CHUNK padded to its bucket is pushed through the derived
+    incremental graph at positions ``[start, start + C)`` of its
+    assigned slot — slot index, start position, true chunk length,
+    finality, temperature, rng key, eos id and token budget are all
+    traced operands. The FINAL chunk samples the first output token
+    in-program at the last real prompt position and scatter-updates
+    the per-slot state vectors; non-final chunks (``prefill_chunk``
+    pieces of a long prompt, interleaved with decode rounds —
+    Sarathi-Serve, Agrawal et al. 2024) only write K/V and park the
+    slot in a frozen state whose idempotent decode-round rewrite is
+    harmless. Admission costs zero extra compiled programs.
   - **fused decode step** (exactly one program): one token for EVERY
     slot at its own position — per-slot position vector, per-slot
     temperature/rng sampling, vectorized EOS/length masking. Finished
     slots freeze (their write is idempotent) until reused.
+  - **bucketed prefix copy** (one program per bucket, when the prefix
+    cache is on): rows ``[0, B)`` of one cache slot land in another in
+    a single compiled slice+scatter — pool→slot on a prefix hit
+    (the matched prompt prefix's K/V replaces its prefill FLOPs,
+    RadixAttention-style — Zheng et al. 2023), slot→pool when a
+    freshly prefilled prompt is retained. Source/destination slot and
+    direction are traced operands.
+
+* a host-side **prefix cache** (``serving/prefix.py``): a refcounted-
+  LRU trie over token ids maps a new prompt to the longest prefix a
+  RETAINED prompt shares with it; retained prompts own slots in a
+  reserved on-device pool (same cache layout, extra slot axis rows)
+  bounded by ``prefix_cache_mb``. Windowed-ring models bypass it —
+  ring eviction invalidates absolute-position reuse (doc/serving.md).
 
 * a host-side scheduler that admits queued requests into freed slots
   BETWEEN device steps (iteration-level / continuous batching — Orca,
@@ -41,6 +60,7 @@ depend only on ``(seed, position)`` — not on scheduling.
 from __future__ import annotations
 
 import collections
+import os
 import time
 
 import numpy as np
@@ -52,8 +72,15 @@ from ..base import MXNetError
 from .. import telemetry as tele
 from ..io import StagedStream
 from ..parallel.decode import Decoder
+from .prefix import PrefixCache
 
 __all__ = ["InferenceEngine", "Request"]
+
+# hard bound on reserved prefix-pool slots: the byte budget is the
+# real knob; this only stops a tiny model + big budget from minting a
+# silly slot axis (256 entries is far past any workload's useful
+# distinct-prefix count)
+_MAX_POOL_SLOTS = 256
 
 # per-request serving stats (doc/observability.md "serving"): all
 # host-side perf_counter arithmetic on values the scheduler already
@@ -73,11 +100,28 @@ _TM_SLOTS_BUSY = tele.histogram(
     "serving.slots_busy_per_round",
     buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128))
 _TM_OCCUPANCY = tele.gauge("serving.slot_occupancy")
+# prefix cache + chunked prefill (all host-side: the lookup is a trie
+# walk, the copy/chunk spans time dispatches — nothing crosses the
+# device boundary beyond the programs themselves)
+_TM_PREFIX_HITS = tele.counter("serving.prefix_hits")
+_TM_PREFIX_MISSES = tele.counter("serving.prefix_misses")
+_TM_PREFIX_HIT_TOKENS = tele.counter("serving.prefix_hit_tokens")
+_TM_PREFIX_LOOKUP_MS = tele.histogram(
+    "serving.prefix_lookup_ms",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0))
+_TM_PREFIX_BYTES = tele.gauge("serving.prefix_cache_bytes")
+_TM_PREFIX_EVICTIONS = tele.counter("serving.prefix_evictions")
+_TM_PREFIX_INSERT_SKIPPED = tele.counter(
+    "serving.prefix_insert_skipped")
+_TM_CHUNKS = tele.histogram(
+    "serving.prefill_chunks_per_request",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
 # compile_counts re-exported as telemetry: the in-engine log stays the
 # tested contract; these make recompiles visible in ONE snapshot next
 # to everything else
 _TM_COMPILE_DECODE = tele.counter("serving.compiles_decode")
 _TM_COMPILE_PREFILL = tele.counter("serving.compiles_prefill")
+_TM_COMPILE_COPY = tele.counter("serving.compiles_copy")
 
 
 class Request:
@@ -91,9 +135,12 @@ class Request:
     ``t_done`` (perf_counter seconds; admit = slot assigned + prefill
     dispatched; first = first token DRAINED, i.e. visible to the
     caller, not merely computed). ``retire_reason`` is ``"eos"`` or
-    ``"length"`` once done. The same breakdown feeds the
-    ``serving.*`` telemetry histograms (queue wait / TTFT / per-token
-    cadence — doc/observability.md).
+    ``"length"`` once done. ``prefix_hit_tokens`` counts prompt
+    positions whose K/V came from the prefix cache instead of prefill
+    FLOPs; ``prefill_chunks`` how many prefill dispatches admitted the
+    prompt (1 unless ``prefill_chunk`` split it). The same breakdown
+    feeds the ``serving.*`` telemetry histograms (queue wait / TTFT /
+    per-token cadence / prefix + chunk stats — doc/observability.md).
     """
 
     def __init__(self, rid, prompt, max_tokens, eos_id, temperature,
@@ -112,6 +159,8 @@ class Request:
         self.t_first = None
         self.t_done = None
         self.retire_reason = None
+        self.prefix_hit_tokens = 0
+        self.prefill_chunks = 0
 
     def result(self):
         if not self.done:
@@ -205,11 +254,39 @@ class InferenceEngine:
         typical output length (k=1 is latency-optimal per-token
         scheduling; the chip-facing bench uses 8). Still ONE compiled
         decode program either way.
+    prefix_cache_mb : float, optional
+        Byte budget (MiB) for the prefix-reuse pool: prompts are
+        retained as on-device K/V rows in a reserved slot pool, and a
+        new request whose prompt shares a prefix with a retained one
+        gets that prefix COPIED into its slot (one compiled copy per
+        bucket) instead of re-prefilled — shared system prompts stop
+        paying their FLOPs per request. Default: the
+        ``MXNET_SERVING_PREFIX_CACHE_MB`` env var, else 64. ``0``
+        disables. Pool slots = budget // per-slot cache bytes (capped
+        at 256); eviction is refcounted LRU. Windowed-ring decoders
+        bypass the cache automatically (ring eviction invalidates
+        absolute-position reuse — doc/serving.md). Greedy outputs stay
+        byte-identical with the cache on or off.
+    prefill_chunk : int, optional
+        Chunked-prefill bound: a prompt (suffix) longer than this many
+        tokens is admitted as a SEQUENCE of chunk-sized prefill
+        dispatches interleaved with decode rounds, under a per-round
+        prefill budget of one chunk shared by all in-flight admissions
+        — resident decode slots stall ~one chunk of prefill work per
+        round, not one whole prompt (nor a burst of them): the p99
+        token-cadence lever under long-prompt traffic. Also lifts the
+        submit length cap from the largest bucket to ``max_len - 1``
+        (pieces only need the chunk to fit a bucket). Default: the
+        ``MXNET_SERVING_PREFILL_CHUNK`` env var, else 0 (= monolithic
+        prefill). Uses the SAME bucketed prefill programs (chunk start
+        is a traced operand); greedy outputs stay byte-identical
+        across any chunk boundary.
     """
 
     def __init__(self, decoder, slots=8, prefill_buckets=None,
                  max_queue=256, stage_depth=2, drain_depth=2,
-                 steps_per_round=1):
+                 steps_per_round=1, prefix_cache_mb=None,
+                 prefill_chunk=None):
         if not isinstance(decoder, Decoder):
             raise MXNetError("InferenceEngine needs a Decoder, got %r"
                              % type(decoder).__name__)
@@ -238,6 +315,18 @@ class InferenceEngine:
         if self.steps_per_round < 1:
             raise MXNetError("InferenceEngine: steps_per_round must "
                              "be >= 1")
+        if prefill_chunk is None:
+            prefill_chunk = int(os.environ.get(
+                "MXNET_SERVING_PREFILL_CHUNK", "0") or 0)
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 0:
+            raise MXNetError("InferenceEngine: prefill_chunk must be "
+                             ">= 0 (0 disables chunking)")
+        if self.prefill_chunk > buckets[-1]:
+            raise MXNetError(
+                "InferenceEngine: prefill_chunk=%d exceeds the largest "
+                "prefill bucket %d — every chunk piece must fit a "
+                "bucket program" % (self.prefill_chunk, buckets[-1]))
 
         # device-resident: the slot-paged cache + per-slot state vectors
         S = self.slots
@@ -252,6 +341,34 @@ class InferenceEngine:
             jnp.zeros((S,), jnp.int32),        # last allowed position
         )
 
+        # prefix-reuse pool: a SEPARATE cache tree of pool slots (same
+        # per-slot layout) holding retained prompt K/V. Separate, not
+        # extra rows on the serving tree, so the fused decode step
+        # keeps vmapping over exactly S lanes — pool size must never
+        # tax the per-token path.
+        if prefix_cache_mb is None:
+            prefix_cache_mb = float(os.environ.get(
+                "MXNET_SERVING_PREFIX_CACHE_MB") or "64")
+        self.prefix_cache_mb = float(prefix_cache_mb)
+        if self.prefix_cache_mb < 0:
+            raise MXNetError("InferenceEngine: prefix_cache_mb must "
+                             "be >= 0 (0 disables the prefix cache)")
+        self._windowed = any(decoder._node_window(n)
+                             for n in decoder._mha)
+        slot_bytes = sum(x.nbytes for x in
+                         jax.tree_util.tree_leaves(self._caches)) // S
+        pool_slots = 0
+        if self.prefix_cache_mb > 0 and not self._windowed:
+            pool_slots = min(
+                int(self.prefix_cache_mb * 2**20) // max(1, slot_bytes),
+                _MAX_POOL_SLOTS)
+        if pool_slots > 0:
+            self._pool = decoder.init_cache(pool_slots)
+            self._prefix = PrefixCache(pool_slots, slot_bytes)
+        else:
+            self._pool = None
+            self._prefix = None
+
         # host-side scheduler state
         self._pending = collections.deque()
         self._stager = StagedStream(_PendingSource(self._pending),
@@ -260,25 +377,39 @@ class InferenceEngine:
         self._free = collections.deque(range(S))  # FIFO slot recycling
         self._mirror = [None] * S   # drain-side view: slot -> Request
         self._drain = collections.deque()
+        # requests admitted to a slot whose prompt is still being
+        # chunk-prefilled, oldest first; plus one admission candidate
+        # held over when a round's prefill budget ran out. Each round
+        # runs at most ~prefill_chunk tokens of prefill work between
+        # decode rounds (the chunked-prefill cadence bound)
+        self._chunking = collections.deque()
+        self._held = None
+        self._round_budget = float("inf")
         self._next_id = 0
         self._auto_seed = 0
         self.stats = {"submitted": 0, "completed": 0, "prefills": 0,
-                      "steps": 0, "tokens": 0}
+                      "steps": 0, "tokens": 0, "prefix_hits": 0,
+                      "prefix_hit_tokens": 0, "prefill_chunks": 0,
+                      "prefix_copies": 0}
 
-        # the two compiled program families; the log records one tag
+        # the three compiled program families; the log records one tag
         # per TRACE (python side effects run at trace time only), so it
         # IS the compile count — tests pin the contract against it
         self._compile_log = []
-        self._donate = (2, 3) if jax.default_backend() != "cpu" else ()
+        on_chip = jax.default_backend() != "cpu"
+        self._donate = (2, 3) if on_chip else ()
+        self._copy_donate = (0, 1) if on_chip else ()
         self._step_fn = jax.jit(self._make_step(),
                                 donate_argnums=self._donate)
         self._prefill_fns = {}
+        self._copy_fns = {}
 
     # -- construction ---------------------------------------------------
     @classmethod
     def from_checkpoint(cls, prefix, epoch, max_len, slots=8,
                         prefill_buckets=None, max_queue=256,
                         stage_depth=2, drain_depth=2, steps_per_round=1,
+                        prefix_cache_mb=None, prefill_chunk=None,
                         **decoder_kwargs):
         """Checkpoint → serving engine in one call
         (``prefix-symbol.json`` + ``prefix-NNNN.params``, the reference
@@ -291,7 +422,9 @@ class InferenceEngine:
         return cls(dec, slots=slots, prefill_buckets=prefill_buckets,
                    max_queue=max_queue, stage_depth=stage_depth,
                    drain_depth=drain_depth,
-                   steps_per_round=steps_per_round)
+                   steps_per_round=steps_per_round,
+                   prefix_cache_mb=prefix_cache_mb,
+                   prefill_chunk=prefill_chunk)
 
     # -- compiled programs ----------------------------------------------
     def _make_step(self):
@@ -355,19 +488,28 @@ class InferenceEngine:
             dec = self._dec
 
             def prefill(params, aux, caches, state, slot, tokens,
-                        true_len, temp, key, eos, max_toks):
+                        start, true_len, final, temp, key, eos,
+                        max_toks):
+                # ONE program per bucket serves whole prompts AND every
+                # chunk of a chunked prefill: start, the chunk's true
+                # length and finality are traced operands. total = the
+                # absolute prompt length covered so far.
                 self._compile_log.append(("prefill", bucket))
                 _TM_COMPILE_PREFILL.inc()
                 pos, tok, live, temps, keys, eoss, lasts = state
+                total = start + true_len
                 sub = dec.slot_slice(caches, slot)
                 # ring-position reset: a recycled slot must not leak
-                # the previous occupant's window entries
-                sub = dec.clear_window_positions(sub)
-                # valid_len: pad rows must not enter window rings
-                # (they would EVICT real in-window keys — linear cache
-                # rows are masked-until-overwritten, ring slots wrap)
-                logits, sub = dec._run(params, aux, sub, 0, tokens,
-                                       valid_len=true_len)
+                # the previous occupant's window entries — but ONLY on
+                # the first chunk; later chunks extend the same ring
+                sub = dec.clear_window_positions(
+                    sub, only_if=start == jnp.int32(0))
+                # valid_len (absolute): pad rows must not enter window
+                # rings (they would EVICT real in-window keys — linear
+                # cache rows are masked-until-overwritten, ring slots
+                # wrap)
+                logits, sub = dec._run(params, aux, sub, start, tokens,
+                                       valid_len=total)
                 caches = dec.slot_update(caches, slot, sub)
                 v = logits.shape[2]
                 zero = jnp.int32(0)
@@ -376,15 +518,24 @@ class InferenceEngine:
                 greedy = jnp.argmax(lastlog, -1).astype(jnp.int32)
                 t = jnp.where(temp > 0.0, temp, jnp.float32(1.0))
                 sampled = jax.random.categorical(
-                    jax.random.fold_in(key, true_len),
+                    jax.random.fold_in(key, total),
                     lastlog.astype(jnp.float32) / t).astype(jnp.int32)
                 t0 = jnp.where(temp > 0.0, sampled, greedy)
-                lastp = jnp.minimum(true_len + max_toks - 1,
+                lastp = jnp.minimum(total + max_toks - 1,
                                     dec.max_len - 1).astype(jnp.int32)
-                done0 = (t0 == eos) | (true_len >= lastp)
-                state2 = (pos.at[slot].set(true_len),
-                          tok.at[slot].set(t0),
-                          live.at[slot].set(~done0),
+                done0 = (t0 == eos) | (total >= lastp)
+                # a NON-final chunk parks the slot dead at (pos=total,
+                # tok=last chunk token): the decode rounds that
+                # interleave until the next chunk rewrite exactly that
+                # token's K/V at row `total` — a row the next chunk
+                # overwrites before any masked read could see it, the
+                # same idempotent-freeze contract finished slots use
+                lastchunk = lax.dynamic_slice(
+                    tokens, (zero, true_len - 1), (1, 1))[0, 0]
+                state2 = (pos.at[slot].set(total),
+                          tok.at[slot].set(
+                              jnp.where(final, t0, lastchunk)),
+                          live.at[slot].set(final & ~done0),
                           temps.at[slot].set(temp),
                           keys.at[slot].set(key),
                           eoss.at[slot].set(eos),
@@ -395,17 +546,68 @@ class InferenceEngine:
                 prefill, donate_argnums=self._donate)
         return self._prefill_fns[bucket]
 
+    def _copy_fn(self, bucket):
+        """Compiled slot-to-slot prefix copy, one program per bucket:
+        rows ``[0, bucket)`` of a source slot land in a destination
+        slot. Source/destination may each be a serving slot or a pool
+        slot — the direction booleans are traced operands, so ONE
+        program covers pool→slot (prefix hit) and slot→pool
+        (retention). int8 flavors copy their row scales alongside
+        automatically (the copy is a tree-map over every cache
+        buffer)."""
+        if bucket not in self._copy_fns:
+            def copy(serv, pool, src, dst, src_pool, dst_pool):
+                self._compile_log.append(("copy", bucket))
+                _TM_COMPILE_COPY.inc()
+                rows = lax.cond(
+                    src_pool,
+                    lambda _: Decoder.slot_prefix_rows(pool, src,
+                                                       bucket),
+                    lambda _: Decoder.slot_prefix_rows(serv, src,
+                                                       bucket),
+                    None)
+                serv = lax.cond(
+                    dst_pool, lambda s: s,
+                    lambda s: Decoder.slot_write_prefix_rows(s, dst,
+                                                             rows),
+                    serv)
+                pool = lax.cond(
+                    dst_pool,
+                    lambda p: Decoder.slot_write_prefix_rows(p, dst,
+                                                             rows),
+                    lambda p: p, pool)
+                return serv, pool
+
+            self._copy_fns[bucket] = jax.jit(
+                copy, donate_argnums=self._copy_donate)
+        return self._copy_fns[bucket]
+
+    def _dispatch_copy(self, length, src, dst, src_pool, dst_pool):
+        """Bucket ``length`` and dispatch the copy program (prefix-hit
+        admission or retention insert)."""
+        bucket = self._bucket_for(length)
+        with tele.span("serving.prefix_copy", cat="serving",
+                       bucket=bucket, to_pool=bool(dst_pool)):
+            self._caches, self._pool = self._copy_fn(bucket)(
+                self._caches, self._pool, np.int32(src), np.int32(dst),
+                np.bool_(src_pool), np.bool_(dst_pool))
+        self.stats["prefix_copies"] += 1
+
     @property
     def compile_counts(self):
-        """{'decode': n_traces, 'prefill': {bucket: n_traces}} — the
-        compile-count contract: after any workload, decode == 1 and
-        each USED bucket == 1 (doc/serving.md)."""
-        out = {"decode": 0, "prefill": {}}
+        """{'decode': n, 'prefill': {bucket: n}, 'copy': {bucket: n}}
+        — the compile-count contract: after any workload, decode == 1,
+        each USED prefill bucket == 1 and each USED copy bucket == 1
+        (chunked prefill reuses the prefill buckets — chunk start is a
+        traced operand, so chunking adds NO programs; one copy program
+        covers both pool→slot and slot→pool). doc/serving.md."""
+        out = {"decode": 0, "prefill": {}, "copy": {}}
         for tag in self._compile_log:
             if tag == "decode":
                 out["decode"] += 1
             else:
-                out["prefill"][tag[1]] = out["prefill"].get(tag[1], 0) + 1
+                fam = out[tag[0]]
+                fam[tag[1]] = fam.get(tag[1], 0) + 1
         return out
 
     # -- host scheduler -------------------------------------------------
@@ -419,8 +621,19 @@ class InferenceEngine:
 
     def _place_prompt(self, req):
         """Stager place fn: pad to the bucket and dispatch the h2d
-        (async) — runs up to stage_depth requests ahead of admission."""
+        (async) — runs up to stage_depth requests ahead of admission.
+
+        A prompt longer than ``prefill_chunk`` is guaranteed to admit
+        as chunk pieces built at admission time (the split depends on
+        the prefix match), so its full-prompt h2d would only be
+        discarded — stage nothing. A prefix HIT on a short prompt also
+        discards the staged array, but hits are unknowable this far
+        ahead of admission; the waste there is one small int32 h2d
+        (chunk/suffix arrays are a few KB — the prefill dispatch they
+        feed dominates)."""
         p = len(req.prompt)
+        if self.prefill_chunk and p > self.prefill_chunk:
+            return req, None
         bucket = self._bucket_for(p)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :p] = req.prompt
@@ -428,12 +641,15 @@ class InferenceEngine:
 
     def queued(self):
         """Requests submitted but not yet admitted to a slot."""
-        return len(self._pending) + self._stager.staged()
+        return len(self._pending) + self._stager.staged() \
+            + (self._held is not None)
 
     @property
     def idle(self):
         return not self._pending and self._stager.staged() == 0 \
-            and len(self._free) == self.slots and not self._drain
+            and self._held is None \
+            and len(self._free) == self.slots and not self._drain \
+            and not self._chunking
 
     def submit(self, prompt, max_tokens, eos_id=None, temperature=0.0,
                seed=None, request_id=None):
@@ -456,14 +672,36 @@ class InferenceEngine:
                 "InferenceEngine: request queue is full (%d waiting; "
                 "max_queue=%d) — step() the engine to drain it"
                 % (self.queued(), self.max_queue))
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        # validate shape/dtype HERE, where the caller can see the
+        # problem — a bad prompt forwarded to the compiled programs
+        # surfaces as an opaque shape/dtype error rounds later
+        try:
+            prompt = np.asarray(prompt)
+        except Exception as e:
+            raise MXNetError(
+                "InferenceEngine: prompt is not array-like (%s)" % e)
+        if prompt.ndim != 1:
+            raise MXNetError(
+                "InferenceEngine: prompt must be a 1-D token sequence "
+                "(one request per submit), got shape %r"
+                % (prompt.shape,))
         if prompt.size < 1:
             raise MXNetError("InferenceEngine: empty prompt")
+        if not np.issubdtype(prompt.dtype, np.integer):
+            raise MXNetError(
+                "InferenceEngine: prompt token ids must be integers, "
+                "got dtype %s (floats would be silently truncated)"
+                % prompt.dtype)
+        prompt = prompt.astype(np.int32)
         if prompt.size > self.max_len - 1:
             raise MXNetError(
                 "InferenceEngine: prompt length %d leaves no room to "
                 "generate (max_len=%d)" % (prompt.size, self.max_len))
-        self._bucket_for(prompt.size)  # validate against buckets now
+        if not self.prefill_chunk:
+            # monolithic prefill must fit one bucket program; chunked
+            # engines serve ANY prompt <= max_len - 1 in pieces (each
+            # piece <= prefill_chunk <= the largest bucket)
+            self._bucket_for(prompt.size)
         max_tokens = int(max_tokens)
         if max_tokens < 1:
             raise MXNetError("InferenceEngine: max_tokens must be >= 1")
@@ -482,39 +720,160 @@ class InferenceEngine:
         return req
 
     def _admit(self):
-        """Fill freed slots from the staged queue: one prefill dispatch
-        per admission, between device steps (iteration-level
-        scheduling). Returns how many requests were admitted."""
-        params, aux = self._dec._params, self._dec._aux
+        """Fill freed slots from the staged queue, between device
+        steps (iteration-level scheduling). Admission = prefix-cache
+        lookup (longest retained prefix → one compiled row copy into
+        the slot) + the FIRST prefill piece of the uncovered suffix;
+        further pieces run one budget's worth per round via the
+        chunking queue. Under chunking, each admission's first piece
+        draws from the round's prefill-token budget — a burst of
+        arrivals admits only as much prefill work per round as the
+        budget allows (the held request resumes first next round, so
+        FIFO order is preserved). Returns how many requests were
+        admitted."""
         admitted = 0
         while self._free:
-            try:
-                req, dev = self._stager.next()
-            except StopIteration:
+            if self._held is not None:
+                req, dev, self._held = \
+                    self._held[0], self._held[1], None
+            else:
+                try:
+                    req, dev = self._stager.next()
+                except StopIteration:
+                    break
+            p = len(req.prompt)
+            hit, entry, depth = 0, None, 0
+            if self._prefix is not None:
+                with tele.span("serving.prefix_lookup", cat="serving",
+                               hist=_TM_PREFIX_LOOKUP_MS):
+                    depth, entry = self._prefix.lookup(req.prompt)
+                # a FULL hit still re-prefills the last prompt token:
+                # the cache retains K/V only, and the first generated
+                # token needs the last position's logits
+                hit = min(depth, p - 1)
+                # a hit only pays when it REDUCES prefill work (fewer
+                # padded tokens across the piece split); otherwise the
+                # copy dispatch is pure overhead on top of the same
+                # bucket-quantized prefill — treat as miss
+                if hit > 0 and self._suffix_cost(p - hit) \
+                        >= self._suffix_cost(p):
+                    hit, entry = 0, None
+            first_piece = min(p - hit, self.prefill_chunk or p - hit)
+            if first_piece > self._round_budget:
+                # this round's prefill budget is spent: hold the
+                # request (admitted next round, before newer arrivals)
+                self._held = (req, dev)
                 break
             slot = self._free.popleft()
-            bucket = int(dev.shape[1])
-            fn = self._prefill_fn(bucket)
             req.t_admit = time.perf_counter()
             _TM_QUEUE_WAIT_MS.observe(
                 (req.t_admit - req.t_submit) * 1e3)
-            with tele.span("serving.prefill", cat="serving",
-                           bucket=bucket, slot=slot):
-                self._caches, self._state, t0 = fn(
-                    params, aux, self._caches, self._state,
-                    np.int32(slot), dev, np.int32(len(req.prompt)),
-                    np.float32(req.temperature), _raw_key(req.seed),
-                    np.int32(-1 if req.eos_id is None else req.eos_id),
-                    np.int32(req.limit))
-            self._drain.append(("prefill", req, slot, t0))
-            self.stats["prefills"] += 1
-            _TM_PREFILLS.inc()
+            if self._prefix is not None:
+                if hit > 0:
+                    self._prefix.acquire(entry)
+                    req.prefix_hit_tokens = hit
+                    self.stats["prefix_hits"] += 1
+                    self.stats["prefix_hit_tokens"] += hit
+                    _TM_PREFIX_HITS.inc()
+                    _TM_PREFIX_HIT_TOKENS.inc(hit)
+                    self._dispatch_copy(hit, src=entry.slot, dst=slot,
+                                        src_pool=True, dst_pool=False)
+                else:
+                    entry = None    # unused match: nothing to release
+                    _TM_PREFIX_MISSES.inc()
+            st = {"req": req, "slot": slot, "dev": dev, "next": hit,
+                  "entry": entry,
+                  # retain only prompts no entry already covers whole
+                  # (a second copy buys nothing) that fit the copy
+                  # bucket family (longer chunked prompts stay
+                  # unretained — their prefixes can still hit via
+                  # shorter entries)
+                  "insert": self._prefix is not None and depth < p
+                  and p <= self.prefill_buckets[-1]}
+            if not self._advance_chunk(st):
+                self._chunking.append(st)
             admitted += 1
         return admitted
 
+    def _suffix_cost(self, n):
+        """Prefill-work proxy for an ``n``-token suffix: total PADDED
+        tokens across its piece split — what bucket quantization
+        actually charges for (piece count alone would demote every hit
+        whose suffix and full prompt both fit one chunk)."""
+        chunk = self.prefill_chunk or n
+        total = 0
+        while n > 0:
+            piece = min(n, chunk)
+            total += self._bucket_for(piece)
+            n -= piece
+        return total
+
+    def _advance_chunk(self, st):
+        """Dispatch the next prefill piece for an admitted request:
+        the whole remaining suffix when chunking is off (or it fits),
+        else one ``prefill_chunk``-sized piece. The FINAL piece
+        samples the first token in-program and (prefix cache on)
+        retains the freshly built prompt K/V in the pool. Returns True
+        once the final piece is dispatched."""
+        req, slot = st["req"], st["slot"]
+        params, aux = self._dec._params, self._dec._aux
+        start = st["next"]
+        p = len(req.prompt)
+        remaining = p - start
+        piece = remaining if self.prefill_chunk == 0 \
+            else min(remaining, self.prefill_chunk)
+        final = start + piece == p
+        if start == 0 and piece == p and st["dev"] is not None:
+            dev = st["dev"]            # staged whole-prompt h2d
+            bucket = int(dev.shape[1])
+        else:
+            bucket = self._bucket_for(piece)
+            chunk = np.zeros((1, bucket), np.int32)
+            chunk[0, :piece] = req.prompt[start:start + piece]
+            dev = chunk
+        fn = self._prefill_fn(bucket)
+        with tele.span("serving.prefill", cat="serving", bucket=bucket,
+                       slot=slot, start=start):
+            self._caches, self._state, t0 = fn(
+                params, aux, self._caches, self._state,
+                np.int32(slot), dev, np.int32(start), np.int32(piece),
+                np.bool_(final), np.float32(req.temperature),
+                _raw_key(req.seed),
+                np.int32(-1 if req.eos_id is None else req.eos_id),
+                np.int32(req.limit))
+        req.prefill_chunks += 1
+        st["next"] = start + piece
+        self.stats["prefill_chunks"] += 1
+        self._round_budget -= piece
+        if not final:
+            return False
+        self._drain.append(("prefill", req, slot, t0))
+        self.stats["prefills"] += 1
+        _TM_PREFILLS.inc()
+        _TM_CHUNKS.observe(req.prefill_chunks)
+        if st["entry"] is not None:
+            self._prefix.release(st["entry"])
+        # a duplicate prompt admitted while this one was mid-chunk may
+        # have finished first and retained the same tokens — its rows
+        # are already byte-identical, so re-copying is a wasted dispatch
+        if st["insert"] and self._prefix.get(req.prompt) is None:
+            ev0 = self._prefix.evictions
+            new = self._prefix.insert(req.prompt)
+            _TM_PREFIX_EVICTIONS.inc(self._prefix.evictions - ev0)
+            if new is None:
+                _TM_PREFIX_INSERT_SKIPPED.inc()
+            else:
+                # the slot's rows [0, P) ARE the prompt K/V right now —
+                # the retention copy is ordered before the slot's
+                # decode writes by the cache-tree data dependency
+                self._dispatch_copy(p, src=slot, dst=new.slot,
+                                    src_pool=False, dst_pool=True)
+            _TM_PREFIX_BYTES.set(self._prefix.bytes_used)
+        return True
+
     def _busy(self):
         return (self.slots - len(self._free)) > 0 or bool(self._pending) \
-            or self._stager.staged() > 0
+            or self._stager.staged() > 0 or self._held is not None
 
     def _push_token(self, req, slot, t, done_now):
         assert t >= 0, "drained a token from a device-dead slot"
@@ -556,13 +915,27 @@ class InferenceEngine:
                         self._push_token(req, s, int(row[s]), done_now)
 
     def step(self):
-        """One scheduling round: admit staged requests into free slots,
-        dispatch ONE decode round (``steps_per_round`` fused all-slot
-        steps) if any slot is occupied, then drain output vectors that
-        are ``drain_depth`` dispatches old (all of them once nothing
-        is in flight). Returns the requests COMPLETED by this round,
-        in completion order."""
+        """One scheduling round: advance every mid-prefill request by
+        ONE chunk, admit staged requests into free slots (prefix copy
+        + first prefill piece), dispatch ONE decode round
+        (``steps_per_round`` fused all-slot steps) if any decodable
+        slot is occupied, then drain output vectors that are
+        ``drain_depth`` dispatches old (all of them once nothing is in
+        flight). Returns the requests COMPLETED by this round, in
+        completion order."""
         done_now = []
+        # chunked prefill, Sarathi-style per-round budget: at most
+        # ~prefill_chunk tokens of prefill work run between decode
+        # rounds — ONE piece of the oldest parked request, then
+        # admissions' first pieces until the budget is spent (_admit
+        # holds the overflow request for next round). Resident
+        # decoders therefore stall at most one budget's worth of
+        # prefill per round, however many long prompts are in flight.
+        self._round_budget = self.prefill_chunk or float("inf")
+        if self._chunking:
+            st = self._chunking.popleft()
+            if not self._advance_chunk(st):
+                self._chunking.append(st)
         admitted = self._admit()
         busy = self.slots - len(self._free)
         _TM_OCCUPANCY.set(busy)
@@ -572,7 +945,9 @@ class InferenceEngine:
             # 0 bucket exists for them); only fully-idle polls are
             # not a scheduling round
             _TM_ADMITTED.observe(admitted)
-        if busy > 0:
+        # slots still mid-prefill have nothing to decode: a round with
+        # ONLY those resident would be pure wasted dispatch
+        if busy - len(self._chunking) > 0:
             with tele.span("serving.decode_round", cat="serving",
                            slots_busy=busy):
                 self._caches, self._state, out = self._step_fn(

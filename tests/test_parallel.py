@@ -1343,7 +1343,12 @@ def _per_device_param_bytes(tr):
     return total
 
 
+@pytest.mark.slow
 def test_pipeline_per_stage_placement_memory_and_values():
+    # moved to the slow sweep (PR 5): the suite's heaviest test (~43 s)
+    # in a tier-1 run brushing the 870 s timeout; per-stage placement
+    # VALUE coverage stays tier-1 via test_pipeline_pp_sharded_big_params
+    # and test_pipeline_trainer_matches_single_device
     """param_placement='stage' (default) holds each stage's params and
     optimizer state ONLY on its own pp device (~1/S of the replicated
     footprint, VERDICT r2 next #4 — reference graph_executor.cc:341-458
@@ -1541,7 +1546,12 @@ def test_pipeline_remat_matches_no_remat():
                                    rtol=1e-5, atol=1e-6, err_msg=n)
 
 
+@pytest.mark.slow
 def test_pipeline_1f1b_matches_gpipe():
+    # moved to the slow sweep (PR 5, ~41 s — see the note above):
+    # 1f1b keeps tier-1 coverage via
+    # test_pipeline_1f1b_activation_memory_bounded, which steps the
+    # schedule end to end; the gpipe-equality oracle runs in slow
     """schedule='1f1b' (explicit interleaved fwd/bwd, activation memory
     bounded by 2S-1 in-flight microbatches instead of GPipe's M) trains
     to the same parameters as the GPipe schedule — on a pure-pp mesh

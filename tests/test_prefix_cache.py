@@ -1,0 +1,102 @@
+"""Host-side prefix-cache bookkeeping (mxnet_tpu/serving/prefix.py):
+trie lookup, refcounted-LRU eviction, byte-budget accounting — pure
+python unit tests, zero compiles (the device half of prefix reuse is
+pinned by tests/test_serving.py's byte-identity oracles)."""
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import PrefixCache
+
+
+def _pc(capacity=4, slot_bytes=1024):
+    return PrefixCache(capacity, slot_bytes)
+
+
+def test_lookup_longest_prefix_and_miss():
+    pc = _pc()
+    a = pc.insert((1, 2, 3, 4, 5))
+    b = pc.insert((1, 2, 9))
+    assert a.slot != b.slot and len(pc) == 2
+
+    # exact, partial (diverging tail), and nested-prefix matches
+    d, e = pc.lookup((1, 2, 3, 4, 5))
+    assert d == 5 and e is a
+    d, e = pc.lookup((1, 2, 3, 7, 7, 7))
+    assert d == 3 and e is a
+    d, e = pc.lookup((1, 2, 9, 9))
+    assert d == 3 and e is b
+    # the shared (1, 2) stem matches BOTH entries: either is valid,
+    # the match length is what matters
+    d, e = pc.lookup((1, 2))
+    assert d == 2 and e in (a, b)
+    # misses: cold token, and empty
+    assert pc.lookup((8, 1, 2)) == (0, None)
+    assert pc.lookup(()) == (0, None)
+
+
+def test_insert_duplicate_returns_existing():
+    pc = _pc()
+    a = pc.insert((4, 5, 6))
+    assert pc.insert((4, 5, 6)) is a
+    assert len(pc) == 1 and pc.inserts == 1
+    assert pc.get((4, 5, 6)) is a and pc.get((4, 5)) is None
+
+
+def test_lru_eviction_order_and_touch():
+    pc = _pc(capacity=2)
+    a = pc.insert((1, 1))
+    b = pc.insert((2, 2))
+    pc.lookup((1, 1))            # touch a: b is now LRU
+    c = pc.insert((3, 3))
+    assert pc.evictions == 1
+    assert pc.get((2, 2)) is None and pc.get((1, 1)) is a
+    assert pc.lookup((2, 2)) == (0, None)      # b's path is pruned
+    assert c.slot == b.slot                     # slot recycled
+    d, e = pc.lookup((3, 3, 9))
+    assert d == 2 and e is c
+
+
+def test_refcount_pins_against_eviction():
+    pc = _pc(capacity=1)
+    a = pc.insert((1, 2))
+    pc.acquire(a)
+    assert pc.insert((3, 4)) is None            # sole entry is pinned
+    assert pc.insert_skipped == 1 and pc.get((1, 2)) is a
+    pc.release(a)
+    b = pc.insert((3, 4))                       # now evictable
+    assert b is not None and pc.get((1, 2)) is None
+    assert pc.evictions == 1
+    with pytest.raises(MXNetError, match="release without acquire"):
+        pc.release(a)
+
+
+def test_eviction_prunes_only_the_unshared_suffix():
+    pc = _pc(capacity=2)
+    pc.insert((1, 2, 3, 4))
+    b = pc.insert((1, 2, 7))
+    pc.lookup((1, 2, 7))                        # (1,2,3,4) is LRU
+    pc.insert((9,))                             # evicts it
+    # the shared (1, 2) stem must survive for b; the 3->4 branch is gone
+    d, e = pc.lookup((1, 2, 3, 4))
+    assert d == 2 and e is b
+    d, e = pc.lookup((1, 2, 7, 7))
+    assert d == 3 and e is b
+
+
+def test_byte_budget_accounting():
+    pc = _pc(capacity=3, slot_bytes=2048)
+    assert pc.bytes_used == 0
+    pc.insert((1,))
+    pc.insert((2,))
+    assert pc.bytes_used == 2 * 2048
+    pc.insert((3,))
+    pc.insert((4,))                             # evicts: still 3 slots
+    assert pc.bytes_used == 3 * 2048 and len(pc) == 3
+
+
+def test_validation():
+    with pytest.raises(MXNetError, match="capacity"):
+        PrefixCache(0, 1024)
+    pc = _pc()
+    with pytest.raises(MXNetError, match="empty"):
+        pc.insert(())

@@ -55,6 +55,11 @@ def lm():
 def _engine(sym, params, **kw):
     kw.setdefault("slots", 2)
     kw.setdefault("prefill_buckets", (4, 8))
+    # prefix cache off unless a test opts in: the cache-on tests below
+    # pin its behavior; everything else pins the base engine (and the
+    # random prompts here would make copy-program compile counts
+    # draw-dependent)
+    kw.setdefault("prefix_cache_mb", 0)
     return InferenceEngine(Decoder(sym, params, max_len=T,
                                    cache_block=None), **kw)
 
@@ -109,7 +114,8 @@ def test_engine_mixed_lengths_slot_reuse_byte_identical(lm):
     assert eng.stats["prefills"] == len(cases) > eng.slots  # slot reuse
     for p, n, r in reqs:
         np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
-    assert eng.compile_counts == {"decode": 1, "prefill": {4: 1, 8: 1}}
+    assert eng.compile_counts == {"decode": 1, "prefill": {4: 1, 8: 1},
+                                  "copy": {}}
 
     # PR 4 (telemetry): the per-request latency breakdown is fully
     # populated and ordered; every request here retires on its token
@@ -136,7 +142,8 @@ def test_engine_mixed_lengths_slot_reuse_byte_identical(lm):
     eng.serve_forever()
     for p, n, r in wave2:
         np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
-    assert eng.compile_counts == {"decode": 1, "prefill": {4: 1, 8: 1}}
+    assert eng.compile_counts == {"decode": 1, "prefill": {4: 1, 8: 1},
+                                  "copy": {}}
     assert eng.idle
 
 
@@ -245,7 +252,12 @@ def test_engine_cache_flavors_match_offline(flavor):
     """The slot-paged engine reuses the Decoder's cache layouts
     verbatim: int8-quantized entries and sliding-window rings (with
     rope, plus the ring-position reset on slot reuse) both byte-match
-    their own offline decoder."""
+    their own offline decoder — WITH the prefix cache and chunked
+    prefill requested. int8 entries copy their row scales alongside
+    (real hits asserted); windowed models BYPASS the prefix cache
+    (ring eviction invalidates absolute-position reuse — pinned here)
+    but still chunk their prefills exactly (the ring's read-before-
+    write chunk math at nonzero start positions)."""
     rng = np.random.RandomState(5)
     if flavor == "int8":
         sym, deckw = _lm(), dict(cache_dtype="int8")
@@ -255,14 +267,96 @@ def test_engine_cache_flavors_match_offline(flavor):
     dec = Decoder(sym, params, max_len=T, cache_block=None, **deckw)
     eng = InferenceEngine(
         Decoder(sym, params, max_len=T, cache_block=None, **deckw),
-        slots=2, prefill_buckets=(4, 8))
-    reqs = [(p, n, eng.submit(p, max_tokens=n))
-            for pl, n in [(3, 5), (6, 4), (3, 5), (6, 4), (3, 5)]
-            for p in [rng.randint(0, VOCAB, (pl,))]]
+        slots=2, prefill_buckets=(4, 8),
+        prefix_cache_mb=0.01, prefill_chunk=4)
+    # shared prefixes ON PURPOSE: the repeats hit the cache (int8),
+    # same (prompt_len, max_tokens) shapes as before for oracle reuse
+    base = rng.randint(0, VOCAB, (6,))
+    cases = [(rng.randint(0, VOCAB, (3,)), 5), (base, 4),
+             (base[:3].copy(), 5), (base.copy(), 4),
+             (np.concatenate([base[:3], rng.randint(0, VOCAB, (3,))]),
+              4)]
+    reqs = [(p, n, eng.submit(p, max_tokens=n)) for p, n in cases]
     eng.serve_forever()
     assert eng.stats["prefills"] > eng.slots  # reuse exercised the reset
     for p, n, r in reqs:
         np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
+    if flavor == "int8":
+        assert eng.stats["prefix_hit_tokens"] > 0  # scales copied too
+        assert eng.compile_counts["copy"]
+    else:
+        assert eng._prefix is None and eng._pool is None  # the bypass
+        assert eng.compile_counts["copy"] == {}
+        assert eng.stats["prefill_chunks"] > len(cases)  # chunks ran
+
+
+def test_engine_prefix_cache_chunked_byte_identical(lm):
+    """THE tentpole oracle: with the prefix cache AND chunked prefill
+    on, greedy outputs stay byte-identical to the offline decoder (=
+    the cache-off engine pinned by every other test here) across full
+    hits, partial hits, misses, chunk-boundary prompts, LRU eviction
+    under a one-slot byte budget, and a second admission order on the
+    same engine — while the compile contract extends to exactly one
+    copy program per used bucket."""
+    sym, params, dec = lm
+    rng = np.random.RandomState(13)
+    base = rng.randint(0, VOCAB, (7,))
+    # (prompt, max_tokens) — shapes reuse the module's oracle compiles;
+    # prompt lengths 3/4/6/7 straddle the chunk size 3 (exact multiple,
+    # one-over, one-under) and share engineered prefixes
+    cases = {
+        "miss_long": (base, 3),                      # retained; 3 chunks
+        "prefix_of": (base[:4].copy(), 6),           # hit 3 of 4
+        "partial": (np.concatenate([base[:4],
+                                    rng.randint(0, VOCAB, (3,))]), 3),
+        "unrelated": (rng.randint(0, VOCAB, (2,)), 5),   # miss, 1 chunk
+        "full_dup": (base.copy(), 3),                # full hit -> P-1
+        "boundary": (rng.randint(0, VOCAB, (6,)), 2),    # exactly 2 chunks
+        # past the largest bucket (8): only CHUNKED admission can
+        # serve it (monolithic submit would reject); not retained
+        "beyond_bucket": (rng.randint(0, VOCAB, (10,)), 3),
+    }
+    # pool budget = ONE slot (1-layer f32 K+V slot is 2 KiB): every
+    # retention past the first EVICTS — identity must survive serving
+    # from, and losing, any entry
+    eng = _engine(sym, params, prefix_cache_mb=0.0021, prefill_chunk=3)
+    assert eng._prefix is not None and eng._prefix.capacity == 1
+    order1 = ["miss_long", "prefix_of", "partial", "unrelated",
+              "full_dup", "boundary", "beyond_bucket"]
+    rs = {k: eng.submit(*cases[k]) for k in order1}
+    eng.serve_forever()
+    for k, (p, n) in cases.items():
+        np.testing.assert_array_equal(rs[k].result(), _oracle(dec, p, n))
+    assert eng.stats["prefix_hits"] >= 1          # some reuse happened
+    assert eng.stats["prefill_chunks"] > len(cases)   # chunking ran
+    assert sum(r.prefill_chunks for r in rs.values()) \
+        == eng.stats["prefill_chunks"]
+    assert eng._prefix.evictions >= 1             # the 1-slot pool churned
+    cc = eng.compile_counts
+    assert cc["decode"] == 1
+    assert cc["copy"] and all(v == 1 for v in cc["copy"].values())
+    assert all(v == 1 for v in cc["prefill"].values())
+
+    # second wave, REVERSED admission order, same engine (zero new
+    # compiles): hit/miss patterns differ completely, outputs must not
+    log_len = len(eng._compile_log)
+    rs2 = {k: eng.submit(*cases[k]) for k in reversed(order1)}
+    eng.serve_forever()
+    for k, (p, n) in cases.items():
+        np.testing.assert_array_equal(rs2[k].result(),
+                                      _oracle(dec, p, n))
+    assert len(eng._compile_log) == log_len
+    assert eng.idle
+
+    # telemetry satellite: the new serving.prefix_*/chunk metrics are
+    # populated in the process-wide snapshot (lower bounds — shared
+    # registry)
+    snap = mx.telemetry.snapshot()["serving"]
+    assert snap["prefix_hit_tokens"] >= 1
+    assert snap["prefix_lookup_ms"]["count"] >= len(cases)
+    assert snap["prefix_cache_bytes"] >= 0
+    assert snap["prefill_chunks_per_request"]["count"] >= len(cases)
+    assert snap["compiles_copy"] >= 1
 
 
 def test_window_prefill_pad_rows_do_not_corrupt_ring():
@@ -398,6 +492,17 @@ def test_engine_validation(lm, shared_engine):
         _engine(sym, params, prefill_buckets=(8, 4))
     with pytest.raises(MXNetError, match="empty prompt"):
         eng.submit([], max_tokens=2)
+    # dtype/rank validation (PR satellite): a 2-D prompt or float ids
+    # used to flow into the compiled programs and die as opaque
+    # shape/dtype errors rounds later
+    with pytest.raises(MXNetError, match="1-D"):
+        eng.submit(np.ones((2, 3), np.int32), max_tokens=2)
+    with pytest.raises(MXNetError, match="integers"):
+        eng.submit(np.array([1.5, 2.0]), max_tokens=2)
+    with pytest.raises(MXNetError, match="prefill_chunk"):
+        _engine(sym, params, prefill_chunk=-1)
+    with pytest.raises(MXNetError, match="prefix_cache_mb"):
+        _engine(sym, params, prefix_cache_mb=-1)
     with pytest.raises(MXNetError, match="no room"):
         eng.submit(np.zeros(T, np.int32), max_tokens=2)
     with pytest.raises(MXNetError, match="largest .* bucket"):

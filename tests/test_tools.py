@@ -321,3 +321,38 @@ def test_dump_telemetry_snapshot_and_trace(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "t10.region" in out and "t10.event" in out
     assert "trace events" in out
+
+
+def test_dump_telemetry_serving_filter(tmp_path, capsys):
+    """--serving (PR 5 satellite): the per-request prefix/chunk stats
+    tabulate next to TTFT and cadence — one view answers whether the
+    prefix cache and chunking moved the latencies."""
+    from tools import dump_telemetry
+
+    def hist(v):
+        return {"count": 1, "sum": v, "mean": v, "min": v, "max": v,
+                "buckets": {"%g" % v: 1}, "p50": v, "p99": v}
+
+    # literal snapshot (not the live registry — it is process-global
+    # and earlier serving tests feed the same names)
+    snap = {"serving": {
+        "prefix_hits": 3, "prefix_misses": 1, "prefix_hit_tokens": 96,
+        "completed": 4, "tokens": 40, "prefix_cache_bytes": 2048.0,
+        "ttft_ms": hist(5.0), "token_cadence_ms": hist(1.5),
+        "queue_wait_ms": hist(0.4), "prefix_lookup_ms": hist(0.02),
+        "prefill_chunks_per_request": hist(4),
+        "compiles_decode": 1, "compiles_prefill": 2,
+        "compiles_copy": 2,
+    }}
+    snap_path = tmp_path / "snap.json"
+    snap_path.write_text(json.dumps(snap))
+    dump_telemetry.main([str(snap_path), "--serving"])
+    out = capsys.readouterr().out
+    assert "hit_rate=0.75" in out and "hit_tokens=96" in out
+    for key in ("ttft_ms", "token_cadence_ms", "prefix_lookup_ms",
+                "prefill_chunks_per_request"):
+        assert key in out
+    # a snapshot with no serving section degrades gracefully
+    (tmp_path / "empty.json").write_text("{}")
+    dump_telemetry.main([str(tmp_path / "empty.json"), "--serving"])
+    assert "no serving metrics" in capsys.readouterr().out

@@ -1,4 +1,5 @@
-"""Serving-engine sweep: slot counts x arrival rates.
+"""Serving-engine sweeps: slot counts x arrival rates, and (ISSUE 5)
+prefix-cache hit-rate x prefill-chunk size.
 
 Drives ``bench.bench_serving`` (the continuous-batching engine under
 Poisson arrivals with mixed prompt/output lengths) over a grid of
@@ -7,6 +8,15 @@ discipline as bench_decode: each cell runs ``--reps`` times, reports
 the MEDIAN tokens/s and the relative spread ``(max-min)/median`` —
 a cell whose spread exceeds ~0.2 is dispatch-jitter, not signal
 (doc/performance.md has the relay-measurement story).
+
+``--hit-rates``/``--chunk-sizes`` add a second grid over
+``bench.bench_serving_prefix`` (shared-system-prompt workload): each
+cell serves the same request stream with the given fraction sharing a
+192-token system prefix and the given ``prefill_chunk`` (0 = off),
+reporting p50 TTFT, cadence p99, tokens/s and hit tokens — the
+hit-rate axis shows where prefix-copy reuse starts paying off over
+re-prefilling, the chunk axis what bounding decode stalls costs in
+throughput. ``--no-prefix-sweep`` skips it.
 
 Run from the repo root::
 
@@ -20,6 +30,8 @@ Prints one JSON dict::
                               "spread": (max-min)/median,
                               "p50_ms_per_token": ..., "p99_ms_per_token": ...,
                               "compile_programs": ...},
+   "h<hit_rate>_c<chunk>": {"ttft_p50_ms": ..., "cadence_p99_ms": ...,
+                            "tokens_per_sec": ..., "prefix_hit_tokens": ...},
    ..., "config": {...}}
 
 The slot sweep is the capacity knob (decode cost per step is nearly
@@ -54,6 +66,17 @@ def main():
     ap.add_argument("--vocab", type=int, default=32000)
     ap.add_argument("--max-len", type=int, default=1024)
     ap.add_argument("--requests", type=int, default=96)
+    ap.add_argument("--hit-rates", type=float, nargs="+",
+                    default=[0.0, 0.5, 0.9],
+                    help="prefix-sweep axis: fraction of requests "
+                         "sharing the system prompt")
+    ap.add_argument("--chunk-sizes", type=int, nargs="+",
+                    default=[0, 128],
+                    help="prefix-sweep axis: prefill_chunk per cell "
+                         "(0 = monolithic prefill)")
+    ap.add_argument("--prefix-requests", type=int, default=48,
+                    help="requests per prefix-sweep cell")
+    ap.add_argument("--no-prefix-sweep", action="store_true")
     args = ap.parse_args()
 
     import bench
@@ -89,6 +112,37 @@ def main():
             out["s%d_a%g" % (slots, arrival)] = cell
             print("s%d_a%g: %r" % (slots, arrival, cell),
                   file=sys.stderr)
+
+    # hit-rate x chunk-size grid over the shared-system-prompt arm:
+    # one engine config per cell (cache ON; chunk as given), same
+    # request stream per seed so cells are comparable
+    if not args.no_prefix_sweep:
+        # geometry scales with max_len so smoke configs stay valid
+        # (chunk included: a chunk past the largest bucket is rejected
+        # by the engine, and the largest bench bucket is <= max_len)
+        shared = min(192, args.max_len // 4)
+        long_len = min(512, args.max_len // 2)
+        seen = set()
+        for hr in args.hit_rates:
+            for chunk in args.chunk_sizes:
+                chunk = min(chunk, args.max_len // 2)
+                if (hr, chunk) in seen:
+                    continue
+                seen.add((hr, chunk))
+                r = bench.bench_serving_prefix(
+                    slots=max(args.slots[0], 2), layers=args.layers,
+                    embed=args.embed, heads=args.heads,
+                    vocab=args.vocab, max_len=args.max_len,
+                    n_requests=args.prefix_requests, hit_rate=hr,
+                    shared_len=shared, tail_len=max(8, shared // 6),
+                    long_len=long_len, chunk=chunk, seed=11)
+                cell = {k: r[k] for k in
+                        ("ttft_p50_ms", "cadence_p99_ms",
+                         "tokens_per_sec", "prefix_hit_tokens",
+                         "prefill_chunks", "compile_programs")}
+                out["h%g_c%d" % (hr, chunk)] = cell
+                print("h%g_c%d: %r" % (hr, chunk, cell),
+                      file=sys.stderr)
     print(json.dumps(out, sort_keys=True))
 
 

@@ -9,12 +9,20 @@ Usage::
     python tools/dump_telemetry.py BENCH_extra.json      # snapshot tree
     python tools/dump_telemetry.py /tmp/tr/mx_trace_1.json  # trace table
     python tools/dump_telemetry.py trace.json --names io. train.
+    python tools/dump_telemetry.py BENCH_extra.json --serving
 
 The file kind is auto-detected (a trace has a ``traceEvents`` list).
 Snapshot histograms print as one ``count/mean/p50/p99 [min..max]``
 line; traces print a per-span-name table (count, total/mean/max ms)
 plus instant-event counts — the quick "where did the time go" read
 for benchmark and fault-injection runs without opening Perfetto.
+
+``--serving`` narrows to the serving engine: request latencies (queue
+wait / TTFT / token cadence) tabulated NEXT TO the prefix-cache and
+chunked-prefill stats that explain them (hit tokens saved, lookup
+cost, chunks per request, pool bytes, compile counts) — the one-look
+answer to "did the cache/chunking actually move TTFT and p99". On a
+trace file it filters to ``serving.`` spans.
 """
 from __future__ import annotations
 
@@ -34,7 +42,8 @@ def _is_histogram(v):
         "buckets" in v or set(v) == {"count"})
 
 
-def print_snapshot(snap, indent=0, out=sys.stdout):
+def print_snapshot(snap, indent=0, out=None):
+    out = out or sys.stdout
     pad = "  " * indent
     for key in sorted(snap):
         v = snap[key]
@@ -52,7 +61,45 @@ def print_snapshot(snap, indent=0, out=sys.stdout):
             out.write("%s%-28s %s\n" % (pad, key, v))
 
 
-def print_trace(doc, name_filters=(), out=sys.stdout):
+def print_serving(snap, out=None):
+    """Serving-focused table: per-request latency histograms beside
+    the prefix/chunk stats (doc/serving.md "Measuring it")."""
+    out = out or sys.stdout
+    s = snap.get("serving")
+    if not isinstance(s, dict) or not s:
+        out.write("(no serving metrics in this snapshot)\n")
+        return
+    hits = s.get("prefix_hits", 0)
+    misses = s.get("prefix_misses", 0)
+    out.write("serving requests: completed=%s tokens=%s "
+              "retired_eos=%s retired_length=%s\n"
+              % (s.get("completed", 0), s.get("tokens", 0),
+                 s.get("retired_eos", 0), s.get("retired_length", 0)))
+    out.write("prefix cache:     hits=%d misses=%d hit_rate=%s "
+              "hit_tokens=%s bytes=%s evictions=%s skipped=%s\n"
+              % (hits, misses,
+                 "n/a" if not hits + misses
+                 else "%.2f" % (hits / float(hits + misses)),
+                 s.get("prefix_hit_tokens", 0),
+                 s.get("prefix_cache_bytes", 0),
+                 s.get("prefix_evictions", 0),
+                 s.get("prefix_insert_skipped", 0)))
+    out.write("compiles:         decode=%s prefill=%s copy=%s\n"
+              % (s.get("compiles_decode", 0),
+                 s.get("compiles_prefill", 0),
+                 s.get("compiles_copy", 0)))
+    out.write("\n%-28s %s\n" % ("per-request", "distribution"))
+    for key in ("queue_wait_ms", "ttft_ms", "token_cadence_ms",
+                "prefix_lookup_ms", "prefill_chunks_per_request",
+                "admitted_per_round", "slots_busy_per_round"):
+        v = s.get(key)
+        if _is_histogram(v):
+            out.write("%-28s %s\n"
+                      % (key, _fmt_hist(v) if v["count"] else "(empty)"))
+
+
+def print_trace(doc, name_filters=(), out=None):
+    out = out or sys.stdout
     evs = doc.get("traceEvents", [])
     spans, instants = {}, {}
     for e in evs:
@@ -93,18 +140,29 @@ def main(argv=None):
     ap.add_argument("--names", nargs="*", default=(),
                     help="only trace spans whose name starts with one "
                          "of these prefixes (e.g. --names io. train.)")
+    ap.add_argument("--serving", action="store_true",
+                    help="serving-engine view: request latency "
+                         "histograms tabulated next to the prefix-"
+                         "cache/chunked-prefill stats (snapshots), or "
+                         "serving.* spans only (traces)")
     args = ap.parse_args(argv)
     with open(args.file) as f:
         doc = json.load(f)
     if isinstance(doc, dict) and isinstance(doc.get("traceEvents"),
                                             list):
-        print_trace(doc, tuple(args.names))
+        names = tuple(args.names)
+        if args.serving:
+            names += ("serving.",)
+        print_trace(doc, names)
         return
     # snapshot, possibly wrapped (BENCH_extra.json carries it under
     # the "telemetry" key)
     if isinstance(doc, dict) and "telemetry" in doc \
             and isinstance(doc["telemetry"], dict):
         doc = doc["telemetry"]
+    if args.serving:
+        print_serving(doc)
+        return
     print_snapshot(doc)
 
 
