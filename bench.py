@@ -595,6 +595,134 @@ def bench_serving_prefix(slots=16, layers=12, embed=768, heads=12,
     }
 
 
+def bench_serving_overload(slots=16, layers=12, embed=768, heads=12,
+                           vocab=32000, max_len=512, n_requests=64,
+                           seed=0, prompt_len=96, out_tokens=32,
+                           slo_factor=3.0):
+    """Overload-policy A/B (ISSUE 7): ONE engine — same weights, same
+    compiled programs, policy knobs flipped between arms — serves an
+    IDENTICAL 2x-saturating Poisson arrival schedule twice:
+
+    * ``overload='block'``, queue deep enough for the whole run: every
+      request is accepted and ages in the queue; its SLO deadline
+      keeps ticking, so backlogged requests die at the round sweep
+      (cheap) or mid-flight after wasting prefill + decode slot-time.
+    * ``overload='shed'``, queue bounded at ``slots``: excess submits
+      fail fast with ``EngineOverloaded`` (zero engine work wasted —
+      the router would retry another replica); admitted requests keep
+      most of their deadline budget and complete.
+
+    Saturation is CALIBRATED, not assumed: a full-batch warm pass
+    measures the service rate, arrivals run at 2x it, and the SLO is
+    ``slo_factor`` x the full-batch service time. Goodput counts
+    tokens of requests that COMPLETED (eos/length) per wall second —
+    deadline-retired work is wasted capacity, shed requests cost
+    nothing. Headline: ``serving_shed_goodput_ratio`` = shed goodput /
+    block goodput (> 1 when shedding protects the serving capacity).
+
+    Returns {"goodput_ratio", "block": {...}, "shed": {...},
+    "slo_ms", "service_req_per_s", "compile_programs"}.
+    """
+    import jax.numpy as jnp
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.models import get_transformer_lm
+    from mxnet_tpu.parallel import Decoder
+    from mxnet_tpu.serving import InferenceEngine, EngineOverloaded
+
+    sym = get_transformer_lm(vocab, num_layers=layers, embed_dim=embed,
+                             num_heads=heads, impl="flash")
+    rng = np.random.RandomState(seed)
+    shapes = {"data": (8, max_len), "softmax_label": (8, max_len)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    params = {n: jnp.asarray(rng.uniform(-0.05, 0.05, sh)
+                             .astype(np.float32))
+              for n, sh in zip(sym.list_arguments(), arg_shapes)
+              if n not in shapes}
+    prompt_len = min(prompt_len, max_len - out_tokens - 1)
+    bucket = next(b for b in (64, 128, 256, max_len)
+                  if b >= prompt_len and b <= max_len)
+    dec = Decoder(sym, params, max_len=max_len,
+                  compute_dtype="bfloat16", cache_block=None)
+    engine = InferenceEngine(dec, slots=slots,
+                             prefill_buckets=(bucket,),
+                             max_queue=4 * n_requests,
+                             steps_per_round=8, prefix_cache_mb=0)
+
+    wl = np.random.RandomState(seed + 1)
+    prompts = [wl.randint(0, vocab, (prompt_len,))
+               for _ in range(n_requests)]
+
+    # warmup (compiles) + calibration: a full batch of `slots`
+    # concurrent requests measures the service rate the arrival
+    # process must double
+    for p in prompts[:slots]:
+        engine.submit(p, max_tokens=out_tokens)
+    engine.serve_forever()        # includes the compile; re-run timed
+    for p in prompts[:slots]:
+        engine.submit(p, max_tokens=out_tokens)
+    t0 = time.perf_counter()
+    engine.serve_forever()
+    batch_s = time.perf_counter() - t0
+    service_rate = slots / batch_s              # req/s at capacity
+    slo_ms = slo_factor * batch_s * 1e3
+    inter = 1.0 / (2.0 * service_rate)          # 2x saturation
+
+    def run_arm(policy, max_queue):
+        engine.overload, engine.max_queue = policy, max_queue
+        arrivals = np.cumsum(np.random.RandomState(seed + 2)
+                             .exponential(inter, size=n_requests))
+        handles, shed, i = [], 0, 0
+        t0 = time.perf_counter()
+        while i < n_requests or not engine.idle:
+            now = time.perf_counter() - t0
+            while i < n_requests and arrivals[i] <= now:
+                try:
+                    handles.append(engine.submit(
+                        prompts[i], max_tokens=out_tokens,
+                        deadline_ms=slo_ms))
+                except EngineOverloaded:
+                    shed += 1
+                except MXNetError:
+                    break       # block backpressure: drain first
+                i += 1
+            for h in engine.step():
+                pass
+        dt = time.perf_counter() - t0
+        good = [h for h in handles
+                if h.retire_reason in ("eos", "length")]
+        missed = sum(1 for h in handles
+                     if h.retire_reason == "deadline")
+        return {
+            "goodput_tokens_per_sec":
+                round(sum(len(h.tokens) for h in good) / dt, 1),
+            "completed": len(good),
+            "deadline_missed": missed,
+            "shed": shed,
+            "wall_s": round(dt, 3),
+        }
+
+    block = run_arm("block", 4 * n_requests)
+    shed = run_arm("shed", slots)
+    engine.overload, engine.max_queue = "block", 4 * n_requests
+    cc = engine.compile_counts
+    assert cc["decode"] == 1 \
+        and all(v == 1 for v in cc["prefill"].values()) \
+        and not cc["copy"], \
+        "compile-count contract violated: %r" % (cc,)
+    ratio = None if not block["goodput_tokens_per_sec"] else round(
+        shed["goodput_tokens_per_sec"]
+        / block["goodput_tokens_per_sec"], 3)
+    return {
+        "goodput_ratio": ratio,
+        "block": block,
+        "shed": shed,
+        "slo_ms": round(slo_ms, 1),
+        "service_req_per_s": round(service_rate, 2),
+        "arrival_req_per_s": round(2 * service_rate, 2),
+        "compile_programs": cc["decode"] + sum(cc["prefill"].values()),
+    }
+
+
 def bench_recordio_io():
     """C++ ImageRecordIOIter: run tools/bench_io.py in a CLEAN
     subprocess (no jax): on this 1-core container the jax/axon runtime
@@ -952,6 +1080,13 @@ def main():
     except Exception:
         traceback.print_exc()
         serving_prefix = None
+    # overload-policy A/B (ISSUE 7): shed vs block goodput at a
+    # calibrated 2x saturation, every request under the same SLO
+    try:
+        serving_overload = bench_serving_overload()
+    except Exception:
+        traceback.print_exc()
+        serving_overload = None
     def _dec_best_ms():
         if not dec_arms:
             return None
@@ -1019,6 +1154,19 @@ def main():
                     "arrival rates",
         },
         "serving_prefix_cache_chunked_prefill": serving_prefix,
+        "serving_overload_shed_vs_block": None if serving_overload is None
+        else {
+            **serving_overload,
+            "note": "ONE engine, policy knobs flipped between arms, "
+                    "identical 2x-saturating Poisson schedule (rate "
+                    "calibrated from a full-batch service pass), every "
+                    "request under the same SLO deadline; goodput = "
+                    "tokens of COMPLETED requests per wall second "
+                    "(deadline-retired work is wasted capacity, shed "
+                    "requests cost nothing); goodput_ratio = shed / "
+                    "block — doc/serving.md 'Serving under hostile "
+                    "traffic'",
+        },
         "calibration": {
             "gemm_8192_bf16_tflops":
                 None if ceiling is None else round(ceiling / 1e12, 1),
@@ -1107,6 +1255,9 @@ def main():
             "serving_chunked_p99_ms":
                 None if serving_prefix is None
                 else serving_prefix["chunked_128"]["cadence_p99_ms"],
+            "serving_shed_goodput_ratio":
+                None if serving_overload is None
+                else serving_overload["goodput_ratio"],
             "cifar10_img_per_sec":
                 None if cifar is None else round(cifar, 1),
             "cifar10_vs_gtx980":

@@ -559,6 +559,23 @@ class StagedStream:
                 return
             self._queue.append(self._placefn(item))
 
+    def prune(self, pred):
+        """Drop staged items matching ``pred`` (inline mode only) and
+        return them — the serving engine retires queue-waiting requests
+        (deadline expiry, cancellation, load shedding) that its stager
+        already pulled and placed, without disturbing the rest of the
+        staged order."""
+        if self._threaded:
+            raise MXNetError("StagedStream.prune: inline mode only "
+                             "(threaded staging owns its queue)")
+        kept, dropped = [], []
+        for x in self._queue:        # single pass: pred may be stateful
+            (dropped if pred(x) else kept).append(x)
+        if dropped:
+            self._queue.clear()
+            self._queue.extend(kept)
+        return dropped
+
     # -- lifecycle ------------------------------------------------------
     def reset(self):
         """Discard staged items (stale after a source rewind) and

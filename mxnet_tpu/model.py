@@ -1105,6 +1105,7 @@ class FeedForward(BASE_ESTIMATOR):
     def as_serving_engine(self, max_len, slots=8, prefill_buckets=None,
                           max_queue=256, steps_per_round=1,
                           prefix_cache_mb=None, prefill_chunk=None,
+                          overload=None, round_timeout_ms=None,
                           **decoder_kwargs):
         """Trained estimator → continuous-batching inference engine
         (``mxnet_tpu.serving.InferenceEngine``, doc/serving.md): the
@@ -1113,7 +1114,9 @@ class FeedForward(BASE_ESTIMATOR):
         checkpoint-to-engine path ``InferenceEngine.from_checkpoint``
         takes, minus the file round-trip. ``decoder_kwargs`` reach the
         underlying ``Decoder`` (``compute_dtype``, ``cache_dtype``,
-        ...)."""
+        ...); ``overload``/``round_timeout_ms`` are the robustness
+        knobs (load shedding policy, round watchdog — doc/serving.md
+        "Serving under hostile traffic")."""
         from .parallel.decode import Decoder
         from .serving import InferenceEngine
 
@@ -1138,7 +1141,9 @@ class FeedForward(BASE_ESTIMATOR):
                                max_queue=max_queue,
                                steps_per_round=steps_per_round,
                                prefix_cache_mb=prefix_cache_mb,
-                               prefill_chunk=prefill_chunk)
+                               prefill_chunk=prefill_chunk,
+                               overload=overload,
+                               round_timeout_ms=round_timeout_ms)
 
     @staticmethod
     def load(prefix, epoch, ctx=None, **kwargs):

@@ -705,6 +705,22 @@ class Decoder:
 
         return jax.tree_util.tree_map(write, caches, rows)
 
+    @staticmethod
+    def buffers_ready(tree):
+        """True when every dispatched device buffer in ``tree`` has
+        materialized — a NON-blocking readiness probe (leaves without
+        ``is_ready`` count as ready). The serving engine's round
+        watchdog polls this instead of letting ``np.asarray`` block
+        forever on a wedged dispatch: a bounded host-side wait is what
+        turns "the device hung" from a silent `serve_forever` freeze
+        into a typed, recoverable error (doc/serving.md robustness).
+        Purely host-side — no device op, no sync, no compilation."""
+        for leaf in jax.tree_util.tree_leaves(tree):
+            ready = getattr(leaf, "is_ready", None)
+            if ready is not None and not ready():
+                return False
+        return True
+
     # -- user API -------------------------------------------------------
     @staticmethod
     def clone_cache(caches):

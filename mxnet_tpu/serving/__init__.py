@@ -12,8 +12,19 @@ prefix-cache row copy) with iteration-level scheduling between device
 steps (Orca, OSDI '22; slot-structured caches after vLLM's
 PagedAttention, SOSP '23; prefix reuse after RadixAttention and
 chunk-interleaved prefill after Sarathi-Serve).
+
+Robustness layer (doc/serving.md "Serving under hostile traffic"):
+per-request deadlines and :meth:`InferenceEngine.cancel`, overload
+shedding (:class:`EngineOverloaded`), a round watchdog
+(:class:`EngineStuck`), poisoned-request isolation, crash-safe
+:meth:`InferenceEngine.snapshot` / :meth:`InferenceEngine.restore`,
+and a :meth:`InferenceEngine.close` shutdown path
+(:class:`EngineClosed`) — all host-side, the compiled program
+families above are frozen.
 """
-from .engine import InferenceEngine, Request
+from .engine import (InferenceEngine, Request, EngineOverloaded,
+                     EngineClosed, EngineStuck)
 from .prefix import PrefixCache
 
-__all__ = ["InferenceEngine", "Request", "PrefixCache"]
+__all__ = ["InferenceEngine", "Request", "PrefixCache",
+           "EngineOverloaded", "EngineClosed", "EngineStuck"]

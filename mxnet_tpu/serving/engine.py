@@ -56,10 +56,25 @@ Determinism guarantees (pinned by tests/test_serving.py): greedy
 ``Decoder.generate`` per request, regardless of admission order, slot
 assignment, co-resident requests, or bucket padding; sampled outputs
 depend only on ``(seed, position)`` — not on scheduling.
+
+Robustness (doc/serving.md "Serving under hostile traffic", all
+host-side — the three compiled program families above are the ONLY
+device programs, frozen): per-request deadlines
+(``deadline_ms``/``ttft_deadline_ms``) and :meth:`cancel` retire work
+at round boundaries through the same dead-slot freeze + slot-recycle
+machinery normal retirement uses; ``overload`` policies shed load with
+a typed :class:`EngineOverloaded` instead of queueing unboundedly; a
+round watchdog (``round_timeout_ms``) turns a wedged device dispatch
+into a typed, recoverable :class:`EngineStuck`; per-request host
+failures poison only their own request; :meth:`snapshot` /
+:meth:`restore` rebuild the scheduler after a crash with
+byte-identical continuations; :meth:`close` fails everything pending
+with :class:`EngineClosed` and is idempotent.
 """
 from __future__ import annotations
 
 import collections
+import math
 import os
 import time
 
@@ -74,7 +89,35 @@ from ..io import StagedStream
 from ..parallel.decode import Decoder
 from .prefix import PrefixCache
 
-__all__ = ["InferenceEngine", "Request"]
+__all__ = ["InferenceEngine", "Request", "EngineOverloaded",
+           "EngineClosed", "EngineStuck"]
+
+# serving-side fault injection (mxnet_tpu.testing.faults): an installed
+# injector's hooks run at the engine's host-side seams — h2d/prefill
+# admission work, post-dispatch (simulated crash), and the watchdog's
+# readiness poll. None in production; never on a device path.
+_SERVING_FAULTS = None
+
+
+class EngineOverloaded(MXNetError):
+    """Typed overload signal: raised by ``submit`` under the ``shed``
+    policy when the queue is full (and attached as the ``error`` of
+    requests evicted by ``shed_oldest``). Callers fail fast and retry
+    against another replica instead of queueing into a missed SLO."""
+
+
+class EngineClosed(MXNetError):
+    """The engine was shut down: raised by ``submit``/``step`` after
+    :meth:`InferenceEngine.close`, and attached as the ``error`` of
+    requests that were still pending when close ran."""
+
+
+class EngineStuck(MXNetError):
+    """Round watchdog trip: a dispatched device round failed to
+    materialize within ``round_timeout_ms``. The undrained round stays
+    queued — a later ``step()`` retries it if the device recovers;
+    otherwise ``snapshot()`` still works (host state only) and
+    ``restore()`` resumes every request on a fresh engine."""
 
 # hard bound on reserved prefix-pool slots: the byte budget is the
 # real knob; this only stops a tiny model + big budget from minting a
@@ -122,6 +165,14 @@ _TM_CHUNKS = tele.histogram(
 _TM_COMPILE_DECODE = tele.counter("serving.compiles_decode")
 _TM_COMPILE_PREFILL = tele.counter("serving.compiles_prefill")
 _TM_COMPILE_COPY = tele.counter("serving.compiles_copy")
+# robustness counters (doc/observability.md): every abnormal retirement
+# path is visible in the same snapshot as the latencies it protects
+_TM_SHED = tele.counter("serving.shed")
+_TM_DEADLINE = tele.counter("serving.deadline_missed")
+_TM_CANCELLED = tele.counter("serving.cancelled")
+_TM_ERRORS = tele.counter("serving.request_errors")
+_TM_WATCHDOG = tele.counter("serving.watchdog_trips")
+_TM_RESTORES = tele.counter("serving.restores")
 
 
 class Request:
@@ -134,8 +185,12 @@ class Request:
     numpy. Latency probes: ``t_submit``/``t_admit``/``t_first``/
     ``t_done`` (perf_counter seconds; admit = slot assigned + prefill
     dispatched; first = first token DRAINED, i.e. visible to the
-    caller, not merely computed). ``retire_reason`` is ``"eos"`` or
-    ``"length"`` once done. ``prefix_hit_tokens`` counts prompt
+    caller, not merely computed). ``retire_reason`` once done is
+    ``"eos"`` / ``"length"`` (normal completion), ``"deadline"`` /
+    ``"cancelled"`` (host-retired, ``result()`` returns the tokens
+    generated so far), or ``"shed"`` / ``"error"`` / ``"closed"``
+    (failed — ``result()`` raises the typed ``error``; partial tokens
+    stay readable on ``.tokens``). ``prefix_hit_tokens`` counts prompt
     positions whose K/V came from the prefix cache instead of prefill
     FLOPs; ``prefill_chunks`` how many prefill dispatches admitted the
     prompt (1 unless ``prefill_chunk`` split it). The same breakdown
@@ -144,7 +199,8 @@ class Request:
     """
 
     def __init__(self, rid, prompt, max_tokens, eos_id, temperature,
-                 seed, limit):
+                 seed, limit, deadline_ms=None, ttft_deadline_ms=None,
+                 resume_tokens=()):
         self.id = rid
         self.prompt = prompt
         self.max_tokens = max_tokens
@@ -152,8 +208,16 @@ class Request:
         self.temperature = temperature
         self.seed = seed
         self.limit = limit          # min(max_tokens, max_len - P)
-        self.tokens = []
+        # tokens already emitted by a pre-crash engine (restore());
+        # ``seq`` is what admission prefills — re-prefilling the
+        # emitted suffix puts every position's draw key back where the
+        # uninterrupted run had it (byte-identical continuations)
+        self.tokens = list(int(t) for t in resume_tokens)
+        self.resumed = len(self.tokens)
+        self.seq = prompt if not self.resumed else np.concatenate(
+            [prompt, np.asarray(self.tokens, np.int32)])
         self.done = False
+        self.error = None
         self.t_submit = time.perf_counter()
         self.t_admit = None
         self.t_first = None
@@ -161,10 +225,27 @@ class Request:
         self.retire_reason = None
         self.prefix_hit_tokens = 0
         self.prefill_chunks = 0
+        self.deadline_ms = deadline_ms
+        self.ttft_deadline_ms = ttft_deadline_ms
+        self._deadline = None if deadline_ms is None \
+            else self.t_submit + deadline_ms / 1e3
+        self._ttft_deadline = None if ttft_deadline_ms is None \
+            else self.t_submit + ttft_deadline_ms / 1e3
+        self._cancelled = False
+
+    def _expired(self, now):
+        """Which deadline (if any) has passed — checked at round
+        boundaries and at admission pop (host clock only)."""
+        if self._deadline is not None and now >= self._deadline:
+            return True
+        return self._ttft_deadline is not None and self.t_first is None \
+            and now >= self._ttft_deadline
 
     def result(self):
         if not self.done:
             raise MXNetError("request %s is not finished" % self.id)
+        if self.error is not None:
+            raise self.error
         return np.asarray(self.tokens, np.int32)
 
     def __repr__(self):
@@ -172,6 +253,15 @@ class Request:
                 "generated=%d)" % (self.id, len(self.prompt),
                                    self.max_tokens, self.done,
                                    len(self.tokens)))
+
+
+class _PlacementError:
+    """Marker riding a staged ``(req, dev)`` tuple when
+    ``_place_prompt`` failed: admission retires the request with the
+    carried error instead of serving it."""
+
+    def __init__(self, error):
+        self.error = error
 
 
 class _PendingSource:
@@ -281,12 +371,39 @@ class InferenceEngine:
         prefill). Uses the SAME bucketed prefill programs (chunk start
         is a traced operand); greedy outputs stay byte-identical
         across any chunk boundary.
+    overload : {"block", "shed", "shed_oldest"}, optional
+        What a full queue does to ``submit`` (default: the
+        ``MXNET_SERVING_OVERLOAD`` env var, else ``"block"``).
+        ``block`` keeps the PR 3 backpressure contract (generic
+        ``MXNetError``; callers drive ``step`` to drain). ``shed``
+        fails the NEW request fast with a typed
+        :class:`EngineOverloaded` — the router-facing policy: a
+        rejected request can retry elsewhere instead of aging into a
+        missed SLO. ``shed_oldest`` evicts the oldest QUEUED (never
+        admitted) request instead — freshest-work-wins under bursts.
+        Under either shedding policy the engine also degrades
+        gracefully while the queue is full: admitted work keeps
+        priority (the chunking queue always ran first) and
+        prefix-cache RETENTION pauses, so slot-to-pool copy dispatches
+        stop competing with serving work under pressure.
+    round_timeout_ms : float, optional
+        Round watchdog (default: ``MXNET_SERVING_ROUND_TIMEOUT_MS``
+        env var, else 0 = off): when draining a dispatched round, the
+        engine polls device-buffer readiness host-side and raises a
+        typed :class:`EngineStuck` after this long instead of blocking
+        ``serve_forever`` forever on a wedged dispatch. The undrained
+        round stays queued — a later ``step()`` retries (transient
+        stall), or ``snapshot()``/``restore()`` move the requests to a
+        fresh engine (real wedge). Mutable attribute; size it well
+        above the worst legitimate round (compiles excepted — first
+        rounds trace).
     """
 
     def __init__(self, decoder, slots=8, prefill_buckets=None,
                  max_queue=256, stage_depth=2, drain_depth=2,
                  steps_per_round=1, prefix_cache_mb=None,
-                 prefill_chunk=None):
+                 prefill_chunk=None, overload=None,
+                 round_timeout_ms=None):
         if not isinstance(decoder, Decoder):
             raise MXNetError("InferenceEngine needs a Decoder, got %r"
                              % type(decoder).__name__)
@@ -327,6 +444,23 @@ class InferenceEngine:
                 "InferenceEngine: prefill_chunk=%d exceeds the largest "
                 "prefill bucket %d — every chunk piece must fit a "
                 "bucket program" % (self.prefill_chunk, buckets[-1]))
+        if overload is None:
+            overload = os.environ.get("MXNET_SERVING_OVERLOAD") \
+                or "block"
+        if overload not in ("block", "shed", "shed_oldest"):
+            raise MXNetError(
+                "InferenceEngine: overload must be 'block', 'shed' or "
+                "'shed_oldest', got %r (MXNET_SERVING_OVERLOAD sets "
+                "the default)" % (overload,))
+        self.overload = overload
+        if round_timeout_ms is None:
+            round_timeout_ms = float(os.environ.get(
+                "MXNET_SERVING_ROUND_TIMEOUT_MS") or "0")
+        self.round_timeout_ms = float(round_timeout_ms)
+        if self.round_timeout_ms < 0:
+            raise MXNetError("InferenceEngine: round_timeout_ms must "
+                             "be >= 0 (0 disables the watchdog)")
+        self.stage_depth = int(stage_depth)
 
         # device-resident: the slot-paged cache + per-slot state vectors
         S = self.slots
@@ -387,10 +521,20 @@ class InferenceEngine:
         self._round_budget = float("inf")
         self._next_id = 0
         self._auto_seed = 0
+        # request lifecycle: every not-yet-done request, in submission
+        # order (snapshot/restore replays this order); _watched is the
+        # subset that can retire host-side (deadline or cancel) so the
+        # per-round sweep never walks a deadline-less backlog
+        self._active = {}            # id -> Request
+        self._watched = set()        # ids with a deadline / cancel mark
+        self._done_buf = []          # finished since the last step()
+        self._closed = False
         self.stats = {"submitted": 0, "completed": 0, "prefills": 0,
                       "steps": 0, "tokens": 0, "prefix_hits": 0,
                       "prefix_hit_tokens": 0, "prefill_chunks": 0,
-                      "prefix_copies": 0}
+                      "prefix_copies": 0, "shed": 0, "deadline_missed": 0,
+                      "cancelled": 0, "errors": 0, "watchdog_trips": 0,
+                      "restores": 0}
 
         # the three compiled program families; the log records one tag
         # per TRACE (python side effects run at trace time only), so it
@@ -410,6 +554,7 @@ class InferenceEngine:
                         prefill_buckets=None, max_queue=256,
                         stage_depth=2, drain_depth=2, steps_per_round=1,
                         prefix_cache_mb=None, prefill_chunk=None,
+                        overload=None, round_timeout_ms=None,
                         **decoder_kwargs):
         """Checkpoint → serving engine in one call
         (``prefix-symbol.json`` + ``prefix-NNNN.params``, the reference
@@ -424,7 +569,8 @@ class InferenceEngine:
                    drain_depth=drain_depth,
                    steps_per_round=steps_per_round,
                    prefix_cache_mb=prefix_cache_mb,
-                   prefill_chunk=prefill_chunk)
+                   prefill_chunk=prefill_chunk, overload=overload,
+                   round_timeout_ms=round_timeout_ms)
 
     # -- compiled programs ----------------------------------------------
     def _make_step(self):
@@ -626,18 +772,28 @@ class InferenceEngine:
         A prompt longer than ``prefill_chunk`` is guaranteed to admit
         as chunk pieces built at admission time (the split depends on
         the prefix match), so its full-prompt h2d would only be
-        discarded — stage nothing. A prefix HIT on a short prompt also
-        discards the staged array, but hits are unknowable this far
-        ahead of admission; the waste there is one small int32 h2d
-        (chunk/suffix arrays are a few KB — the prefill dispatch they
-        feed dominates)."""
-        p = len(req.prompt)
-        if self.prefill_chunk and p > self.prefill_chunk:
-            return req, None
-        bucket = self._bucket_for(p)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :p] = req.prompt
-        return req, jax.device_put(padded)
+        discarded — stage nothing; likewise a resumed sequence past
+        the largest bucket (it admits in bucket-sized pieces). A
+        prefix HIT on a short prompt also discards the staged array,
+        but hits are unknowable this far ahead of admission; the waste
+        there is one small int32 h2d (chunk/suffix arrays are a few KB
+        — the prefill dispatch they feed dominates).
+
+        A placement failure (a bad h2d) must poison only ITS request:
+        the error rides the staged tuple to admission, where the
+        request retires with reason ``"error"`` instead of unwinding
+        ``step()`` from inside the stager fill."""
+        try:
+            p = len(req.seq)
+            if (self.prefill_chunk and p > self.prefill_chunk) \
+                    or p > self.prefill_buckets[-1]:
+                return req, None
+            bucket = self._bucket_for(p)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :p] = req.seq
+            return req, jax.device_put(padded)
+        except Exception as e:               # noqa: BLE001 — isolated
+            return req, _PlacementError(e)
 
     def queued(self):
         """Requests submitted but not yet admitted to a slot."""
@@ -652,7 +808,8 @@ class InferenceEngine:
             and not self._chunking
 
     def submit(self, prompt, max_tokens, eos_id=None, temperature=0.0,
-               seed=None, request_id=None):
+               seed=None, request_id=None, deadline_ms=None,
+               ttft_deadline_ms=None, _resume_tokens=()):
         """Queue one generation request; returns its :class:`Request`
         handle (fills in as the engine steps).
 
@@ -664,17 +821,26 @@ class InferenceEngine:
         ``Decoder.generate``; > 0 samples with ``seed`` (auto-drawn if
         omitted) — reproducible and schedule-independent.
 
-        Raises ``MXNetError`` once ``max_queue`` requests are waiting
-        (backpressure — callers drive :meth:`step` to drain).
+        ``deadline_ms`` / ``ttft_deadline_ms`` (host wall clock from
+        submit): past the deadline — overall, or first-token — the
+        request retires at the next round boundary with
+        ``retire_reason="deadline"`` and whatever tokens it generated;
+        a still-QUEUED expired request is failed without ever
+        occupying a slot. :meth:`cancel` retires the same way with
+        ``"cancelled"``.
+
+        A full queue follows the ``overload`` policy: ``block`` raises
+        a generic ``MXNetError`` (backpressure — callers drive
+        :meth:`step` to drain), ``shed`` raises a typed
+        :class:`EngineOverloaded`, ``shed_oldest`` evicts the oldest
+        queued request in favor of this one.
         """
-        if self.queued() >= self.max_queue:
-            raise MXNetError(
-                "InferenceEngine: request queue is full (%d waiting; "
-                "max_queue=%d) — step() the engine to drain it"
-                % (self.queued(), self.max_queue))
+        self._check_open()
         # validate shape/dtype HERE, where the caller can see the
         # problem — a bad prompt forwarded to the compiled programs
-        # surfaces as an opaque shape/dtype error rounds later
+        # surfaces as an opaque shape/dtype error rounds later;
+        # validation runs BEFORE the overload branch so an
+        # inadmissible submit can never shed valid queued work
         try:
             prompt = np.asarray(prompt)
         except Exception as e:
@@ -693,18 +859,67 @@ class InferenceEngine:
                 "got dtype %s (floats would be silently truncated)"
                 % prompt.dtype)
         prompt = prompt.astype(np.int32)
-        if prompt.size > self.max_len - 1:
+        if prompt.size + len(_resume_tokens) > self.max_len - 1:
             raise MXNetError(
                 "InferenceEngine: prompt length %d leaves no room to "
                 "generate (max_len=%d)" % (prompt.size, self.max_len))
-        if not self.prefill_chunk:
+        if not self.prefill_chunk and not _resume_tokens:
             # monolithic prefill must fit one bucket program; chunked
             # engines serve ANY prompt <= max_len - 1 in pieces (each
-            # piece <= prefill_chunk <= the largest bucket)
+            # piece <= prefill_chunk <= the largest bucket), and a
+            # RESUMED sequence admits in bucket-sized pieces even with
+            # chunking off (restore() must never reject what the
+            # crashed engine had accepted)
             self._bucket_for(prompt.size)
         max_tokens = int(max_tokens)
         if max_tokens < 1:
             raise MXNetError("InferenceEngine: max_tokens must be >= 1")
+        # eos/temperature validation HERE too (same reasoning as the
+        # prompt checks): a vector eos or NaN temperature forwarded as
+        # a traced operand misbehaves downstream — a NaN softmax draw,
+        # a shape error rounds later — with no pointer back to the
+        # offending submit
+        if eos_id is not None:
+            try:
+                e = np.asarray(eos_id)
+            except Exception:
+                e = None
+            if e is None or e.ndim != 0 \
+                    or not np.issubdtype(e.dtype, np.integer):
+                raise MXNetError(
+                    "InferenceEngine: eos_id must be a scalar integer "
+                    "token id, got %r" % (eos_id,))
+            eos_id = int(e)
+            if eos_id < 0:
+                raise MXNetError(
+                    "InferenceEngine: eos_id must be >= 0, got %d "
+                    "(negative ids collide with the engine's 'no eos' "
+                    "sentinel)" % eos_id)
+        try:
+            temp = float(temperature)
+        except (TypeError, ValueError):
+            temp = float("nan")          # rejected just below
+        if math.isnan(temp) or math.isinf(temp) or temp < 0:
+            raise MXNetError(
+                "InferenceEngine: temperature must be a finite float "
+                ">= 0, got %r (0 = greedy)" % (temperature,))
+        temperature = temp
+        if self.queued() >= self.max_queue:
+            if self.overload == "shed_oldest" and self._shed_oldest():
+                pass                     # room made; admit the new one
+            elif self.overload in ("shed", "shed_oldest"):
+                _TM_SHED.inc()
+                self.stats["shed"] += 1
+                raise EngineOverloaded(
+                    "InferenceEngine: overloaded — %d requests waiting "
+                    "(max_queue=%d, overload=%r); retry against "
+                    "another replica or back off"
+                    % (self.queued(), self.max_queue, self.overload))
+            else:
+                raise MXNetError(
+                    "InferenceEngine: request queue is full (%d "
+                    "waiting; max_queue=%d) — step() the engine to "
+                    "drain it" % (self.queued(), self.max_queue))
         if seed is None:
             seed = self._auto_seed
             self._auto_seed += 1
@@ -714,10 +929,158 @@ class InferenceEngine:
             self._next_id += 1
         limit = min(max_tokens, self.max_len - prompt.size)
         req = Request(rid, prompt, max_tokens, eos_id,
-                      float(temperature), seed, limit)
+                      temperature, seed, limit,
+                      deadline_ms=deadline_ms,
+                      ttft_deadline_ms=ttft_deadline_ms,
+                      resume_tokens=_resume_tokens)
         self._pending.append(req)
+        self._active[rid] = req
+        if req._deadline is not None or req._ttft_deadline is not None:
+            self._watched.add(rid)
         self.stats["submitted"] += 1
         return req
+
+    def cancel(self, request_id):
+        """Cancel a queued or in-flight request: it retires at the
+        next round boundary with ``retire_reason="cancelled"`` and
+        whatever tokens already drained (``result()`` returns them); a
+        still-queued request never occupies a slot. Returns True if
+        the request was live, False if unknown or already done."""
+        req = self._active.get(request_id)
+        if req is None or req.done:
+            return False
+        req._cancelled = True
+        self._watched.add(request_id)
+        return True
+
+    # -- lifecycle: retirement, shedding, shutdown ----------------------
+    def _check_open(self):
+        if self._closed:
+            raise EngineClosed(
+                "InferenceEngine is closed — build a new engine (or "
+                "restore() a snapshot)")
+
+    def _release_slot(self, slot):
+        """Host-side slot release — the same freeze contract device
+        retirement uses: the device copy may still be live (it keeps
+        decoding its dead request harmlessly until its own budget, or
+        until the next occupant's prefill scatter overwrites its state
+        and rows), and pending drain entries for it drop their tokens
+        through the cleared mirror. Purely host bookkeeping: no device
+        op, no new program."""
+        self._mirror[slot] = None
+        self._free.append(slot)
+
+    def _finish(self, req, reason, error=None):
+        """Common retirement tail for every host-side path; the
+        request is handed back by the next ``step()`` return."""
+        req.done = True
+        req.t_done = time.perf_counter()
+        req.retire_reason = reason
+        req.error = error
+        self._active.pop(req.id, None)
+        self._watched.discard(req.id)
+        if reason == "deadline":
+            _TM_DEADLINE.inc()
+            self.stats["deadline_missed"] += 1
+        elif reason == "cancelled":
+            _TM_CANCELLED.inc()
+            self.stats["cancelled"] += 1
+        elif reason == "shed":
+            _TM_SHED.inc()
+            self.stats["shed"] += 1
+        elif reason == "error":
+            _TM_ERRORS.inc()
+            self.stats["errors"] += 1
+        self._done_buf.append(req)
+
+    def _retire_active(self, req, reason, error=None):
+        """Detach ``req`` from whichever scheduler structure holds it
+        (queue, stager, held buffer, chunking queue, drain queue, or a
+        decoding slot), releasing its slot and prefix-cache pin. The
+        slot-recycle argument is `_release_slot`'s; prefix pins are
+        released on EVERY path (a leaked pin would starve the pool)."""
+        try:
+            self._pending.remove(req)
+        except ValueError:
+            pass
+        self._stager.prune(lambda item: item[0] is req)
+        if self._held is not None and self._held[0] is req:
+            self._held = None
+        for st in list(self._chunking):
+            if st["req"] is req:
+                self._chunking.remove(st)
+                if st["entry"] is not None:
+                    self._prefix.release(st["entry"])
+                    st["entry"] = None
+                self._release_slot(st["slot"])
+        for entry in self._drain:
+            if entry[0] == "prefill" and entry[1] is req:
+                # the staged first token is dropped at drain time (the
+                # req is done); the slot frees NOW — FIFO draining
+                # keeps any reuse ordered behind this entry
+                self._release_slot(entry[2])
+        for s in range(self.slots):
+            if self._mirror[s] is req:
+                self._release_slot(s)
+        self._finish(req, reason, error)
+
+    def _shed_oldest(self, why="under overload='shed_oldest' (newer "
+                                "work displaced it)"):
+        """Evict the oldest QUEUED (never admitted) request to make
+        room (overload="shed_oldest") or to drop an unadmitted backlog
+        (``why`` names the cause on the victim's error). Admitted work
+        is never shed — its prefill is sunk cost. Age order: the held
+        admission candidate (popped from the stager earliest), then
+        staged items, then the pending deque. Returns True if one was
+        shed."""
+        victim = None
+        if self._held is not None:
+            victim = self._held[0]
+        elif self._stager.staged():
+            first = []
+
+            def oldest(item):       # one-shot: prune is single-pass
+                if first:
+                    return False
+                first.append(item)
+                return True
+
+            dropped = self._stager.prune(oldest)
+            if dropped:
+                victim = dropped[0][0]
+        if victim is None and self._pending:
+            victim = self._pending[0]
+        if victim is None:
+            return False
+        self._retire_active(victim, "shed", EngineOverloaded(
+            "InferenceEngine: request %r shed %s" % (victim.id, why)))
+        return True
+
+    def _sweep(self):
+        """Round-boundary lifecycle sweep: retire cancelled and
+        deadline-expired requests. Only ``_watched`` ids are visited,
+        so deadline-less traffic pays nothing."""
+        if not self._watched:
+            return
+        now = time.perf_counter()
+        for rid in list(self._watched):
+            req = self._active.get(rid)
+            if req is None or req.done:
+                self._watched.discard(rid)
+                continue
+            if req._cancelled:
+                self._retire_active(req, "cancelled")
+            elif req._expired(now):
+                self._retire_active(req, "deadline")
+
+    @property
+    def _pressure(self):
+        """Overloaded right now? Under a shedding policy this pauses
+        prefix-cache retention (the slot→pool copy dispatch competes
+        with serving work exactly when there is least room for it)."""
+        return self.overload != "block" \
+            and self.queued() >= self.max_queue
 
     def _admit(self):
         """Fill freed slots from the staged queue, between device
@@ -732,6 +1095,7 @@ class InferenceEngine:
         FIFO order is preserved). Returns how many requests were
         admitted."""
         admitted = 0
+        now = time.perf_counter()
         while self._free:
             if self._held is not None:
                 req, dev, self._held = \
@@ -741,23 +1105,45 @@ class InferenceEngine:
                     req, dev = self._stager.next()
                 except StopIteration:
                     break
-            p = len(req.prompt)
-            hit, entry, depth = 0, None, 0
-            if self._prefix is not None:
-                with tele.span("serving.prefix_lookup", cat="serving",
-                               hist=_TM_PREFIX_LOOKUP_MS):
-                    depth, entry = self._prefix.lookup(req.prompt)
-                # a FULL hit still re-prefills the last prompt token:
-                # the cache retains K/V only, and the first generated
-                # token needs the last position's logits
-                hit = min(depth, p - 1)
-                # a hit only pays when it REDUCES prefill work (fewer
-                # padded tokens across the piece split); otherwise the
-                # copy dispatch is pure overhead on top of the same
-                # bucket-quantized prefill — treat as miss
-                if hit > 0 and self._suffix_cost(p - hit) \
-                        >= self._suffix_cost(p):
-                    hit, entry = 0, None
+            if req.done:
+                continue            # retired while staged (shed/close)
+            if req._cancelled or req._expired(now):
+                # queue-waiting expiry: failed WITHOUT occupying a slot
+                self._finish(req, "cancelled" if req._cancelled
+                             else "deadline")
+                continue
+            if isinstance(dev, _PlacementError):
+                self._finish(req, "error", MXNetError(
+                    "InferenceEngine: request %r failed h2d staging "
+                    "(%s)" % (req.id, dev.error)))
+                continue
+            p = len(req.seq)
+            try:
+                hit, entry, depth = 0, None, 0
+                if self._prefix is not None:
+                    with tele.span("serving.prefix_lookup",
+                                   cat="serving",
+                                   hist=_TM_PREFIX_LOOKUP_MS):
+                        depth, entry = self._prefix.lookup(req.seq)
+                    # a FULL hit still re-prefills the last prompt
+                    # token: the cache retains K/V only, and the first
+                    # generated token needs the last position's logits
+                    hit = min(depth, p - 1)
+                    # a hit only pays when it REDUCES prefill work
+                    # (fewer padded tokens across the piece split);
+                    # otherwise the copy dispatch is pure overhead on
+                    # top of the same bucket-quantized prefill — treat
+                    # as miss
+                    if hit > 0 and self._suffix_cost(p - hit) \
+                            >= self._suffix_cost(p):
+                        hit, entry = 0, None
+            except Exception as e:       # noqa: BLE001 — trie fault
+                # a corrupt trie poisons THIS request, not the engine:
+                # no slot was taken, nothing was pinned
+                self._finish(req, "error", MXNetError(
+                    "InferenceEngine: prefix-cache lookup failed for "
+                    "request %r (%s)" % (req.id, e)))
+                continue
             first_piece = min(p - hit, self.prefill_chunk or p - hit)
             if first_piece > self._round_budget:
                 # this round's prefill budget is spent: hold the
@@ -768,39 +1154,64 @@ class InferenceEngine:
             req.t_admit = time.perf_counter()
             _TM_QUEUE_WAIT_MS.observe(
                 (req.t_admit - req.t_submit) * 1e3)
-            if self._prefix is not None:
-                if hit > 0:
-                    self._prefix.acquire(entry)
-                    req.prefix_hit_tokens = hit
-                    self.stats["prefix_hits"] += 1
-                    self.stats["prefix_hit_tokens"] += hit
-                    _TM_PREFIX_HITS.inc()
-                    _TM_PREFIX_HIT_TOKENS.inc(hit)
-                    self._dispatch_copy(hit, src=entry.slot, dst=slot,
-                                        src_pool=True, dst_pool=False)
-                else:
-                    entry = None    # unused match: nothing to release
-                    _TM_PREFIX_MISSES.inc()
             st = {"req": req, "slot": slot, "dev": dev, "next": hit,
-                  "entry": entry,
+                  "entry": None,
                   # retain only prompts no entry already covers whole
                   # (a second copy buys nothing) that fit the copy
                   # bucket family (longer chunked prompts stay
                   # unretained — their prefixes can still hit via
-                  # shorter entries)
+                  # shorter entries); the overload-pressure pause is
+                  # checked at the retention DISPATCH instead (the
+                  # final chunk may land rounds after admission)
                   "insert": self._prefix is not None and depth < p
                   and p <= self.prefill_buckets[-1]}
-            if not self._advance_chunk(st):
-                self._chunking.append(st)
+            try:
+                if self._prefix is not None:
+                    if hit > 0:
+                        self._prefix.acquire(entry)
+                        st["entry"] = entry
+                        req.prefix_hit_tokens = hit
+                        self.stats["prefix_hits"] += 1
+                        self.stats["prefix_hit_tokens"] += hit
+                        _TM_PREFIX_HITS.inc()
+                        _TM_PREFIX_HIT_TOKENS.inc(hit)
+                        self._dispatch_copy(hit, src=entry.slot,
+                                            dst=slot, src_pool=True,
+                                            dst_pool=False)
+                    else:
+                        _TM_PREFIX_MISSES.inc()
+                if not self._advance_chunk(st):
+                    self._chunking.append(st)
+            except Exception as e:       # noqa: BLE001 — poisoned
+                self._poison(st, e)
             admitted += 1
         return admitted
+
+    def _poison(self, st, exc):
+        """A per-request host-side failure (bad h2d, chunk math, copy
+        dispatch) retires ONLY that request: its slot is released, its
+        prefix pin dropped, the error carried on the request — the
+        co-resident slots' requests never notice (acceptance-pinned in
+        tests/test_serving_faults.py)."""
+        if st["entry"] is not None:
+            self._prefix.release(st["entry"])
+            st["entry"] = None
+        self._release_slot(st["slot"])
+        req = st["req"]
+        self._finish(req, "error", MXNetError(
+            "InferenceEngine: request %r poisoned during admission/"
+            "prefill (%s: %s) — retired alone, engine keeps serving"
+            % (req.id, type(exc).__name__, exc)))
 
     def _suffix_cost(self, n):
         """Prefill-work proxy for an ``n``-token suffix: total PADDED
         tokens across its piece split — what bucket quantization
         actually charges for (piece count alone would demote every hit
-        whose suffix and full prompt both fit one chunk)."""
-        chunk = self.prefill_chunk or n
+        whose suffix and full prompt both fit one chunk). Splits
+        exactly like :meth:`_advance_chunk`: chunking off still caps
+        pieces at the largest bucket (resumed sequences can exceed
+        it)."""
+        chunk = self.prefill_chunk or self.prefill_buckets[-1]
         total = 0
         while n > 0:
             piece = min(n, chunk)
@@ -811,17 +1222,24 @@ class InferenceEngine:
     def _advance_chunk(self, st):
         """Dispatch the next prefill piece for an admitted request:
         the whole remaining suffix when chunking is off (or it fits),
-        else one ``prefill_chunk``-sized piece. The FINAL piece
-        samples the first token in-program and (prefix cache on)
-        retains the freshly built prompt K/V in the pool. Returns True
-        once the final piece is dispatched."""
+        else one ``prefill_chunk``-sized piece (a RESUMED sequence
+        longer than the largest bucket splits into bucket-sized pieces
+        even with chunking off — same programs, same park-dead
+        contract between pieces). The FINAL piece samples the first
+        token in-program and (prefix cache on) retains the freshly
+        built prompt K/V in the pool. Returns True once the final
+        piece is dispatched. Exceptions poison only this request — the
+        caller routes them to :meth:`_poison`."""
         req, slot = st["req"], st["slot"]
+        flt = _SERVING_FAULTS
+        if flt is not None:
+            flt.serving_h2d(req)         # injected per-request fault
         params, aux = self._dec._params, self._dec._aux
         start = st["next"]
-        p = len(req.prompt)
+        p = len(req.seq)
         remaining = p - start
-        piece = remaining if self.prefill_chunk == 0 \
-            else min(remaining, self.prefill_chunk)
+        piece = min(remaining,
+                    self.prefill_chunk or self.prefill_buckets[-1])
         final = start + piece == p
         if start == 0 and piece == p and st["dev"] is not None:
             dev = st["dev"]            # staged whole-prompt h2d
@@ -829,7 +1247,7 @@ class InferenceEngine:
         else:
             bucket = self._bucket_for(piece)
             chunk = np.zeros((1, bucket), np.int32)
-            chunk[0, :piece] = req.prompt[start:start + piece]
+            chunk[0, :piece] = req.seq[start:start + piece]
             dev = chunk
         fn = self._prefill_fn(bucket)
         with tele.span("serving.prefill", cat="serving", bucket=bucket,
@@ -840,7 +1258,7 @@ class InferenceEngine:
                 np.bool_(final), np.float32(req.temperature),
                 _raw_key(req.seed),
                 np.int32(-1 if req.eos_id is None else req.eos_id),
-                np.int32(req.limit))
+                np.int32(req.limit - req.resumed))
         req.prefill_chunks += 1
         st["next"] = start + piece
         self.stats["prefill_chunks"] += 1
@@ -853,31 +1271,49 @@ class InferenceEngine:
         _TM_CHUNKS.observe(req.prefill_chunks)
         if st["entry"] is not None:
             self._prefix.release(st["entry"])
+            st["entry"] = None
         # a duplicate prompt admitted while this one was mid-chunk may
         # have finished first and retained the same tokens — its rows
-        # are already byte-identical, so re-copying is a wasted dispatch
-        if st["insert"] and self._prefix.get(req.prompt) is None:
-            ev0 = self._prefix.evictions
-            new = self._prefix.insert(req.prompt)
-            _TM_PREFIX_EVICTIONS.inc(self._prefix.evictions - ev0)
-            if new is None:
-                _TM_PREFIX_INSERT_SKIPPED.inc()
-            else:
-                # the slot's rows [0, P) ARE the prompt K/V right now —
-                # the retention copy is ordered before the slot's
-                # decode writes by the cache-tree data dependency
-                self._dispatch_copy(p, src=slot, dst=new.slot,
-                                    src_pool=False, dst_pool=True)
-            _TM_PREFIX_BYTES.set(self._prefix.bytes_used)
+        # are already byte-identical, so re-copying is a wasted
+        # dispatch. Retention failures are NON-fatal: the request has
+        # its token coming — drop the half-made entry (its rows never
+        # materialized) and skip.
+        try:
+            # pressure is re-checked NOW, not at admission: the slot→
+            # pool copy competes with serving exactly when the queue
+            # is full at dispatch time (and transient pressure back at
+            # admission shouldn't suppress a retention the engine has
+            # room for by the final chunk)
+            if st["insert"] and not self._pressure \
+                    and self._prefix.get(req.seq) is None:
+                ev0 = self._prefix.evictions
+                new = self._prefix.insert(req.seq)
+                _TM_PREFIX_EVICTIONS.inc(self._prefix.evictions - ev0)
+                if new is None:
+                    _TM_PREFIX_INSERT_SKIPPED.inc()
+                else:
+                    try:
+                        # the slot's rows [0, P) ARE the prompt K/V
+                        # right now — the retention copy is ordered
+                        # before the slot's decode writes by the
+                        # cache-tree data dependency
+                        self._dispatch_copy(p, src=slot, dst=new.slot,
+                                            src_pool=False,
+                                            dst_pool=True)
+                    except Exception:
+                        self._prefix.discard(new)
+                        raise
+                _TM_PREFIX_BYTES.set(self._prefix.bytes_used)
+        except Exception:                # noqa: BLE001 — isolated
+            _TM_PREFIX_INSERT_SKIPPED.inc()
         return True
 
     def _busy(self):
         return (self.slots - len(self._free)) > 0 or bool(self._pending) \
             or self._stager.staged() > 0 or self._held is not None
 
-    def _push_token(self, req, slot, t, done_now):
+    def _push_token(self, req, slot, t, now):
         assert t >= 0, "drained a token from a device-dead slot"
-        now = time.perf_counter()
         req.tokens.append(int(t))
         if req.t_first is None:
             req.t_first = now
@@ -891,39 +1327,78 @@ class InferenceEngine:
             req.retire_reason = "eos" if hit_eos else "length"
             (_TM_RETIRED_EOS if hit_eos else _TM_RETIRED_LENGTH).inc()
             _TM_COMPLETED.inc()
-            if len(req.tokens) > 1:
+            # cadence = wall time per decode interval THIS engine ran:
+            # a resumed request's pre-crash tokens arrived before
+            # t_first and must not inflate the denominator
+            if len(req.tokens) - req.resumed > 1:
                 _TM_CADENCE_MS.observe(
                     (req.t_done - req.t_first)
-                    / (len(req.tokens) - 1) * 1e3)
-            self._mirror[slot] = None
-            self._free.append(slot)
+                    / (len(req.tokens) - req.resumed - 1) * 1e3)
+            self._active.pop(req.id, None)
+            self._watched.discard(req.id)
+            self._release_slot(slot)
             self.stats["completed"] += 1
-            done_now.append(req)
+            self._done_buf.append(req)
 
-    def _drain_one(self, done_now):
-        entry = self._drain.popleft()
+    def _guard_ready(self, arrays):
+        """Round watchdog: with ``round_timeout_ms`` set, poll the
+        drain head's device buffers host-side and raise a typed
+        :class:`EngineStuck` instead of letting the d2h conversion
+        block forever on a wedged dispatch. The undrained entry stays
+        queued — a recovered device drains it on the next step."""
+        if self.round_timeout_ms <= 0:
+            return
+        flt = _SERVING_FAULTS
+        deadline = time.perf_counter() + self.round_timeout_ms / 1e3
+        while True:
+            stuck = flt is not None and flt.serving_round_stuck()
+            if not stuck and Decoder.buffers_ready(arrays):
+                return
+            if time.perf_counter() >= deadline:
+                _TM_WATCHDOG.inc()
+                self.stats["watchdog_trips"] += 1
+                raise EngineStuck(
+                    "InferenceEngine: dispatched round not ready after "
+                    "round_timeout_ms=%g — device stuck or overloaded. "
+                    "step() again to retry the drain, or snapshot()/"
+                    "restore() onto a fresh engine"
+                    % self.round_timeout_ms)
+            time.sleep(0.001)
+
+    def _drain_one(self):
+        entry = self._drain[0]       # peek: a watchdog trip must not
+        self._guard_ready(entry[3] if entry[0] == "prefill"
+                          else entry[1])  # lose the undrained round
+        self._drain.popleft()
+        now = time.perf_counter()
         if entry[0] == "prefill":
             _, req, slot, t0 = entry
+            if req.done:
+                return               # host-retired while staged: the
+                                     # slot was already released
             self._mirror[slot] = req
-            self._push_token(req, slot, int(np.asarray(t0)), done_now)
+            self._push_token(req, slot, int(np.asarray(t0)), now)
         else:
             rounds = np.asarray(entry[1])        # [steps_per_round, S]
             for row in rounds:
                 for s in range(self.slots):
                     req = self._mirror[s]
                     if req is not None:
-                        self._push_token(req, s, int(row[s]), done_now)
+                        self._push_token(req, s, int(row[s]), now)
 
     def step(self):
-        """One scheduling round: advance every mid-prefill request by
-        ONE chunk, admit staged requests into free slots (prefix copy
-        + first prefill piece), dispatch ONE decode round
+        """One scheduling round: retire cancelled/expired requests
+        (round-boundary lifecycle sweep), advance every mid-prefill
+        request by ONE chunk, admit staged requests into free slots
+        (prefix copy + first prefill piece), dispatch ONE decode round
         (``steps_per_round`` fused all-slot steps) if any decodable
         slot is occupied, then drain output vectors that are
         ``drain_depth`` dispatches old (all of them once nothing is in
-        flight). Returns the requests COMPLETED by this round, in
-        completion order."""
-        done_now = []
+        flight). Returns the requests that finished since the last
+        round — normal completions AND host retirements (check
+        ``retire_reason``) — in completion order."""
+        self._check_open()
+        self._sweep()
         # chunked prefill, Sarathi-style per-round budget: at most
         # ~prefill_chunk tokens of prefill work run between decode
         # rounds — ONE piece of the oldest parked request, then
@@ -934,8 +1409,11 @@ class InferenceEngine:
         self._round_budget = self.prefill_chunk or float("inf")
         if self._chunking:
             st = self._chunking.popleft()
-            if not self._advance_chunk(st):
-                self._chunking.append(st)
+            try:
+                if not self._advance_chunk(st):
+                    self._chunking.append(st)
+            except Exception as e:   # noqa: BLE001 — poisoned request
+                self._poison(st, e)
         admitted = self._admit()
         busy = self.slots - len(self._free)
         _TM_OCCUPANCY.set(busy)
@@ -957,9 +1435,13 @@ class InferenceEngine:
             self.stats["steps"] += 1
             _TM_ROUNDS.inc()
             _TM_SLOTS_BUSY.observe(busy)
+            flt = _SERVING_FAULTS
+            if flt is not None:
+                flt.serving_crash()  # injected mid-round process death
         while len(self._drain) > (self._drain_depth if self._busy()
                                   else 0):
-            self._drain_one(done_now)
+            self._drain_one()
+        done_now, self._done_buf = self._done_buf, []
         return done_now
 
     def serve_forever(self, requests=None):
@@ -969,31 +1451,205 @@ class InferenceEngine:
         array, or ``None`` meaning "nothing has arrived yet", which
         lets a generator pace an online arrival process), stepping
         continuously; between pulls the engine keeps serving whatever
-        is resident. Returns all completed requests in completion
-        order. With ``requests=None`` it serves what was already
-        submitted and returns when idle."""
+        is resident. Returns all finished requests in completion order
+        (host retirements included — check ``retire_reason``). With
+        ``requests=None`` it serves what was already submitted and
+        returns when idle.
+
+        Failure containment: if the ``requests`` iterable (or a submit
+        it drives) raises mid-iteration, already-admitted work FINISHES
+        first — queued-but-unadmitted requests finish too under
+        ``overload="block"``, or are shed under a shedding policy —
+        and only then does the original exception propagate, traceback
+        intact. On KeyboardInterrupt the engine :meth:`close`\\ s
+        (pending requests fail with :class:`EngineClosed`) before the
+        interrupt propagates."""
+        self._check_open()
         completed = []
         src = iter(requests) if requests is not None else None
         exhausted = src is None
-        while True:
-            # ingest until backpressure or a pacing None — one item per
-            # round would starve free slots while the source has ready
-            # requests
-            while not exhausted and self.queued() < self.max_queue:
-                try:
-                    item = next(src)
-                except StopIteration:
+        ingest_error = None
+        try:
+            while True:
+                # ingest until backpressure or a pacing None — one item
+                # per round would starve free slots while the source
+                # has ready requests
+                while not exhausted and self.queued() < self.max_queue:
+                    try:
+                        item = next(src)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    except Exception as e:   # noqa: BLE001
+                        ingest_error = e
+                        break
+                    try:
+                        if item is None:
+                            break          # nothing ready yet: decode
+                        if isinstance(item, dict):
+                            self.submit(**item)
+                        elif isinstance(item, tuple) \
+                                and len(item) == 2 \
+                                and isinstance(item[1], dict):
+                            self.submit(item[0], **item[1])
+                        else:
+                            self.submit(item, max_tokens=self.max_len)
+                    except Exception as e:   # noqa: BLE001
+                        ingest_error = e
+                        break
+                if ingest_error is not None and not exhausted:
+                    # stop ingesting; shed the unadmitted backlog when
+                    # the policy allows, then drain what was admitted
                     exhausted = True
+                    if self.overload != "block":
+                        why = ("with the unadmitted backlog after the "
+                               "request stream raised (overload=%r "
+                               "drops instead of draining it)"
+                               % self.overload)
+                        while self._shed_oldest(why):
+                            pass
+                completed.extend(self.step())
+                if exhausted and self.idle:
                     break
-                if item is None:
-                    break              # nothing ready yet: go decode
-                if isinstance(item, dict):
-                    self.submit(**item)
-                elif isinstance(item, tuple) and len(item) == 2 \
-                        and isinstance(item[1], dict):
-                    self.submit(item[0], **item[1])
-                else:
-                    self.submit(item, max_tokens=self.max_len)
-            completed.extend(self.step())
-            if exhausted and self.idle:
-                return completed
+            if ingest_error is not None:
+                raise ingest_error
+            return completed
+        except KeyboardInterrupt:
+            self.close()
+            raise
+
+    # -- shutdown -------------------------------------------------------
+    def close(self):
+        """Shut the engine down: every pending request — queued,
+        staged, mid-prefill, or decoding — fails with a typed
+        :class:`EngineClosed` error (``retire_reason="closed"``,
+        already-drained tokens stay readable on ``.tokens``), the
+        prompt stager stops, and every slot and prefix-cache pin is
+        released. Idempotent; ``submit``/``step``/``serve_forever``
+        raise :class:`EngineClosed` afterwards. Also usable as a
+        context manager (``with engine: ...`` closes on exit), and
+        installed by ``serve_forever`` on KeyboardInterrupt."""
+        if self._closed:
+            return
+        self._closed = True
+        for req in list(self._active.values()):
+            self._retire_active(req, "closed", EngineClosed(
+                "InferenceEngine: engine closed while request %r was "
+                "pending" % (req.id,)))
+        self._pending.clear()
+        self._chunking.clear()
+        self._held = None
+        self._drain.clear()
+        self._stager.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.close()
+        return False
+
+    # -- crash-safe restart ---------------------------------------------
+    def snapshot(self):
+        """Host scheduler state as a plain JSON-serializable dict:
+        every unfinished request (queued AND in-flight) with its
+        prompt, the tokens drained so far, its sampling identity
+        (seed/temperature — draws are keyed ``fold_in(seed, position)``,
+        so a resumed request reproduces them), and its remaining
+        deadline budget, plus the engine geometry. NO device state:
+        prompt K/V is a pure function of the token ids, so
+        :meth:`restore` re-prefills ``prompt + emitted`` (through the
+        prefix cache where it hits) and every greedy continuation is
+        byte-identical to the uninterrupted run. Valid after a crashed
+        ``step()`` or a watchdog trip — tokens dispatched but never
+        drained are simply re-generated."""
+        now = time.perf_counter()
+        reqs = []
+        for req in self._active.values():
+            if req.done:
+                continue
+            reqs.append({
+                "id": req.id,
+                "prompt": np.asarray(req.prompt).tolist(),
+                "tokens": list(req.tokens),
+                "max_tokens": int(req.max_tokens),
+                "eos_id": req.eos_id,
+                "temperature": float(req.temperature),
+                "seed": int(req.seed),
+                "deadline_ms": None if req._deadline is None
+                else (req._deadline - now) * 1e3,
+                "ttft_deadline_ms": None
+                if req._ttft_deadline is None or req.t_first is not None
+                else (req._ttft_deadline - now) * 1e3,
+            })
+        return {
+            "version": 1,
+            "auto_seed": self._auto_seed,
+            "engine": {
+                "slots": self.slots,
+                "prefill_buckets": list(self.prefill_buckets),
+                "max_queue": self.max_queue,
+                "stage_depth": self.stage_depth,
+                "drain_depth": self._drain_depth,
+                "steps_per_round": self.steps_per_round,
+                "prefix_cache_mb": self.prefix_cache_mb,
+                "prefill_chunk": self.prefill_chunk,
+                "overload": self.overload,
+                "round_timeout_ms": self.round_timeout_ms,
+            },
+            "requests": reqs,
+        }
+
+    @classmethod
+    def restore(cls, snap, decoder, **overrides):
+        """Warm restart from :meth:`snapshot`: builds a fresh engine
+        (same geometry unless ``overrides`` change it) on ``decoder``
+        (the same weights) and resubmits every unfinished request,
+        re-prefilling ``prompt + already-emitted`` so each one resumes
+        exactly where it stopped — greedy continuations are
+        byte-identical to an uninterrupted run, and sampled draws stay
+        position-keyed. Emitted tokens reappear on the handles'
+        ``.tokens``; resumed sequences longer than the largest bucket
+        admit in bucket-sized pieces automatically. Remaining deadline
+        budgets carry over (an already-expired one retires on the
+        first round). Returns ``(engine, {request_id: Request})``."""
+        if not isinstance(snap, dict) or snap.get("version") != 1:
+            raise MXNetError(
+                "InferenceEngine.restore: not an engine snapshot "
+                "(want the dict snapshot() returned)")
+        cfg = dict(snap["engine"])
+        cfg["prefill_buckets"] = tuple(cfg["prefill_buckets"])
+        cfg.update(overrides)
+        eng = cls(decoder, **cfg)
+        handles = {}
+        real_max_queue = eng.max_queue
+        # resubmission must never shed: the crashed engine had already
+        # accepted this work (its in-flight slots don't count as queue)
+        eng.max_queue = max(real_max_queue, len(snap["requests"]))
+        try:
+            next_id = eng._next_id
+            for r in snap["requests"]:
+                req = eng.submit(
+                    np.asarray(r["prompt"], np.int32),
+                    max_tokens=r["max_tokens"], eos_id=r["eos_id"],
+                    temperature=r["temperature"], seed=r["seed"],
+                    request_id=r["id"],
+                    deadline_ms=r.get("deadline_ms"),
+                    ttft_deadline_ms=r.get("ttft_deadline_ms"),
+                    _resume_tokens=r["tokens"])
+                handles[req.id] = req
+                if isinstance(req.id, int):
+                    next_id = max(next_id, req.id + 1)
+            eng._next_id = next_id   # fresh auto-ids never collide
+            # likewise fresh auto-drawn seeds: resubmission passes
+            # explicit seeds, so the new counter sits at 0 and the
+            # next seed-less sampled submit would replay a resumed
+            # request's draws
+            eng._auto_seed = max(int(snap.get("auto_seed", 0)),
+                                 *(int(r["seed"]) + 1
+                                   for r in snap["requests"]), 0)
+        finally:
+            eng.max_queue = real_max_queue
+        eng.stats["restores"] = 1
+        _TM_RESTORES.inc()
+        return eng, handles

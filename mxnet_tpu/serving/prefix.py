@@ -90,6 +90,15 @@ class PrefixCache:
     def bytes_used(self):
         return len(self._by_tokens) * self.slot_bytes
 
+    @property
+    def pinned(self):
+        """Total outstanding pins (sum of entry refcounts). Every
+        engine error/cancel/deadline path must return this to its
+        pre-request value — a leaked pin is a pool slot that can never
+        be evicted again (eventual pool starvation); the fault tests
+        assert it drains back to zero."""
+        return sum(e.refs for e in self._by_tokens.values())
+
     def entries(self):
         """Snapshot of retained entries (tests/debugging)."""
         return list(self._by_tokens.values())
@@ -164,6 +173,13 @@ class PrefixCache:
         self._by_tokens[tokens] = entry
         self.inserts += 1
         return entry
+
+    def discard(self, entry):
+        """Drop a retained entry whose device rows never materialized
+        (a failed retention copy): without this, a later hit would
+        serve garbage rows. No-op if the entry is already gone."""
+        if self._by_tokens.get(entry.tokens) is entry:
+            self._remove(entry)
 
     def _evict_one(self):
         victim = None

@@ -29,6 +29,20 @@ Two injection surfaces:
   retry-dedup table), the single-process stand-in for a parameter
   server recovering from its replica.
 
+* **Serving-engine faults** — the same injector installs itself as
+  ``serving.engine._SERVING_FAULTS`` and drives the engine's
+  host-side failure seams deterministically:
+  :meth:`FaultInjector.serving_h2d_failures` poisons individual
+  requests (a bad host→device staging raises inside admission — the
+  engine must retire ONLY that request),
+  :meth:`FaultInjector.serving_round_hang` makes a dispatched round
+  look permanently not-ready so the ``round_timeout_ms`` watchdog
+  trips, and :meth:`FaultInjector.serving_crash_mid_round` raises
+  :class:`InjectedCrash` after a decode dispatch — process death
+  mid-round, the setup for ``engine.snapshot()`` →
+  ``InferenceEngine.restore()`` kill-and-recover scenarios
+  (tests/test_serving_faults.py).
+
 Every injected fault is appended to ``FaultInjector.log`` as
 ``(kind, op)`` so tests can assert the schedule actually fired.
 """
@@ -42,8 +56,15 @@ import time
 
 from .. import kvstore_dist as _kd
 
-__all__ = ["FaultInjector", "kill_server", "restart_server",
-           "server_down"]
+__all__ = ["FaultInjector", "InjectedCrash", "kill_server",
+           "restart_server", "server_down"]
+
+
+class InjectedCrash(RuntimeError):
+    """Simulated process death mid-round (serving_crash_mid_round):
+    deliberately NOT an MXNetError — the engine's per-request error
+    isolation must not swallow it, exactly as it could not swallow a
+    real SIGKILL."""
 
 
 class FaultInjector:
@@ -69,8 +90,11 @@ class FaultInjector:
     def __init__(self, seed=0):
         self.rng = random.Random(seed)
         self.plan = collections.deque()
+        self.serving_plan = collections.deque()
         self.log = []          # (kind, op) per injected fault
         self._depth = 0
+        self._serving_depth = 0
+        self._hang_until = None
         self._lock = threading.Lock()
 
     # -- plan construction --------------------------------------------
@@ -112,6 +136,87 @@ class FaultInjector:
         """Lose the reply (after the server applied the request) for
         the next ``n`` round trips."""
         return self._scheduled([("drop_reply",)] * n)
+
+    # -- serving-engine plans -----------------------------------------
+    def serving_h2d_failures(self, n=1):
+        """Fail the next ``n`` per-request host→device stagings inside
+        engine admission (the poisoned-request case: each failure must
+        retire ONLY its own request, with an error result)."""
+        return self._serving_scheduled([("h2d_fail",)] * n)
+
+    def serving_round_hang(self, seconds=0.5):
+        """Make the next drained round look not-ready for ``seconds``
+        (a wedged device dispatch): with ``round_timeout_ms`` set the
+        engine's watchdog trips with ``EngineStuck`` — and once the
+        hang passes, the round drains normally (recovery path)."""
+        return self._serving_scheduled([("hang", seconds)])
+
+    def serving_crash_mid_round(self, n=1):
+        """Raise :class:`InjectedCrash` right after the next ``n``
+        decode dispatches — the process dies mid-round with tokens
+        dispatched but undrained, the snapshot()/restore() scenario."""
+        return self._serving_scheduled([("crash",)] * n)
+
+    @contextlib.contextmanager
+    def _serving_scheduled(self, directives):
+        from ..serving import engine as _se
+
+        with self._lock:
+            self.serving_plan.extend(directives)
+            if self._serving_depth == 0:
+                self._serving_prev = _se._SERVING_FAULTS
+                _se._SERVING_FAULTS = self
+            self._serving_depth += 1
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._serving_depth -= 1
+                if self._serving_depth == 0:
+                    _se._SERVING_FAULTS = self._serving_prev
+                    self.serving_plan.clear()
+                    self._hang_until = None
+
+    # -- hooks called by serving.engine (host-side seams only) --------
+    def serving_h2d(self, req):
+        """May raise: a per-request staging failure at admission."""
+        with self._lock:
+            head = self.serving_plan[0] if self.serving_plan else None
+            if head is None or head[0] != "h2d_fail":
+                return
+            self.serving_plan.popleft()
+        self.log.append(("h2d_fail", req.id))
+        raise RuntimeError("fault injection: h2d failed for request "
+                           "%r" % (req.id,))
+
+    def serving_round_stuck(self):
+        """True while a scheduled round-hang is active (the watchdog's
+        readiness poll consults this; a real wedge would keep
+        ``buffers_ready`` False the same way)."""
+        with self._lock:
+            if self._hang_until is None:
+                head = (self.serving_plan[0] if self.serving_plan
+                        else None)
+                if head is None or head[0] != "hang":
+                    return False
+                self.serving_plan.popleft()
+                self._hang_until = time.perf_counter() + head[1]
+                self.log.append(("hang", head[1]))
+            if time.perf_counter() < self._hang_until:
+                return True
+            self._hang_until = None
+            return False
+
+    def serving_crash(self):
+        """May raise InjectedCrash: process death after dispatch."""
+        with self._lock:
+            head = self.serving_plan[0] if self.serving_plan else None
+            if head is None or head[0] != "crash":
+                return
+            self.serving_plan.popleft()
+        self.log.append(("crash", None))
+        raise InjectedCrash("fault injection: process died mid-round "
+                            "(dispatched, undrained)")
 
     @contextlib.contextmanager
     def _scheduled(self, directives):

@@ -80,11 +80,12 @@ def test_sever_reconnect_retry(backend):
 
 
 def test_dropped_frame_times_out_then_retries(backend):
-    """A swallowed frame surfaces as a socket timeout; the retry path
+    """A swallowed frame surfaces as a dead request; the retry path
     resends and the value lands once."""
     backend.init(2, np.zeros(3))
     inj = faults.FaultInjector(seed=2)
     timeouts0 = mx.telemetry.counter("kvstore.timeouts").value
+    retries0 = mx.telemetry.counter("kvstore.retries").value
     t0 = time.time()
     with inj.drop_sends(1):
         backend.push(2, np.full(3, 7.0))
@@ -92,7 +93,12 @@ def test_dropped_frame_times_out_then_retries(backend):
     assert time.time() - t0 >= 1.0
     assert ("drop", "push") in inj.log
     np.testing.assert_allclose(backend.pull(2), 7.0)
-    assert mx.telemetry.counter("kvstore.timeouts").value > timeouts0
+    # the client's recv timeout and the server's idle-connection drop
+    # are both armed at MXNET_KVSTORE_TIMEOUT: on a loaded box the
+    # server can win, turning the stall into a ConnectionError instead
+    # of socket.timeout — either way the retry counter must move
+    assert mx.telemetry.counter("kvstore.retries").value > retries0 or \
+        mx.telemetry.counter("kvstore.timeouts").value > timeouts0
 
 
 def test_lost_reply_not_double_applied(backend):

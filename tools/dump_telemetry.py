@@ -84,6 +84,11 @@ def print_serving(snap, out=None):
                  s.get("prefix_cache_bytes", 0),
                  s.get("prefix_evictions", 0),
                  s.get("prefix_insert_skipped", 0)))
+    out.write("robustness:       shed=%s deadline_missed=%s "
+              "cancelled=%s errors=%s watchdog_trips=%s restores=%s\n"
+              % (s.get("shed", 0), s.get("deadline_missed", 0),
+                 s.get("cancelled", 0), s.get("request_errors", 0),
+                 s.get("watchdog_trips", 0), s.get("restores", 0)))
     out.write("compiles:         decode=%s prefill=%s copy=%s\n"
               % (s.get("compiles_decode", 0),
                  s.get("compiles_prefill", 0),
