@@ -75,5 +75,8 @@ from . import predict  # noqa: E402
 from .predict import Predictor  # noqa: E402
 from . import serving  # noqa: E402
 from .serving import InferenceEngine  # noqa: E402
+# after serving: the exposition server's /requests//healthz endpoints
+# walk the engine registry, and MXNET_TELEMETRY_PORT arms it at import
+from . import telemetry_http  # noqa: E402
 
 __version__ = "0.1.0"

@@ -35,6 +35,7 @@ from .. import ndarray as nd
 from ..ndarray import NDArray
 from .. import optimizer as opt_mod
 from .. import metric as metric_mod
+from .. import profiler
 from .. import telemetry as tele
 from ..initializer import Uniform
 from .graph import make_graph_fn, integer_semantic_inputs
@@ -335,6 +336,7 @@ class ParallelTrainer:
         self._jit_multi = {}  # num_steps -> compiled scan-of-steps
         self._jit_eval = None
         self._h2d_batch_bytes = None  # telemetry: computed on first stage
+        self._prog_registered = False  # program.* introspection, once
         # buffer donation for the carried train state; flipped off at
         # runtime if this jaxlib miscompiles the alias table (see
         # _disable_donation_or_reraise)
@@ -661,6 +663,24 @@ class ParallelTrainer:
         _TM_STEPS.inc()
         _TM_STEP_MS.observe(dt * 1e3)
         tele.trace_complete("train.step", t0, dt)
+        if not self._prog_registered:
+            # one-time: register the step program for program.* cost/
+            # memory introspection (doc/observability.md). Post-call
+            # arrays carry the avals the dispatch traced with (the
+            # pre-call train state may be donated); the registry keeps
+            # only ShapeDtypeStructs — nothing device-resident.
+            self._prog_registered = True
+            # eager: the cost gauges are captured NOW, while the step
+            # is alive — FeedForward.fit drops its trainer right after
+            # fitting, so a scrape-time collection would find a dead
+            # weakref and no gauges. Worst case (aval lowering-cache
+            # miss on exotic layouts) is one extra abstract trace,
+            # paid once right after the first step's full XLA compile
+            # — noise next to it.
+            profiler.register_program(
+                "train_step", self._jit_step,
+                (self.params, self.opt_state, self.aux, batch,
+                 np.float32(lr), np.int32(self._t), self._rng))
         return outs
 
     def _disable_donation_or_reraise(self, err):
@@ -691,6 +711,7 @@ class ParallelTrainer:
         self._donate = False
         self._jit_step = None
         self._jit_multi.clear()
+        self._prog_registered = False   # the rebuilt step re-registers
 
     def _build_multi_step(self, num_steps):
         self._note_compile("multi_step", num_steps=num_steps)

@@ -24,7 +24,9 @@ families above are frozen.
 """
 from .engine import (InferenceEngine, Request, EngineOverloaded,
                      EngineClosed, EngineStuck)
+from .flight import FlightRecorder
 from .prefix import PrefixCache
 
 __all__ = ["InferenceEngine", "Request", "PrefixCache",
+           "FlightRecorder",
            "EngineOverloaded", "EngineClosed", "EngineStuck"]

@@ -77,6 +77,7 @@ import collections
 import math
 import os
 import time
+import weakref
 
 import numpy as np
 import jax
@@ -84,13 +85,20 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..base import MXNetError
+from .. import profiler
 from .. import telemetry as tele
 from ..io import StagedStream
 from ..parallel.decode import Decoder
+from .flight import FlightRecorder
 from .prefix import PrefixCache
 
 __all__ = ["InferenceEngine", "Request", "EngineOverloaded",
            "EngineClosed", "EngineStuck"]
+
+# live engines in this process, for the observability plane only: the
+# exposition server's /requests, /flight/<id> and /healthz walk this
+# set (weak — an engine the caller dropped disappears with it)
+_ENGINES = weakref.WeakSet()
 
 # serving-side fault injection (mxnet_tpu.testing.faults): an installed
 # injector's hooks run at the engine's host-side seams — h2d/prefill
@@ -173,6 +181,24 @@ _TM_CANCELLED = tele.counter("serving.cancelled")
 _TM_ERRORS = tele.counter("serving.request_errors")
 _TM_WATCHDOG = tele.counter("serving.watchdog_trips")
 _TM_RESTORES = tele.counter("serving.restores")
+# SLO accounting (doc/observability.md "SLO accounting"): attainment
+# counters tick at the same host-side points that feed the TTFT and
+# cadence histograms; the burn gauges are multi-window derivatives of
+# those histograms (tele.SloWindow), refreshed each round and on every
+# exposition-server scrape. Declared with literal names so the metric
+# catalog lint sees them.
+_TM_SLO_TTFT_OK = tele.counter("serving.slo_ttft_attained")
+_TM_SLO_TTFT_MISS = tele.counter("serving.slo_ttft_missed")
+_TM_SLO_CAD_OK = tele.counter("serving.slo_cadence_attained")
+_TM_SLO_CAD_MISS = tele.counter("serving.slo_cadence_missed")
+_SLO_TTFT_WINDOWS = (
+    (60.0, tele.gauge("serving.slo_ttft_burn_1m")),
+    (300.0, tele.gauge("serving.slo_ttft_burn_5m")),
+    (3600.0, tele.gauge("serving.slo_ttft_burn_1h")))
+_SLO_CADENCE_WINDOWS = (
+    (60.0, tele.gauge("serving.slo_cadence_burn_1m")),
+    (300.0, tele.gauge("serving.slo_cadence_burn_5m")),
+    (3600.0, tele.gauge("serving.slo_cadence_burn_1h")))
 
 
 class Request:
@@ -397,13 +423,36 @@ class InferenceEngine:
         fresh engine (real wedge). Mutable attribute; size it well
         above the worst legitimate round (compiles excepted — first
         rounds trace).
+    slo_ttft_ms / slo_cadence_ms : float, optional
+        Per-engine SLO targets (defaults: ``MXNET_SERVING_SLO_TTFT_MS``
+        / ``MXNET_SERVING_SLO_CADENCE_MS`` env vars, else unset = no
+        SLO accounting): a request whose time-to-first-token (resp.
+        steady per-token cadence) beats the target ticks
+        ``serving.slo_*_attained``, otherwise ``_missed``; multi-window
+        burn-rate gauges (``serving.slo_*_burn_{1m,5m,1h}``) are
+        derived from the existing latency histograms each round and on
+        every ``/metrics`` scrape. Measurement only — nothing here
+        changes scheduling (that is ROADMAP item 5's job). Mutable
+        attributes. ``slo_target`` (default 0.99) is the attainment
+        objective the burn rates are normalized against.
+    flight_recorder : int, optional
+        How many RETIRED requests keep their full flight-recorder
+        timeline (submit → staged → admitted → prefix hit/copy →
+        prefill chunks → sampled decode progress → retire reason) for
+        post-hoc reconstruction via ``engine.flight.timeline(id)`` or
+        ``GET /flight/<id>``. Default: the
+        ``MXNET_SERVING_FLIGHT_RECORDER`` env var, else 256; 0
+        disables recording. Host-side, bounded (doc/observability.md
+        "The flight recorder").
     """
 
     def __init__(self, decoder, slots=8, prefill_buckets=None,
                  max_queue=256, stage_depth=2, drain_depth=2,
                  steps_per_round=1, prefix_cache_mb=None,
                  prefill_chunk=None, overload=None,
-                 round_timeout_ms=None):
+                 round_timeout_ms=None, slo_ttft_ms=None,
+                 slo_cadence_ms=None, slo_target=0.99,
+                 flight_recorder=None):
         if not isinstance(decoder, Decoder):
             raise MXNetError("InferenceEngine needs a Decoder, got %r"
                              % type(decoder).__name__)
@@ -460,6 +509,34 @@ class InferenceEngine:
         if self.round_timeout_ms < 0:
             raise MXNetError("InferenceEngine: round_timeout_ms must "
                              "be >= 0 (0 disables the watchdog)")
+        if slo_ttft_ms is None:
+            slo_ttft_ms = os.environ.get("MXNET_SERVING_SLO_TTFT_MS")
+            slo_ttft_ms = float(slo_ttft_ms) if slo_ttft_ms else None
+        if slo_cadence_ms is None:
+            slo_cadence_ms = os.environ.get(
+                "MXNET_SERVING_SLO_CADENCE_MS")
+            slo_cadence_ms = float(slo_cadence_ms) if slo_cadence_ms \
+                else None
+        for nm, v in (("slo_ttft_ms", slo_ttft_ms),
+                      ("slo_cadence_ms", slo_cadence_ms)):
+            if v is not None and not v > 0:
+                raise MXNetError("InferenceEngine: %s must be > 0 "
+                                 "(None disables SLO accounting), got "
+                                 "%r" % (nm, v))
+        if not 0.0 < float(slo_target) < 1.0:
+            raise MXNetError("InferenceEngine: slo_target must be in "
+                             "(0, 1), got %r" % (slo_target,))
+        self.slo_ttft_ms = slo_ttft_ms
+        self.slo_cadence_ms = slo_cadence_ms
+        self.slo_target = float(slo_target)
+        self._slo_windows = {}
+        if flight_recorder is None:
+            flight_recorder = int(os.environ.get(
+                "MXNET_SERVING_FLIGHT_RECORDER", "") or 256)
+        if int(flight_recorder) < 0:
+            raise MXNetError("InferenceEngine: flight_recorder must "
+                             "be >= 0 (0 disables the recorder)")
+        self.flight = FlightRecorder(retain=int(flight_recorder))
         self.stage_depth = int(stage_depth)
 
         # device-resident: the slot-paged cache + per-slot state vectors
@@ -547,6 +624,13 @@ class InferenceEngine:
                                 donate_argnums=self._donate)
         self._prefill_fns = {}
         self._copy_fns = {}
+        # observability plane: watchdog/liveness state read by
+        # health() and the exposition server's /healthz, plus the
+        # once-per-program introspection registration guard
+        self._last_ok_t = time.perf_counter()
+        self._watchdog_stuck_t = None
+        self._prog_seen = set()
+        _ENGINES.add(self)
 
     # -- construction ---------------------------------------------------
     @classmethod
@@ -555,6 +639,8 @@ class InferenceEngine:
                         stage_depth=2, drain_depth=2, steps_per_round=1,
                         prefix_cache_mb=None, prefill_chunk=None,
                         overload=None, round_timeout_ms=None,
+                        slo_ttft_ms=None, slo_cadence_ms=None,
+                        slo_target=0.99, flight_recorder=None,
                         **decoder_kwargs):
         """Checkpoint → serving engine in one call
         (``prefix-symbol.json`` + ``prefix-NNNN.params``, the reference
@@ -570,7 +656,10 @@ class InferenceEngine:
                    steps_per_round=steps_per_round,
                    prefix_cache_mb=prefix_cache_mb,
                    prefill_chunk=prefill_chunk, overload=overload,
-                   round_timeout_ms=round_timeout_ms)
+                   round_timeout_ms=round_timeout_ms,
+                   slo_ttft_ms=slo_ttft_ms,
+                   slo_cadence_ms=slo_cadence_ms, slo_target=slo_target,
+                   flight_recorder=flight_recorder)
 
     # -- compiled programs ----------------------------------------------
     def _make_step(self):
@@ -615,8 +704,12 @@ class InferenceEngine:
                 out
 
         def step(params, aux, caches, state):
-            self._compile_log.append("decode")  # trace-time, see above
-            _TM_COMPILE_DECODE.inc()
+            # trace-time, see above; an introspection re-lower
+            # (profiler.collect_program_stats on a lowering-cache
+            # miss) must not count as a compile
+            if not profiler.collecting():
+                self._compile_log.append("decode")
+                _TM_COMPILE_DECODE.inc()
 
             def body(carry, _):
                 caches, st = carry
@@ -640,8 +733,9 @@ class InferenceEngine:
                 # chunk of a chunked prefill: start, the chunk's true
                 # length and finality are traced operands. total = the
                 # absolute prompt length covered so far.
-                self._compile_log.append(("prefill", bucket))
-                _TM_COMPILE_PREFILL.inc()
+                if not profiler.collecting():
+                    self._compile_log.append(("prefill", bucket))
+                    _TM_COMPILE_PREFILL.inc()
                 pos, tok, live, temps, keys, eoss, lasts = state
                 total = start + true_len
                 sub = dec.slot_slice(caches, slot)
@@ -703,8 +797,9 @@ class InferenceEngine:
         buffer)."""
         if bucket not in self._copy_fns:
             def copy(serv, pool, src, dst, src_pool, dst_pool):
-                self._compile_log.append(("copy", bucket))
-                _TM_COMPILE_COPY.inc()
+                if not profiler.collecting():
+                    self._compile_log.append(("copy", bucket))
+                    _TM_COMPILE_COPY.inc()
                 rows = lax.cond(
                     src_pool,
                     lambda _: Decoder.slot_prefix_rows(pool, src,
@@ -737,6 +832,12 @@ class InferenceEngine:
             self._caches, self._pool = self._copy_fn(bucket)(
                 self._caches, self._pool, np.int32(src), np.int32(dst),
                 np.bool_(src_pool), np.bool_(dst_pool))
+        if ("copy", bucket) not in self._prog_seen:
+            self._prog_seen.add(("copy", bucket))
+            profiler.register_program(
+                "serving_copy_b%d" % bucket, self._copy_fns[bucket],
+                (self._caches, self._pool, np.int32(0), np.int32(0),
+                 np.bool_(True), np.bool_(False)))
         self.stats["prefix_copies"] += 1
 
     @property
@@ -787,12 +888,16 @@ class InferenceEngine:
             p = len(req.seq)
             if (self.prefill_chunk and p > self.prefill_chunk) \
                     or p > self.prefill_buckets[-1]:
+                self.flight.event(req.id, "staged", chunked=True)
                 return req, None
             bucket = self._bucket_for(p)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :p] = req.seq
-            return req, jax.device_put(padded)
+            dev = jax.device_put(padded)
+            self.flight.event(req.id, "staged", bucket=bucket)
+            return req, dev
         except Exception as e:               # noqa: BLE001 — isolated
+            self.flight.event(req.id, "staged", error=str(e))
             return req, _PlacementError(e)
 
     def queued(self):
@@ -938,6 +1043,18 @@ class InferenceEngine:
         if req._deadline is not None or req._ttft_deadline is not None:
             self._watched.add(rid)
         self.stats["submitted"] += 1
+        if self.flight.enabled:
+            meta = {"prompt_len": int(prompt.size),
+                    "max_tokens": max_tokens}
+            if temperature:
+                meta["temperature"] = temperature
+            if req.resumed:
+                meta["resumed"] = req.resumed
+            if deadline_ms is not None:
+                meta["deadline_ms"] = deadline_ms
+            if ttft_deadline_ms is not None:
+                meta["ttft_deadline_ms"] = ttft_deadline_ms
+            self.flight.start(rid, **meta)
         return req
 
     def cancel(self, request_id):
@@ -980,6 +1097,18 @@ class InferenceEngine:
         req.error = error
         self._active.pop(req.id, None)
         self._watched.discard(req.id)
+        if self.flight.enabled:
+            extra = {"tokens": len(req.tokens)}
+            if error is not None:
+                extra["error"] = str(error)
+            self.flight.retire(req.id, reason, **extra)
+        # a TTFT SLO cannot be attained by a request that died without
+        # a first token: count the deadline retirement as a miss (the
+        # burn gauges, derived from the TTFT histogram, only see
+        # SERVED requests — doc/observability.md notes the split)
+        if self.slo_ttft_ms is not None and req.t_first is None \
+                and reason == "deadline":
+            _TM_SLO_TTFT_MISS.inc()
         if reason == "deadline":
             _TM_DEADLINE.inc()
             self.stats["deadline_missed"] += 1
@@ -1154,6 +1283,10 @@ class InferenceEngine:
             req.t_admit = time.perf_counter()
             _TM_QUEUE_WAIT_MS.observe(
                 (req.t_admit - req.t_submit) * 1e3)
+            self.flight.event(
+                req.id, "admitted", slot=slot,
+                queue_wait_ms=round(
+                    (req.t_admit - req.t_submit) * 1e3, 3))
             st = {"req": req, "slot": slot, "dev": dev, "next": hit,
                   "entry": None,
                   # retain only prompts no entry already covers whole
@@ -1175,11 +1308,14 @@ class InferenceEngine:
                         self.stats["prefix_hit_tokens"] += hit
                         _TM_PREFIX_HITS.inc()
                         _TM_PREFIX_HIT_TOKENS.inc(hit)
+                        self.flight.event(req.id, "prefix_hit",
+                                          tokens=hit)
                         self._dispatch_copy(hit, src=entry.slot,
                                             dst=slot, src_pool=True,
                                             dst_pool=False)
                     else:
                         _TM_PREFIX_MISSES.inc()
+                        self.flight.event(req.id, "prefix_miss")
                 if not self._advance_chunk(st):
                     self._chunking.append(st)
             except Exception as e:       # noqa: BLE001 — poisoned
@@ -1259,6 +1395,20 @@ class InferenceEngine:
                 _raw_key(req.seed),
                 np.int32(-1 if req.eos_id is None else req.eos_id),
                 np.int32(req.limit - req.resumed))
+        if ("prefill", bucket) not in self._prog_seen:
+            self._prog_seen.add(("prefill", bucket))
+            # post-dispatch arrays carry the same avals the dispatch
+            # traced with (the pre-call ones may be donated) — the
+            # registry converts to ShapeDtypeStructs immediately
+            profiler.register_program(
+                "serving_prefill_b%d" % bucket, fn,
+                (params, aux, self._caches, self._state, np.int32(0),
+                 np.zeros((1, bucket), np.int32), np.int32(0),
+                 np.int32(1), np.bool_(True), np.float32(0),
+                 _raw_key(0), np.int32(-1), np.int32(1)))
+        self.flight.event(req.id, "prefill_chunk", start=start,
+                          tokens=piece, bucket=bucket,
+                          final=bool(final))
         req.prefill_chunks += 1
         st["next"] = start + piece
         self.stats["prefill_chunks"] += 1
@@ -1292,6 +1442,7 @@ class InferenceEngine:
                 if new is None:
                     _TM_PREFIX_INSERT_SKIPPED.inc()
                 else:
+                    self.flight.event(req.id, "retained", tokens=p)
                     try:
                         # the slot's rows [0, P) ARE the prompt K/V
                         # right now — the retention copy is ordered
@@ -1317,7 +1468,15 @@ class InferenceEngine:
         req.tokens.append(int(t))
         if req.t_first is None:
             req.t_first = now
-            _TM_TTFT_MS.observe((now - req.t_submit) * 1e3)
+            ttft_ms = (now - req.t_submit) * 1e3
+            _TM_TTFT_MS.observe(ttft_ms)
+            if self.slo_ttft_ms is not None:
+                (_TM_SLO_TTFT_OK if ttft_ms <= self.slo_ttft_ms
+                 else _TM_SLO_TTFT_MISS).inc()
+            self.flight.event(req.id, "first_token",
+                              ttft_ms=round(ttft_ms, 3))
+        else:
+            self.flight.token(req.id, len(req.tokens))
         self.stats["tokens"] += 1
         _TM_TOKENS.inc()
         hit_eos = req.eos_id is not None and t == req.eos_id
@@ -1331,13 +1490,20 @@ class InferenceEngine:
             # a resumed request's pre-crash tokens arrived before
             # t_first and must not inflate the denominator
             if len(req.tokens) - req.resumed > 1:
-                _TM_CADENCE_MS.observe(
-                    (req.t_done - req.t_first)
-                    / (len(req.tokens) - req.resumed - 1) * 1e3)
+                cadence_ms = ((req.t_done - req.t_first)
+                              / (len(req.tokens) - req.resumed - 1)
+                              * 1e3)
+                _TM_CADENCE_MS.observe(cadence_ms)
+                if self.slo_cadence_ms is not None:
+                    (_TM_SLO_CAD_OK
+                     if cadence_ms <= self.slo_cadence_ms
+                     else _TM_SLO_CAD_MISS).inc()
             self._active.pop(req.id, None)
             self._watched.discard(req.id)
             self._release_slot(slot)
             self.stats["completed"] += 1
+            self.flight.retire(req.id, req.retire_reason,
+                               tokens=len(req.tokens))
             self._done_buf.append(req)
 
     def _guard_ready(self, arrays):
@@ -1357,6 +1523,7 @@ class InferenceEngine:
             if time.perf_counter() >= deadline:
                 _TM_WATCHDOG.inc()
                 self.stats["watchdog_trips"] += 1
+                self._watchdog_stuck_t = time.perf_counter()
                 raise EngineStuck(
                     "InferenceEngine: dispatched round not ready after "
                     "round_timeout_ms=%g — device stuck or overloaded. "
@@ -1369,6 +1536,7 @@ class InferenceEngine:
         entry = self._drain[0]       # peek: a watchdog trip must not
         self._guard_ready(entry[3] if entry[0] == "prefill"
                           else entry[1])  # lose the undrained round
+        self._watchdog_stuck_t = None    # drained: device recovered
         self._drain.popleft()
         now = time.perf_counter()
         if entry[0] == "prefill":
@@ -1431,6 +1599,12 @@ class InferenceEngine:
                 self._caches, self._state, out = self._step_fn(
                     self._dec._params, self._dec._aux,
                     self._caches, self._state)
+            if "decode" not in self._prog_seen:
+                self._prog_seen.add("decode")
+                profiler.register_program(
+                    "serving_decode", self._step_fn,
+                    (self._dec._params, self._dec._aux, self._caches,
+                     self._state))
             self._drain.append(("step", out))
             self.stats["steps"] += 1
             _TM_ROUNDS.inc()
@@ -1441,8 +1615,79 @@ class InferenceEngine:
         while len(self._drain) > (self._drain_depth if self._busy()
                                   else 0):
             self._drain_one()
+        self._last_ok_t = time.perf_counter()
+        self._slo_tick(self._last_ok_t)
         done_now, self._done_buf = self._done_buf, []
         return done_now
+
+    # -- observability plane (doc/observability.md) ---------------------
+    def _slo_tick(self, now=None):
+        """Refresh the multi-window SLO burn gauges from the TTFT /
+        cadence histograms (rate-limited inside ``tele.SloWindow`` —
+        per-round calls cost a dict lookup). Called at the end of
+        every ``step()`` and by the exposition server per scrape, so
+        the gauges stay current even when the engine idles. The
+        histograms are process-wide: with several engines in one
+        process the burn gauges reflect the engine that ticked last
+        (deploy one engine per process for per-replica SLOs)."""
+        for kind, thr, hist, windows in (
+                ("ttft", self.slo_ttft_ms, _TM_TTFT_MS,
+                 _SLO_TTFT_WINDOWS),
+                ("cadence", self.slo_cadence_ms, _TM_CADENCE_MS,
+                 _SLO_CADENCE_WINDOWS)):
+            if thr is None:
+                continue
+            w = self._slo_windows.get(kind)
+            if w is None or w.threshold != float(thr):
+                # (re)build on first use or a threshold change — the
+                # window history restarts, which is the honest reading
+                # of "the SLO target changed"
+                w = tele.SloWindow(
+                    hist, thr, target=self.slo_target,
+                    windows=[(s, g) for s, g in windows])
+                self._slo_windows[kind] = w
+            w.tick(now)
+
+    def health(self):
+        """Liveness summary for ``/healthz`` (plain dict, host-side):
+        ``stuck`` is the PR 7 watchdog state — True from a
+        ``round_timeout_ms`` trip until a later drain succeeds (the
+        recovered device clears it); ``closed`` after :meth:`close`.
+        ``last_round_age_s`` is how long since a ``step()`` completed
+        — a serving loop that stopped stepping shows up here even
+        without a watchdog armed."""
+        now = time.perf_counter()
+        return {
+            "closed": self._closed,
+            "stuck": self._watchdog_stuck_t is not None,
+            "watchdog_trips": self.stats["watchdog_trips"],
+            "slots": self.slots,
+            "slots_busy": self.slots - len(self._free),
+            "queued": self.queued(),
+            "last_round_age_s": round(now - self._last_ok_t, 3),
+        }
+
+    def request_table(self):
+        """Live + recently-retired request rows for ``/requests``:
+        every unfinished request (queued, staged, mid-prefill, or
+        decoding) followed by the flight recorder's retired ring.
+        Plain dicts, host bookkeeping only."""
+        now = time.perf_counter()
+        rows = []
+        for req in list(self._active.values()):
+            if req.done:
+                continue
+            state = "queued" if req.t_admit is None else "running"
+            rows.append({
+                "id": req.id, "state": state,
+                "prompt_len": int(len(req.prompt)),
+                "tokens": len(req.tokens),
+                "age_s": round(now - req.t_submit, 3),
+                "deadline_ms": req.deadline_ms,
+                "prefix_hit_tokens": req.prefix_hit_tokens,
+            })
+        rows.extend(self.flight.rows())
+        return rows
 
     def serve_forever(self, requests=None):
         """Drive the loop to completion: pull submissions from
@@ -1532,6 +1777,10 @@ class InferenceEngine:
         if self._closed:
             return
         self._closed = True
+        # a closed engine is not "stuck": the wedged round died with
+        # it, and /healthz must not 503 a process that closed the
+        # tripped engine and replaced it with a healthy one
+        self._watchdog_stuck_t = None
         for req in list(self._active.values()):
             self._retire_active(req, "closed", EngineClosed(
                 "InferenceEngine: engine closed while request %r was "
@@ -1596,6 +1845,10 @@ class InferenceEngine:
                 "prefill_chunk": self.prefill_chunk,
                 "overload": self.overload,
                 "round_timeout_ms": self.round_timeout_ms,
+                "slo_ttft_ms": self.slo_ttft_ms,
+                "slo_cadence_ms": self.slo_cadence_ms,
+                "slo_target": self.slo_target,
+                "flight_recorder": self.flight.retain,
             },
             "requests": reqs,
         }

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import atexit
 import bisect
+import collections
 import contextlib
 import json
 import logging
@@ -51,8 +52,8 @@ from .base import MXNetError
 __all__ = ["counter", "gauge", "histogram", "snapshot", "to_prometheus",
            "span", "mark", "trace_complete", "start_trace", "stop_trace",
            "tracing", "tracing_paused", "enable", "enabled", "reset",
-           "start_reporter", "stop_reporter",
-           "Counter", "Gauge", "Histogram"]
+           "start_reporter", "stop_reporter", "serve", "stop_server",
+           "Counter", "Gauge", "Histogram", "SloWindow"]
 
 # default histogram buckets: wall-time milliseconds, µs-to-minutes —
 # wide because the same shape serves sub-ms decode rounds and multi-s
@@ -194,11 +195,13 @@ class Histogram:
 
     def percentile(self, q):
         """Upper bound of the bucket containing quantile ``q`` in
-        [0, 1] (None when empty; max for the +inf bucket)."""
+        [0, 1] (``nan`` when empty — a percentile of nothing is not a
+        number, and a silent None used to poison arithmetic at the
+        caller; max for the +inf bucket)."""
         with self._lock:
             total = self._count
             if total == 0:
-                return None
+                return float("nan")
             need = q * total
             acc = 0
             for i, c in enumerate(self._counts):
@@ -208,6 +211,19 @@ class Histogram:
                         return self.buckets[i]
                     return self._max
             return self._max
+
+    def count_le(self, v):
+        """Observations ``<=`` the smallest bucket bound ``>= v`` —
+        the cumulative count a Prometheus ``le`` bucket would report.
+        Exact when ``v`` IS a bucket bound; otherwise the threshold is
+        quantized UP to the next bound (fixed buckets cannot resolve
+        between bounds). ``v`` past the last bound counts everything.
+        This is the attainment primitive :class:`SloWindow` reads."""
+        i = bisect.bisect_left(self.buckets, float(v))
+        with self._lock:
+            if i >= len(self.buckets):
+                return self._count
+            return sum(self._counts[:i + 1])
 
     def _reset(self):
         with self._lock:
@@ -352,12 +368,21 @@ def to_prometheus():
             with m._lock:
                 counts = list(m._counts)
                 total, tsum = m._count, m._sum
+                vmin, vmax = m._min, m._max
             for b, c in zip(m.buckets, counts):
                 acc += c
                 lines.append('%s_bucket{le="%g"} %d' % (base, b, acc))
             lines.append('%s_bucket{le="+Inf"} %d' % (base, total))
             lines.append("%s_sum %.17g" % (base, tsum))
             lines.append("%s_count %d" % (base, total))
+            if total:
+                # exact streaming extrema next to the bucket-approx
+                # quantiles: scrapers can see how far a tail reading
+                # may sit from the bucket bound that reported it
+                lines.append("# TYPE %s_min gauge" % base)
+                lines.append("%s_min %.17g" % (base, vmin))
+                lines.append("# TYPE %s_max gauge" % base)
+                lines.append("%s_max %.17g" % (base, vmax))
     return "\n".join(lines) + "\n"
 
 
@@ -506,6 +531,125 @@ def span(name, cat="mx", hist=None, **args):
             hist.observe(dt * 1e3)
         if _state.trace_active:
             trace_complete(name, t0, dt, cat=cat, args=args or None)
+
+
+# ---------------------------------------------------------------------------
+# SLO accounting: multi-window burn rates over an existing histogram
+
+class SloWindow:
+    """Multi-window SLO burn-rate gauges computed from an existing
+    cumulative :class:`Histogram` (doc/observability.md "SLO
+    accounting").
+
+    The histogram already holds every observation; what an SLO needs
+    on top is *windowed attainment*: of the observations in the last
+    W seconds, what fraction beat the target latency, and how fast is
+    that burning the error budget? ``tick()`` samples the histogram's
+    ``(count, count_le(threshold))`` pair on a bounded cadence and
+    differences the samples per window:
+
+        burn = (misses_in_window / observations_in_window)
+               / (1 - target)
+
+    so burn 1.0 = missing exactly the budgeted rate (e.g. 1% for
+    target 0.99), burn 10 = burning budget 10x too fast — the
+    standard multi-window multi-burn-rate alerting shape (SRE
+    workbook ch. 5). The threshold is quantized UP to the histogram's
+    next bucket bound (:meth:`Histogram.count_le`); windows with no
+    observations read 0 (no traffic burns no budget).
+
+    ``windows``: sequence of ``(seconds, Gauge)`` — the gauges are
+    created by the caller with literal names so the metric catalog
+    lint can see them. Host-side and allocation-bounded: one sample
+    per ``min_interval_s`` at most, pruned past the longest window.
+    """
+
+    def __init__(self, hist, threshold, target=0.99, windows=(),
+                 min_interval_s=1.0):
+        if not 0.0 < float(target) < 1.0:
+            raise MXNetError("SloWindow: target must be in (0, 1), "
+                             "got %r" % (target,))
+        self.hist = hist
+        self.threshold = float(threshold)
+        self.budget = 1.0 - float(target)
+        self.windows = tuple(sorted(((float(w), g) for w, g in windows),
+                                    key=lambda p: p[0]))
+        self.min_interval_s = float(min_interval_s)
+        self._samples = collections.deque()
+        self._last = None
+        # tick() is called from the owning loop AND from exposition-
+        # server scrape threads; the deque iteration must not race a
+        # concurrent append/popleft (the rate-limit check alone is
+        # racy). Uncontended lock: ~100 ns, once per >= min_interval.
+        self._lock = threading.Lock()
+
+    def tick(self, now=None):
+        """Sample the histogram and refresh every window's burn
+        gauge. Rate-limited: calls within ``min_interval_s`` of the
+        previous sample are free no-ops, so per-round callers don't
+        accumulate unbounded samples. Thread-safe."""
+        if not (_state.enabled and self.windows):
+            return
+        if now is None:
+            now = time.perf_counter()
+        with self._lock:
+            self._tick_locked(now)
+
+    def _tick_locked(self, now):
+        if self._last is not None \
+                and now - self._last < self.min_interval_s:
+            return
+        self._last = now
+        # ok BEFORE total: the two reads are separate histogram lock
+        # acquisitions, and an observe landing between them must err
+        # toward counting the racing observation as a miss (bounded by
+        # the clamp below) — the other order could read ok > total and
+        # export a NEGATIVE burn rate
+        ok = self.hist.count_le(self.threshold)
+        total = self.hist.count
+        self._samples.append((now, total, ok))
+        horizon = now - self.windows[-1][0]
+        # keep ONE sample at-or-before the horizon: it is the longest
+        # window's baseline
+        while len(self._samples) >= 2 and self._samples[1][0] <= horizon:
+            self._samples.popleft()
+        for w, g in self.windows:
+            base = self._samples[0]
+            for s in self._samples:
+                if s[0] <= now - w:
+                    base = s
+                else:
+                    break
+            d_total = total - base[1]
+            d_ok = ok - base[2]
+            if d_total <= 0:
+                g.set(0.0)
+            else:
+                miss_frac = min(1.0, max(
+                    0.0, 1.0 - d_ok / float(d_total)))
+                g.set(miss_frac / self.budget)
+
+
+# ---------------------------------------------------------------------------
+# HTTP exposition (mxnet_tpu/telemetry_http.py holds the server; these
+# delegators keep the user-facing surface on mx.telemetry)
+
+def serve(port=0, host="127.0.0.1"):
+    """Start (or restart) the read-only HTTP exposition server on a
+    daemon thread: ``GET /metrics`` (Prometheus text), ``/snapshot``
+    (JSON), ``/requests`` / ``/flight/<id>`` (serving request table +
+    per-request flight timelines), ``/healthz``. ``port=0`` binds an
+    ephemeral port. Returns the server handle (``.url``, ``.port``,
+    ``.stop()``). ``MXNET_TELEMETRY_PORT`` starts one at import. See
+    doc/observability.md "The exposition server"."""
+    from . import telemetry_http
+    return telemetry_http.serve(port=port, host=host)
+
+
+def stop_server():
+    """Stop the exposition server if one is running (idempotent)."""
+    from . import telemetry_http
+    telemetry_http.stop_server()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,541 @@
+"""The live observability plane (ISSUE 9): HTTP exposition server,
+flight recorder, SLO burn-rate accounting, XLA program/device
+introspection, and the metric-catalog lint.
+
+Everything here is host-side and compile-frugal: the ONLY compiled
+program in this file is one element-wise jit in the introspection test
+(~tens of ms on CPU) — no engines, no trainers. The engine-integrated
+paths (flight timeline of a fault-injected run, /healthz fed by the
+watchdog) are covered in tests/test_serving_faults.py on its
+module-scoped engines. The registry is process-global and shared with
+other test files, so assertions are delta-based or keyed to t10.*
+names no other file uses.
+"""
+import json
+import math
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry as tele
+from mxnet_tpu import telemetry_http
+from mxnet_tpu.serving.flight import FlightRecorder
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.headers.get("Content-Type"), \
+            resp.read().decode()
+
+
+@pytest.fixture()
+def server():
+    """Ephemeral-port exposition server, stopped even on failure (the
+    module singleton would otherwise leak across tests)."""
+    srv = tele.serve(port=0)
+    try:
+        yield srv
+    finally:
+        tele.stop_server()
+
+
+# -- satellite: histogram honesty --------------------------------------
+
+def test_percentile_on_empty_histogram_is_nan():
+    h = tele.histogram("t10.empty_hist")
+    assert math.isnan(h.percentile(0.5))
+    assert math.isnan(h.percentile(0.99))
+    h.observe(3.0)
+    assert not math.isnan(h.percentile(0.5))
+
+
+def test_count_le_uses_bucket_resolution():
+    h = tele.histogram("t10.le_hist", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 5000.0):
+        h.observe(v)
+    assert h.count_le(1.0) == 1          # exact on a bucket bound
+    assert h.count_le(10.0) == 2
+    assert h.count_le(5.0) == 2          # quantized UP to le=10
+    assert h.count_le(100.0) == 3
+    assert h.count_le(1e9) == 4          # past the last bound: total
+
+
+def test_prometheus_exposes_exact_min_max():
+    h = tele.histogram("t10.mm_hist")
+    h.observe(0.07)
+    h.observe(123.4)
+    text = tele.to_prometheus()
+    assert "# TYPE mxnet_t10_mm_hist_min gauge" in text
+    lines = dict(l.rsplit(" ", 1) for l in text.splitlines()
+                 if l.startswith("mxnet_t10_mm_hist"))
+    # the histogram buckets report le=0.1/le=250 for these values; the
+    # _min/_max gauges carry the EXACT extrema
+    assert float(lines["mxnet_t10_mm_hist_min"]) == 0.07
+    assert float(lines["mxnet_t10_mm_hist_max"]) == 123.4
+    # empty histograms emit no extrema lines
+    tele.histogram("t10.mm_empty")
+    assert "mxnet_t10_mm_empty_min" not in tele.to_prometheus()
+
+
+# -- SLO burn-rate math ------------------------------------------------
+
+def test_slo_window_burn_rates_multi_window():
+    """Burn = windowed miss fraction / error budget, from the
+    cumulative histogram alone: misses age OUT of a short window while
+    they still burn the long one."""
+    h = tele.histogram("t10.slo_hist", buckets=(10.0, 100.0))
+    g1 = tele.gauge("t10.slo_burn_short")
+    g2 = tele.gauge("t10.slo_burn_long")
+    w = tele.SloWindow(h, threshold=10.0, target=0.9,
+                       windows=((60.0, g1), (3600.0, g2)),
+                       min_interval_s=0.0)
+    w.tick(now=1000.0)                     # baseline: empty
+    for _ in range(8):
+        h.observe(1.0)                     # attained (<= 10ms)
+    for _ in range(2):
+        h.observe(50.0)                    # missed
+    w.tick(now=1010.0)
+    # 2/10 missed, budget 0.1 -> burn 2.0 in both windows
+    assert g1.value == pytest.approx(2.0)
+    assert g2.value == pytest.approx(2.0)
+    # 100s later: only attained traffic in the last 60s
+    for _ in range(10):
+        h.observe(1.0)
+    w.tick(now=1110.0)
+    assert g1.value == pytest.approx(0.0)          # short window clean
+    assert g2.value == pytest.approx(1.0)          # 2/20 missed / 0.1
+    # no traffic at all in the short window -> burn 0, not NaN
+    w.tick(now=1200.0)
+    assert g1.value == 0.0
+
+
+def test_slo_window_rate_limits_sampling():
+    h = tele.histogram("t10.slo_rl_hist")
+    g = tele.gauge("t10.slo_rl_burn")
+    w = tele.SloWindow(h, threshold=10.0, target=0.99,
+                       windows=((60.0, g),), min_interval_s=1.0)
+    for i in range(100):
+        w.tick(now=500.0 + i * 0.01)       # 1s of 10ms-spaced ticks
+    assert len(w._samples) == 1            # all but the first skipped
+
+
+# -- flight recorder ---------------------------------------------------
+
+def test_flight_recorder_ring_bounds_and_eviction():
+    fr = FlightRecorder(retain=3)
+    for rid in range(5):
+        fr.start(rid, prompt_len=4)
+        fr.event(rid, "admitted", slot=0)
+        fr.retire(rid, "eos", tokens=2)
+    live, retired = fr.ids()
+    assert live == [] and retired == [2, 3, 4]     # oldest evicted
+    assert fr.timeline(0) is None and fr.timeline(1) is None
+    tl = fr.timeline(4)
+    assert not tl["live"]
+    assert [e["event"] for e in tl["events"]] == \
+        ["submit", "admitted", "retire"]
+    assert tl["meta"]["retire_reason"] == "eos"
+    assert [r["id"] for r in fr.rows()] == [2, 3, 4]
+
+
+def test_flight_recorder_event_cap_and_terminal_retire():
+    fr = FlightRecorder(retain=2, max_events=8)
+    fr.start("r", prompt_len=1)
+    for i in range(20):
+        fr.event("r", "prefill_chunk", start=i)
+    fr.retire("r", "error", error="boom")
+    tl = fr.timeline("r")
+    assert tl["dropped_events"] == 20 - 7      # cap hit, drops counted
+    assert tl["events"][-1]["event"] == "retire"   # terminal always lands
+    assert tl["events"][-1]["reason"] == "error"
+
+
+def test_flight_recorder_token_sampling_and_disable():
+    fr = FlightRecorder(retain=4, token_sample=16)
+    fr.start(1, prompt_len=1)
+    for n in range(2, 40):
+        fr.token(1, n)
+    tl = fr.timeline(1)
+    decode = [e for e in tl["events"] if e["event"] == "decode"]
+    assert [e["tokens"] for e in decode] == [16, 32]
+    # retain=0 disables recording entirely
+    off = FlightRecorder(retain=0)
+    off.start(1, prompt_len=1)
+    off.retire(1, "eos")
+    assert off.timeline(1) is None and not off.enabled
+
+
+# -- HTTP exposition server --------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^(?:# (?:TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+    r"(?:counter|gauge|histogram)|HELP .*)"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^{}]*\})? [0-9eE+.natif-]+)$")
+
+
+def test_http_metrics_is_valid_prometheus_exposition(server):
+    tele.counter("t10.http_events").inc(3)
+    tele.histogram("t10.http_lat_ms").observe(2.0)
+    status, ctype, text = _get(server.url + "/metrics")
+    assert status == 200 and ctype.startswith("text/plain")
+    declared = set()
+    for line in text.rstrip("\n").splitlines():
+        assert _PROM_LINE.match(line), "bad exposition line: %r" % line
+        if line.startswith("# TYPE "):
+            declared.add(line.split()[2])
+        elif not line.startswith("#"):
+            name = re.split(r"[ {]", line, 1)[0]
+            # every sample belongs to a family declared ABOVE it
+            # (histogram samples carry _bucket/_sum/_count suffixes)
+            fam = re.sub(r"_(bucket|sum|count)$", "", name)
+            assert name in declared or fam in declared, name
+    assert "mxnet_t10_http_events_total 3" in text \
+        or re.search(r"mxnet_t10_http_events_total \d+", text)
+    # the scrape carries the PR 9 gauge families: SLO counters are
+    # registered at import, device gauges by the scrape's own refresh
+    assert "mxnet_serving_slo_ttft_attained_total" in text
+    assert "mxnet_serving_slo_ttft_burn_5m" in text
+    assert "mxnet_device_live_array_bytes" in text
+    # cumulative bucket shape survives the wire
+    lines = dict(l.rsplit(" ", 1) for l in text.splitlines()
+                 if l.startswith("mxnet_t10_http_lat_ms"))
+    assert lines['mxnet_t10_http_lat_ms_bucket{le="+Inf"}'] == \
+        lines["mxnet_t10_http_lat_ms_count"]
+
+
+def test_http_snapshot_round_trips_and_matches_registry(server):
+    tele.gauge("t10.http_gauge").set(7.5)
+    status, ctype, body = _get(server.url + "/snapshot")
+    assert status == 200 and ctype == "application/json"
+    snap = json.loads(body)                      # strict JSON parses
+    assert snap["t10"]["http_gauge"] == 7.5
+    assert json.loads(json.dumps(snap)) == snap  # round-trips
+
+
+def test_http_unknown_paths_and_write_methods_rejected(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server.url + "/not-an-endpoint")
+    assert e.value.code == 404
+    req = urllib.request.Request(server.url + "/metrics", data=b"x",
+                                 method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 405                   # strictly read-only
+    status, _, body = _get(server.url + "/")
+    assert status == 200 and "/flight/<request_id>" in body
+
+
+def test_http_healthz_ok_and_server_restart_and_stop(server):
+    status, _, body = _get(server.url + "/healthz")
+    doc = json.loads(body)
+    assert status == 200 and doc["status"] == "ok"
+    old_port = server.port
+    srv2 = tele.serve(port=0)                    # restart: singleton
+    assert telemetry_http._server is srv2
+    status, _, _ = _get(srv2.url + "/healthz")
+    assert status == 200
+    tele.stop_server()
+    assert not srv2.running
+    # the old server was stopped by the restart; its port is closed
+    with pytest.raises(Exception):
+        _get("http://127.0.0.1:%d/healthz" % old_port, timeout=2)
+
+
+def test_http_server_stops_cleanly_atexit_registered():
+    """serve() registers stop_server atexit, so an armed server never
+    outlives the interpreter holding its port."""
+    import atexit
+    # the hook is registered at module import; atexit keeps it in its
+    # private callback table — unregister succeeds only if present
+    atexit.unregister(telemetry_http.stop_server)
+    atexit.register(telemetry_http.stop_server)  # re-arm for real exits
+
+
+def test_http_requests_flight_healthz_with_stub_engine():
+    """/requests aggregates engine.request_table(), /flight searches
+    the recorders, and /healthz turns a stuck watchdog into 503 — all
+    duck-typed, so a stub keeps this zero-compile (the real engine
+    path is pinned in test_serving_faults.py)."""
+    from mxnet_tpu.serving import engine as engine_mod
+
+    class _StubEngine:
+        def __init__(self):
+            self.flight = FlightRecorder(retain=4)
+            self.stuck = False
+
+        def request_table(self):
+            return [{"id": "stub-1", "state": "running",
+                     "prompt_len": 3, "tokens": 1, "age_s": 0.5}] \
+                + self.flight.rows()
+
+        def health(self):
+            return {"closed": False, "stuck": self.stuck,
+                    "watchdog_trips": int(self.stuck)}
+
+    stub = _StubEngine()
+    stub.flight.start("stub-1", prompt_len=3)
+    stub.flight.event("stub-1", "admitted", slot=0)
+    stub.flight.retire("stub-1", "deadline", tokens=1)
+    engine_mod._ENGINES.add(stub)
+    srv = tele.serve(port=0)
+    try:
+        _, _, body = _get(srv.url + "/requests")
+        rows = json.loads(body)["requests"]
+        assert {"id": "stub-1", "state": "running", "prompt_len": 3,
+                "tokens": 1, "age_s": 0.5} in rows
+        assert any(r.get("state") == "retired" for r in rows)
+        _, _, body = _get(srv.url + "/flight/stub-1")
+        tl = json.loads(body)
+        assert [e["event"] for e in tl["events"]] == \
+            ["submit", "admitted", "retire"]
+        assert tl["meta"]["retire_reason"] == "deadline"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.url + "/flight/never-submitted")
+        assert e.value.code == 404
+        stub.stuck = True                       # watchdog trip state
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.url + "/healthz")
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["status"] == "stuck"
+    finally:
+        engine_mod._ENGINES.discard(stub)
+        tele.stop_server()
+
+
+def test_http_scrape_concurrent_with_writers(server):
+    """Scrapes race metric writers without error — the server thread
+    only ever reads under the registry's own locks."""
+    stop = threading.Event()
+    c = tele.counter("t10.race_count")
+    h = tele.histogram("t10.race_hist")
+
+    def writer():
+        while not stop.is_set():
+            c.inc()
+            h.observe(1.0)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        for _ in range(10):
+            status, _, _ = _get(server.url + "/metrics")
+            assert status == 200
+            status, _, _ = _get(server.url + "/snapshot")
+            assert status == 200
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
+# -- XLA program / device introspection --------------------------------
+
+def test_program_registry_cost_memory_and_device_gauges():
+    """register_program + collect_program_stats turn a dispatched jit
+    program into program.* gauges WITHOUT re-tracing it (trace count
+    pinned); device_memory always reports the live-array census and
+    degrades allocator stats to absent gauges on CPU."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import profiler
+
+    traces = []
+
+    def f(x, s):
+        traces.append(1)
+        return x * 2.0 + s
+
+    jf = jax.jit(f)
+    x = jnp.ones((16, 4), jnp.float32)
+    jf(x, np.float32(1)).block_until_ready()
+    assert len(traces) == 1
+    # eager=False exercises the scrape-time (lazy) collection path the
+    # trainer uses; engine registrations collect eagerly at dispatch
+    profiler.register_program("t10_prog", jf, (x, np.float32(1)),
+                              eager=False)
+    stats = profiler.collect_program_stats()
+    assert len(traces) == 1                  # cached lowering: no re-trace
+    assert stats["t10_prog"]["flops"] > 0
+    snap = tele.snapshot()["program"]["t10_prog"]
+    assert snap["flops"] > 0 and snap["bytes_accessed"] > 0
+    # second collection is a cached no-op
+    assert profiler.collect_program_stats() == {}
+    # deep collection adds the compiled memory analysis
+    deep = profiler.collect_program_stats(compile=True)
+    assert deep["t10_prog"]["argument_bytes"] > 0
+    assert "temp_bytes" in deep["t10_prog"]
+
+    dev = profiler.device_memory()
+    assert dev["live_array_bytes"] > 0
+    assert dev["live_array_peak_bytes"] >= dev["live_array_bytes"]
+    dsnap = tele.snapshot()["device"]
+    assert dsnap["live_arrays"] >= 1
+    if jax.default_backend() == "cpu":       # allocator stats absent
+        assert "bytes_in_use" not in dsnap   # -> absent gauges, no error
+
+
+def test_program_registry_holds_weakrefs_and_prunes_dead():
+    """Review finding: the registry must not pin a dropped owner (a
+    jit closure reaches the engine and its device-resident KV cache)
+    — dead registrations are pruned at the next collection."""
+    import gc
+    import weakref
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import profiler
+
+    class _Owner:                       # stands in for an engine
+        def __init__(self):
+            # the closure captures self, exactly like the engine's
+            # traced step capturing its compile log — a strong
+            # registry entry would pin the owner through it
+            self.log = []
+
+            def f(x):
+                self.log                # trace-time touch of owner
+                return x * 3.0
+
+            self.fn = jax.jit(f)
+
+    owner = _Owner()
+    wr = weakref.ref(owner)
+    x = jnp.ones((4,), jnp.float32)
+    owner.fn(x).block_until_ready()
+    profiler.register_program("t10_weak", owner.fn, (x,))
+    assert "t10_weak" in profiler.registered_programs()
+    del owner
+    gc.collect()
+    assert wr() is None                 # registry did not pin it
+    profiler.collect_program_stats()
+    assert "t10_weak" not in profiler.registered_programs()
+
+
+def test_healthz_ignores_closed_stuck_engines():
+    """Review finding: a watchdog-tripped engine that was closed and
+    replaced must not 503 /healthz forever — only a LIVE stuck engine
+    does."""
+    from mxnet_tpu.serving import engine as engine_mod
+
+    class _ClosedStuck:
+        flight = FlightRecorder(retain=0)
+
+        def request_table(self):
+            return []
+
+        def health(self):
+            return {"closed": True, "stuck": True, "watchdog_trips": 1}
+
+    stub = _ClosedStuck()
+    engine_mod._ENGINES.add(stub)
+    srv = tele.serve(port=0)
+    try:
+        status, _, body = _get(srv.url + "/healthz")
+        assert status == 200
+        assert json.loads(body)["status"] == "ok"
+    finally:
+        engine_mod._ENGINES.discard(stub)
+        tele.stop_server()
+
+
+def test_collect_lowering_miss_does_not_replay_side_effects():
+    """If collection's lower() ever MISSES the lowering cache (e.g.
+    committed-array avals on a real chip), the re-trace replays
+    trace-time side effects — the profiler.collecting() flag lets
+    compile-count logs (the serving engine's pinned contract) exempt
+    introspection re-traces."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import profiler
+
+    effects = []
+
+    def f(x):
+        if not profiler.collecting():
+            effects.append(1)           # the engine's compile-log shape
+        return x + 1.0
+
+    jf = jax.jit(f)
+    jf(jnp.ones((4,), jnp.float32)).block_until_ready()
+    assert effects == [1]
+    # different avals: the lowering cache misses, collection re-traces
+    profiler.register_program("t10_miss", jf,
+                              (jnp.ones((8,), jnp.float32),),
+                              eager=False)
+    stats = profiler.collect_program_stats()
+    assert "t10_miss" in stats
+    assert effects == [1]               # guarded side effect suppressed
+
+
+# -- metric-catalog lint -----------------------------------------------
+
+def test_metric_catalog_lint_is_clean():
+    """Every registered dotted metric literal under mxnet_tpu/ has a
+    doc/observability.md catalog row and vice versa — the catalog can
+    never silently rot again."""
+    from tools import lint_metrics
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    undocumented, stale = lint_metrics.lint(root)
+    assert not undocumented, (
+        "metrics registered in code but missing from the "
+        "doc/observability.md catalog: %s" % undocumented)
+    assert not stale, (
+        "metrics documented in doc/observability.md but no longer "
+        "registered in code: %s" % stale)
+
+
+def test_metric_catalog_lint_detects_drift(tmp_path):
+    """The lint actually fails on drift (guards the guard): an
+    undocumented registration and a stale catalog row both trip."""
+    from tools import lint_metrics
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'from . import telemetry as tele\n'
+        'C = tele.counter("sub.real_metric")\n'
+        'U = tele.gauge("sub.undocumented_metric")\n'
+        '# tele.counter("sub.commented_out") must NOT count\n')
+    doc = tmp_path / "doc"
+    doc.mkdir()
+    (doc / "observability.md").write_text(
+        "# Catalog\n\n"
+        "| Metric | Kind | Meaning |\n"
+        "|---|---|---|\n"
+        "| `sub.real_metric` | counter | Real. |\n"
+        "| `sub.gone_metric` | gauge | Stale. |\n"
+        "| `program.<name>.flops` | gauge | Pattern row. |\n")
+    undocumented, stale = lint_metrics.lint(str(tmp_path))
+    assert list(undocumented) == ["sub.undocumented_metric"]
+    assert stale == ["sub.gone_metric"]
+
+
+# -- dump_telemetry --url / --watch ------------------------------------
+
+def test_dump_telemetry_url_and_watch_read_live_server(capsys):
+    from tools import dump_telemetry
+    tele.counter("t10.dump_live").inc(4)
+    srv = tele.serve(port=0)
+    try:
+        dump_telemetry.main(["--url", srv.url])
+        out = capsys.readouterr().out
+        assert "dump_live" in out and "4" in out
+        # a copied Prometheus scrape URL reads the JSON twin instead
+        # of crashing on text exposition (review finding)
+        dump_telemetry.main(["--url", srv.url + "/metrics"])
+        assert "dump_live" in capsys.readouterr().out
+        # --watch re-reads the source on an interval (test hook caps
+        # the loop; non-tty output separates refreshes with a marker)
+        dump_telemetry.main(["--url", srv.url, "--watch", "0.01",
+                             "--watch-count", "2", "--serving"])
+        out = capsys.readouterr().out
+        assert out.count("--- refresh") == 2
+    finally:
+        tele.stop_server()
+    # exactly one source required
+    with pytest.raises(SystemExit):
+        dump_telemetry.main([])

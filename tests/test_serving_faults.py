@@ -465,6 +465,81 @@ def test_restore_beyond_bucket_prefix_hit_chunking_off(lm, ceng):
     assert len(ceng._free) == ceng.slots
 
 
+def test_flight_recorder_reconstructs_failed_request_over_http(lm,
+                                                               feng):
+    """ISSUE 9 acceptance: a fault-injected serving run leaves a
+    ``/flight/<id>`` timeline that reconstructs the failed request's
+    FULL lifecycle — submit through ``retire_reason`` — after
+    retirement, served by the live exposition server; the co-resident
+    request is unaffected and the observability plane compiles
+    nothing (the close test's compile-contract pin runs after this)."""
+    import json
+    import urllib.request
+
+    rng = np.random.RandomState(11)
+    p_ok, p_bad = (rng.randint(0, VOCAB, (4,)) for _ in range(2))
+    r_ok = feng.submit(p_ok, max_tokens=3)
+    feng.step()                  # r_ok admitted before the fault arms
+    fi = FaultInjector()
+    with fi.serving_h2d_failures(1):
+        r_bad = feng.submit(p_bad, max_tokens=3, deadline_ms=60000.0)
+        feng.serve_forever()
+    assert r_bad.done and r_bad.retire_reason == "error"
+    assert fi.log == [("h2d_fail", r_bad.id)]
+    np.testing.assert_array_equal(r_ok.result(), _oracle(lm, p_ok, 3))
+
+    srv = mx.telemetry.serve(port=0)
+    try:
+        with urllib.request.urlopen(
+                srv.url + "/flight/%s" % r_bad.id, timeout=10) as resp:
+            tl = json.load(resp)
+        # the reconstruction: every transition in submission order,
+        # with relative timestamps, available AFTER retirement
+        assert not tl["live"]
+        events = [e["event"] for e in tl["events"]]
+        assert events[0] == "submit" and events[-1] == "retire"
+        assert "staged" in events and "admitted" in events
+        ts = [e["t_ms"] for e in tl["events"]]
+        assert ts == sorted(ts) and ts[0] == 0.0
+        assert tl["meta"]["prompt_len"] == 4
+        assert tl["meta"]["deadline_ms"] == 60000.0
+        assert tl["meta"]["retire_reason"] == "error"
+        retire = tl["events"][-1]
+        assert retire["reason"] == "error"
+        assert "poisoned" in retire["error"]
+        # the healthy survivor's timeline retired normally next to it
+        with urllib.request.urlopen(
+                srv.url + "/flight/%s" % r_ok.id, timeout=10) as resp:
+            tl_ok = json.load(resp)
+        assert tl_ok["meta"]["retire_reason"] == "length"
+        assert [e["event"] for e in tl_ok["events"]][:4] == \
+            ["submit", "staged", "admitted", "prefill_chunk"]
+        # /requests shows both retirements; /healthz is 200 ok
+        with urllib.request.urlopen(srv.url + "/requests",
+                                    timeout=10) as resp:
+            rows = json.load(resp)["requests"]
+        by_id = {r["id"]: r for r in rows if r["state"] == "retired"}
+        assert by_id[r_bad.id]["retire_reason"] == "error"
+        assert by_id[r_ok.id]["retire_reason"] == "length"
+        with urllib.request.urlopen(srv.url + "/healthz",
+                                    timeout=10) as resp:
+            assert json.load(resp)["status"] == "ok"
+        # /metrics carries the serving SLO counters AND the engine's
+        # introspected program/device gauges (ISSUE 9 acceptance) —
+        # and the introspection refresh compiles nothing (the close
+        # test's compile-contract pin runs after this scrape)
+        with urllib.request.urlopen(srv.url + "/metrics",
+                                    timeout=10) as resp:
+            text = resp.read().decode()
+        assert "mxnet_serving_slo_ttft_attained_total" in text
+        assert "mxnet_program_serving_decode_flops" in text
+        assert "mxnet_program_serving_prefill_b4_flops" in text
+        assert "mxnet_device_live_array_bytes" in text
+    finally:
+        mx.telemetry.stop_server()
+    assert feng.idle and len(feng._free) == feng.slots
+
+
 def test_close_fails_pending_and_is_idempotent(lm, feng):
     """LAST test on the shared plain engine: close() fails every
     pending request with a typed EngineClosed (drained tokens stay

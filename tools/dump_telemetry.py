@@ -10,6 +10,15 @@ Usage::
     python tools/dump_telemetry.py /tmp/tr/mx_trace_1.json  # trace table
     python tools/dump_telemetry.py trace.json --names io. train.
     python tools/dump_telemetry.py BENCH_extra.json --serving
+    python tools/dump_telemetry.py --url http://host:9100   # live server
+    python tools/dump_telemetry.py --url http://host:9100 --watch 2
+
+``--url`` reads a LIVE process instead of a file: it fetches
+``/snapshot`` from the exposition server ``mx.telemetry.serve`` /
+``MXNET_TELEMETRY_PORT`` started (doc/observability.md) — every
+snapshot view (``--serving`` included) works unchanged. ``--watch N``
+re-reads and re-prints the source every N seconds until interrupted —
+a poor man's dashboard for a serving box.
 
 The file kind is auto-detected (a trace has a ``traceEvents`` list).
 Snapshot histograms print as one ``count/mean/p50/p99 [min..max]``
@@ -89,6 +98,23 @@ def print_serving(snap, out=None):
               % (s.get("shed", 0), s.get("deadline_missed", 0),
                  s.get("cancelled", 0), s.get("request_errors", 0),
                  s.get("watchdog_trips", 0), s.get("restores", 0)))
+    if s.get("slo_ttft_attained", 0) or s.get("slo_ttft_missed", 0) \
+            or s.get("slo_cadence_attained", 0) \
+            or s.get("slo_cadence_missed", 0):
+        out.write("slo:              ttft attained=%s missed=%s "
+                  "burn(1m/5m/1h)=%s/%s/%s\n"
+                  "                  cadence attained=%s missed=%s "
+                  "burn(1m/5m/1h)=%s/%s/%s\n"
+                  % (s.get("slo_ttft_attained", 0),
+                     s.get("slo_ttft_missed", 0),
+                     s.get("slo_ttft_burn_1m", 0),
+                     s.get("slo_ttft_burn_5m", 0),
+                     s.get("slo_ttft_burn_1h", 0),
+                     s.get("slo_cadence_attained", 0),
+                     s.get("slo_cadence_missed", 0),
+                     s.get("slo_cadence_burn_1m", 0),
+                     s.get("slo_cadence_burn_5m", 0),
+                     s.get("slo_cadence_burn_1h", 0)))
     out.write("compiles:         decode=%s prefill=%s copy=%s\n"
               % (s.get("compiles_decode", 0),
                  s.get("compiles_prefill", 0),
@@ -137,11 +163,54 @@ def print_trace(doc, name_filters=(), out=None):
             out.write("%-28s %8d\n" % (name, instants[name]))
 
 
+def _load(args):
+    """One document from the configured source: a file path, or a
+    live exposition server's ``/snapshot``."""
+    if args.url:
+        import urllib.request
+        url = args.url.rstrip("/")
+        last = url.rsplit("/", 1)[-1]
+        if last == "metrics":
+            # a copied Prometheus scrape URL: the text exposition is
+            # not JSON — read the JSON twin instead
+            url = url[:-len("metrics")] + "snapshot"
+        elif last != "snapshot":
+            url += "/snapshot"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.load(resp)
+    with open(args.file) as f:
+        return json.load(f)
+
+
+def _print(doc, args, out=None):
+    if isinstance(doc, dict) and isinstance(doc.get("traceEvents"),
+                                            list):
+        names = tuple(args.names)
+        if args.serving:
+            names += ("serving.",)
+        print_trace(doc, names, out)
+        return
+    # snapshot, possibly wrapped (BENCH_extra.json carries it under
+    # the "telemetry" key)
+    if isinstance(doc, dict) and "telemetry" in doc \
+            and isinstance(doc["telemetry"], dict):
+        doc = doc["telemetry"]
+    if args.serving:
+        print_serving(doc, out)
+        return
+    print_snapshot(doc, 0, out)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Pretty-print a telemetry snapshot / summarize a "
                     "Chrome trace file (doc/observability.md)")
-    ap.add_argument("file", help="snapshot JSON or trace_event JSON")
+    ap.add_argument("file", nargs="?",
+                    help="snapshot JSON or trace_event JSON")
+    ap.add_argument("--url", default=None,
+                    help="read a live /snapshot endpoint instead of a "
+                         "file (mx.telemetry.serve / "
+                         "MXNET_TELEMETRY_PORT server base URL)")
     ap.add_argument("--names", nargs="*", default=(),
                     help="only trace spans whose name starts with one "
                          "of these prefixes (e.g. --names io. train.)")
@@ -150,25 +219,33 @@ def main(argv=None):
                          "histograms tabulated next to the prefix-"
                          "cache/chunked-prefill stats (snapshots), or "
                          "serving.* spans only (traces)")
+    ap.add_argument("--watch", type=float, default=None, metavar="SEC",
+                    help="re-read and re-print the source every SEC "
+                         "seconds until interrupted")
+    ap.add_argument("--watch-count", type=int, default=None,
+                    help=argparse.SUPPRESS)  # test hook: stop after N
     args = ap.parse_args(argv)
-    with open(args.file) as f:
-        doc = json.load(f)
-    if isinstance(doc, dict) and isinstance(doc.get("traceEvents"),
-                                            list):
-        names = tuple(args.names)
-        if args.serving:
-            names += ("serving.",)
-        print_trace(doc, names)
+    if (args.file is None) == (args.url is None):
+        ap.error("pass exactly one source: a file, or --url")
+    if args.watch is None:
+        _print(_load(args), args)
         return
-    # snapshot, possibly wrapped (BENCH_extra.json carries it under
-    # the "telemetry" key)
-    if isinstance(doc, dict) and "telemetry" in doc \
-            and isinstance(doc["telemetry"], dict):
-        doc = doc["telemetry"]
-    if args.serving:
-        print_serving(doc)
-        return
-    print_snapshot(doc)
+    import time
+    n = 0
+    try:
+        while args.watch_count is None or n < args.watch_count:
+            if n:
+                time.sleep(args.watch)
+            sys.stdout.write("\x1b[2J\x1b[H" if sys.stdout.isatty()
+                             else "--- refresh %d ---\n" % n)
+            try:
+                _print(_load(args), args)
+            except Exception as e:   # noqa: BLE001 — keep watching
+                print("(source unavailable: %s)" % e)
+            sys.stdout.flush()
+            n += 1
+    except KeyboardInterrupt:
+        pass
 
 
 if __name__ == "__main__":

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Metric-catalog lint: the telemetry names in the code and the
+catalog in ``doc/observability.md`` must agree, both ways.
+
+The catalog rotted once before (PR 9 found rows the code no longer
+emitted and counters the doc never learned about), and a catalog that
+MIGHT be stale is worse than none — nobody trusts it. This tool makes
+drift a test failure:
+
+* **code → doc**: every dotted metric-name literal passed to
+  ``telemetry.counter(...)`` / ``gauge(...)`` / ``histogram(...)``
+  under ``mxnet_tpu/`` (found by AST walk, so commented-out code
+  doesn't count) must appear in a catalog table row.
+* **doc → code**: every name in a catalog table must still exist as
+  such a literal — documented-but-gone names fail too.
+
+Catalog tables are the markdown tables under ``doc/observability.md``
+whose header's first cell is ``Metric``; a row's first cell may list
+several backticked names (``\\`a\\` / \\`b\\```). Rows describing
+dynamically-named metric families use ``<...>`` or ``*`` placeholders
+(e.g. ``program.<name>.flops``) and are matched as prefix/suffix
+patterns against the registrations the code CAN'T express as literals
+(``tools/lint_metrics.py`` cannot see runtime f-strings; the pattern
+row documents the family instead).
+
+Usage::
+
+    python tools/lint_metrics.py            # lint the repo, exit 1 on drift
+    python tools/lint_metrics.py --list     # dump both name sets
+
+``tests/test_observability.py`` runs :func:`lint` as a tier-1 test.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+
+_REGISTRY_FNS = {"counter", "gauge", "histogram"}
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_<>*]+)+$")
+
+
+def code_metric_names(pkg_dir):
+    """Dotted metric-name literals passed to counter/gauge/histogram
+    anywhere under ``pkg_dir`` — {name: [file:line, ...]}."""
+    out = {}
+    for root, _dirs, files in os.walk(pkg_dir):
+        if "__pycache__" in root:
+            continue
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            try:
+                tree = ast.parse(open(path).read(), filename=path)
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _REGISTRY_FNS
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                name = node.args[0].value
+                if "." not in name:
+                    continue        # not a dotted registry name
+                out.setdefault(name, []).append(
+                    "%s:%d" % (os.path.relpath(path, pkg_dir),
+                               node.lineno))
+    return out
+
+
+def doc_metric_names(doc_path):
+    """Names from the catalog tables (header first cell ``Metric``):
+    (exact names, pattern names containing <...> or *)."""
+    exact, patterns = set(), set()
+    in_table = False
+    for line in open(doc_path):
+        line = line.rstrip()
+        if not line.startswith("|"):
+            in_table = False
+            continue
+        cells = [c.strip() for c in line.strip("|").split("|")]
+        if not cells:
+            continue
+        if cells[0] == "Metric":
+            in_table = True
+            continue
+        if not in_table or set(cells[0]) <= {"-", " ", ":"}:
+            continue
+        for name in re.findall(r"`([^`]+)`", cells[0]):
+            if not _NAME_RE.match(name):
+                continue
+            if "<" in name or "*" in name:
+                patterns.add(name)
+            else:
+                exact.add(name)
+    return exact, patterns
+
+
+def _pattern_re(pat):
+    parts = re.split(r"(<[^>]*>|\*)", pat)
+    rx = "".join(".+" if p.startswith("<") or p == "*"
+                 else re.escape(p) for p in parts if p)
+    return re.compile("^" + rx + "$")
+
+
+def lint(repo_root):
+    """Returns ``(undocumented, stale)``: code names missing from the
+    catalog, and catalog names (patterns included) matching nothing in
+    the code *or* the known dynamic registration sites."""
+    code = code_metric_names(os.path.join(repo_root, "mxnet_tpu"))
+    exact, patterns = doc_metric_names(
+        os.path.join(repo_root, "doc", "observability.md"))
+    pattern_res = [(p, _pattern_re(p)) for p in sorted(patterns)]
+
+    undocumented = {}
+    for name, sites in sorted(code.items()):
+        if name in exact:
+            continue
+        if any(rx.match(name) for _p, rx in pattern_res):
+            continue
+        undocumented[name] = sites
+
+    stale = sorted(exact - set(code))
+    # pattern rows document dynamically-named families — the literals
+    # the AST can't see. The code side of those families is the
+    # "program.%s.%s" / "device.*" registration in profiler.py; treat
+    # a pattern as stale only when NO code literal or known dynamic
+    # prefix matches it.
+    dynamic_prefixes = ("program.",)
+    for pat, rx in pattern_res:
+        if any(rx.match(name) for name in code):
+            continue
+        if any(pat.startswith(pref) for pref in dynamic_prefixes):
+            continue
+        stale.append(pat)
+    return undocumented, stale
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Cross-check mxnet_tpu telemetry metric names "
+                    "against the doc/observability.md catalog")
+    ap.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="repo root (default: this tool's parent)")
+    ap.add_argument("--list", action="store_true",
+                    help="print both name sets and exit")
+    args = ap.parse_args(argv)
+    if args.list:
+        code = code_metric_names(os.path.join(args.root, "mxnet_tpu"))
+        exact, patterns = doc_metric_names(
+            os.path.join(args.root, "doc", "observability.md"))
+        print("code (%d):" % len(code))
+        for n in sorted(code):
+            print("  %s  (%s)" % (n, code[n][0]))
+        print("doc (%d + %d patterns):" % (len(exact), len(patterns)))
+        for n in sorted(exact | patterns):
+            print("  %s" % n)
+        return 0
+    undocumented, stale = lint(args.root)
+    for name, sites in undocumented.items():
+        print("UNDOCUMENTED: %s  (registered at %s) — add a catalog "
+              "row to doc/observability.md" % (name, ", ".join(sites)))
+    for name in stale:
+        print("STALE: %s documented in doc/observability.md but no "
+              "longer registered anywhere under mxnet_tpu/" % name)
+    if undocumented or stale:
+        print("metric catalog drift: %d undocumented, %d stale"
+              % (len(undocumented), len(stale)))
+        return 1
+    print("metric catalog clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
