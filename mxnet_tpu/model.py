@@ -1106,6 +1106,7 @@ class FeedForward(BASE_ESTIMATOR):
                           max_queue=256, steps_per_round=1,
                           prefix_cache_mb=None, prefill_chunk=None,
                           overload=None, round_timeout_ms=None,
+                          spec_k=None, draft=None, draft_decoder=None,
                           **decoder_kwargs):
         """Trained estimator → continuous-batching inference engine
         (``mxnet_tpu.serving.InferenceEngine``, doc/serving.md): the
@@ -1116,7 +1117,9 @@ class FeedForward(BASE_ESTIMATOR):
         underlying ``Decoder`` (``compute_dtype``, ``cache_dtype``,
         ...); ``overload``/``round_timeout_ms`` are the robustness
         knobs (load shedding policy, round watchdog — doc/serving.md
-        "Serving under hostile traffic")."""
+        "Serving under hostile traffic"); ``spec_k``/``draft``/
+        ``draft_decoder`` arm speculative decoding (doc/serving.md
+        "Speculative decoding")."""
         from .parallel.decode import Decoder
         from .serving import InferenceEngine
 
@@ -1143,7 +1146,9 @@ class FeedForward(BASE_ESTIMATOR):
                                prefix_cache_mb=prefix_cache_mb,
                                prefill_chunk=prefill_chunk,
                                overload=overload,
-                               round_timeout_ms=round_timeout_ms)
+                               round_timeout_ms=round_timeout_ms,
+                               spec_k=spec_k, draft=draft,
+                               draft_decoder=draft_decoder)
 
     @staticmethod
     def load(prefix, epoch, ctx=None, **kwargs):

@@ -705,6 +705,129 @@ class Decoder:
 
         return jax.tree_util.tree_map(write, caches, rows)
 
+    def verify_step_slots(self, params, aux, caches, state, drafts,
+                          dlen):
+        """Speculative draft-and-verify decode step over all S slots
+        (the serving engine's verify program — doc/serving.md
+        "Speculative decoding").
+
+        ``state`` is the engine's per-slot state tuple ``(pos, tok,
+        live, temp, keys, eos, last)``; ``drafts`` [S, K] int32 are
+        proposed continuations of each slot's head token ``tok``;
+        ``dlen`` [S] int32 how many of them are real (0 = no draft —
+        the slot rides along and emits exactly its plain-decode
+        token). Returns ``(caches, state2, out)`` with ``out``
+        [K+1, S]: row i is the i-th token emitted this step per slot,
+        -1 where none.
+
+        One chunked run of the target scores all K drafted positions
+        (the multi-token cache append): the chunk ``[tok, d_1..d_K]``
+        is written at positions ``[pos, pos+K]`` and each position's
+        logits give the target's OWN next-token choice there — greedy
+        argmax, or for ``temp > 0`` the categorical draw keyed
+        ``fold_in(key, position)``, the exact (seed, position)
+        identity plain decode uses. Token i is emitted iff every
+        earlier emitted token matched its draft and was not terminal;
+        the first mismatch emits the target's corrected token and
+        stops. Every emitted token is therefore the target's own
+        choice at its position — byte-identical to plain decode by
+        construction, drafts only change how many arrive per dispatch.
+
+        Rejected-position cache rows: the chunk write covers
+        ``[pos, pos+K]`` but only ``[pos, pos+e-1]`` hold real tokens
+        afterwards (e = tokens emitted). The junk tail is provably
+        harmless — it sits at positions STRICTLY ABOVE the slot's new
+        head, every read masks keys to ``key_pos <= query_pos``, and
+        every later step's write covers its read range first — the
+        same overwrite-or-masked discipline as right-padded bucketed
+        prefill and recycled-slot reuse. NOT ring-safe: a windowed
+        ring wraps the junk onto live rows, so the engine refuses
+        speculation for windowed decoders (prefix-cache precedent)."""
+        pos, tok, live, temp, keys, eos, last = state
+        k = drafts.shape[1]
+        chunk = jnp.concatenate(
+            [tok[:, None], drafts.astype(jnp.int32)], axis=1)
+        logits, caches = self._run_slots(params, aux, caches, pos,
+                                         chunk)            # [S,K+1,V]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def with_sampling(_):
+            t = jnp.where(temp > 0.0, temp, jnp.float32(1.0))
+
+            def draw(key, p0, rows):
+                def one(i, row):
+                    return jax.random.categorical(
+                        jax.random.fold_in(key, p0 + i + 1), row)
+
+                return jax.vmap(one)(jnp.arange(k + 1, dtype=jnp.int32),
+                                     rows)
+
+            sampled = jax.vmap(draw)(
+                keys, pos,
+                logits.astype(jnp.float32) / t[:, None, None]
+            ).astype(jnp.int32)
+            return jnp.where(temp[:, None] > 0.0, sampled, greedy)
+
+        # all-greedy rounds skip the per-position fold_in+categorical
+        # (same lax.cond reasoning as the engine's plain decode step)
+        nxt = lax.cond(jnp.any(temp > 0.0), with_sampling,
+                       lambda _: greedy, None)              # [S, K+1]
+
+        emit = live                       # token 0 = plain-step output
+        outs = []
+        e = jnp.zeros_like(pos)
+        tok2 = tok
+        done_any = jnp.zeros_like(live)
+        for i in range(k + 1):
+            tki = nxt[:, i]
+            done_i = (tki == eos) | (pos + i + 1 >= last)
+            outs.append(jnp.where(emit, tki, jnp.int32(-1)))
+            e = e + emit.astype(jnp.int32)
+            tok2 = jnp.where(emit, tki, tok2)
+            done_any = done_any | (emit & done_i)
+            if i < k:
+                matched = (i < dlen) & (tki == drafts[:, i])
+                emit = emit & matched & ~done_i
+        state2 = (pos + e, tok2, live & ~done_any, temp, keys, eos,
+                  last)
+        return caches, state2, jnp.stack(outs)              # [K+1, S]
+
+    def draft_propose_slots(self, params, aux, caches, pos, catchup,
+                            clen, k):
+        """Greedy k-token proposal from a DRAFT model sharing the
+        slot-paged layout (the serving engine's draft program —
+        ``InferenceEngine(draft="model")``).
+
+        Two phases in one program: (1) catch up — ``catchup`` [S, W]
+        holds each slot's real tokens the draft cache has not seen yet
+        (``clen`` [S] in [1, W] of them valid; pad rows write
+        junk-above-head, healed by the next catch-up's overwrite, the
+        same discipline as ``verify_step_slots``), written at
+        positions ``[pos, pos+clen)``; (2) propose — from the last
+        valid position's logits, scan k-1 greedy single-token steps.
+        Returns ``(caches, drafts [S, k])``. Greedy always: for
+        sampled requests the target's verify still gates acceptance
+        against ITS sample, the draft just matches less often."""
+        logits, caches = self._run_slots(params, aux, caches, pos,
+                                         catchup)           # [S, W, V]
+        idx = jnp.clip(clen - 1, 0, catchup.shape[1] - 1)
+        lastlog = jnp.take_along_axis(
+            logits, idx[:, None, None], axis=1)[:, 0]       # [S, V]
+        d1 = jnp.argmax(lastlog, axis=-1).astype(jnp.int32)
+        pos2 = pos + clen
+
+        def body(carry, _):
+            caches, p, t = carry
+            lg, caches = self._run_slots(params, aux, caches, p,
+                                         t[:, None])
+            nx = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+            return (caches, p + 1, nx), nx
+
+        (caches, _, _), rest = lax.scan(body, (caches, pos2, d1), None,
+                                        length=k - 1)       # [k-1, S]
+        drafts = jnp.concatenate([d1[None], rest], axis=0)
+        return caches, drafts.T                             # [S, k]
+
     @staticmethod
     def buffers_ready(tree):
         """True when every dispatched device buffer in ``tree`` has

@@ -6,12 +6,16 @@ per exact ``(batch, prompt_len, num_steps)`` shape and stalls a whole
 batch on its slowest sequence; the :class:`InferenceEngine` here serves
 an arbitrary request mix — mixed prompt lengths, per-request
 ``max_tokens``/``eos_id``/temperature, requests arriving mid-stream —
-from three compiled program families (a bucketed prefill that also
-serves chunked prefill, a fused all-slots decode step, and a bucketed
-prefix-cache row copy) with iteration-level scheduling between device
-steps (Orca, OSDI '22; slot-structured caches after vLLM's
-PagedAttention, SOSP '23; prefix reuse after RadixAttention and
-chunk-interleaved prefill after Sarathi-Serve).
+from a few compiled program families (a bucketed prefill that also
+serves chunked prefill, a fused all-slots decode step, a bucketed
+prefix-cache row copy, and — with speculation on — ONE draft-and-
+verify step emitting up to ``spec_k + 1`` tokens per weights read)
+with iteration-level scheduling between device steps (Orca, OSDI '22;
+slot-structured caches after vLLM's PagedAttention, SOSP '23; prefix
+reuse after RadixAttention, chunk-interleaved prefill after
+Sarathi-Serve, and draft-and-verify decoding after Leviathan et al.
+2023 with prompt-lookup/n-gram drafting per the PLD/lookahead line —
+:class:`NgramDrafter`).
 
 Robustness layer (doc/serving.md "Serving under hostile traffic"):
 per-request deadlines and :meth:`InferenceEngine.cancel`, overload
@@ -26,7 +30,8 @@ from .engine import (InferenceEngine, Request, EngineOverloaded,
                      EngineClosed, EngineStuck)
 from .flight import FlightRecorder
 from .prefix import PrefixCache
+from .spec import NgramDrafter
 
 __all__ = ["InferenceEngine", "Request", "PrefixCache",
-           "FlightRecorder",
+           "FlightRecorder", "NgramDrafter",
            "EngineOverloaded", "EngineClosed", "EngineStuck"]

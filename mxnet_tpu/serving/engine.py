@@ -10,7 +10,9 @@ Architecture (doc/serving.md has the full story):
   ``key_pos <= pos`` causal mask until overwritten; window rings get
   their position buffers reset at admission).
 
-* THREE compiled program families serve any request mix, ever:
+* FOUR compiled program families serve any request mix, ever (the
+  fourth only with speculative decoding on; ``draft="model"`` adds
+  the draft LM's proposal + prefill programs on top):
 
   - **bucketed prefill** (one program per power-of-2 length bucket):
     a prompt CHUNK padded to its bucket is pushed through the derived
@@ -35,6 +37,14 @@ Architecture (doc/serving.md has the full story):
     RadixAttention-style — Zheng et al. 2023), slot→pool when a
     freshly prefilled prompt is retained. Source/destination slot and
     direction are traced operands.
+  - **speculative verify step** (exactly one program, ``draft`` on):
+    the target model scores every slot's ``spec_k`` drafted tokens in
+    one chunked dispatch and emits the accepted prefix plus one
+    corrected token per slot — 1..``spec_k + 1`` tokens per weights
+    read, byte-identical to plain decode by construction (drafts and
+    their lengths are traced operands; doc/serving.md "Speculative
+    decoding"; Leviathan et al. 2023, prompt-lookup drafting per the
+    PLD/lookahead line).
 
 * a host-side **prefix cache** (``serving/prefix.py``): a refcounted-
   LRU trie over token ids maps a new prompt to the longest prefix a
@@ -58,7 +68,7 @@ assignment, co-resident requests, or bucket padding; sampled outputs
 depend only on ``(seed, position)`` — not on scheduling.
 
 Robustness (doc/serving.md "Serving under hostile traffic", all
-host-side — the three compiled program families above are the ONLY
+host-side — the compiled program families above are the ONLY
 device programs, frozen): per-request deadlines
 (``deadline_ms``/``ttft_deadline_ms``) and :meth:`cancel` retire work
 at round boundaries through the same dead-slot freeze + slot-recycle
@@ -77,6 +87,7 @@ import collections
 import math
 import os
 import time
+import warnings
 import weakref
 
 import numpy as np
@@ -91,6 +102,7 @@ from ..io import StagedStream
 from ..parallel.decode import Decoder
 from .flight import FlightRecorder
 from .prefix import PrefixCache
+from .spec import NgramDrafter
 
 __all__ = ["InferenceEngine", "Request", "EngineOverloaded",
            "EngineClosed", "EngineStuck"]
@@ -167,12 +179,27 @@ _TM_PREFIX_INSERT_SKIPPED = tele.counter(
 _TM_CHUNKS = tele.histogram(
     "serving.prefill_chunks_per_request",
     buckets=(1, 2, 4, 8, 16, 32, 64))
+# speculative decoding (doc/serving.md "Speculative decoding"): all
+# host-side accounting on values the drain already sees — drafted vs
+# accepted tokens, the per-slot accepted-length shape, drafter source
+# mix, and rounds that fell back to the plain decode program
+_TM_SPEC_ROUNDS = tele.counter("serving.spec_rounds")
+_TM_SPEC_FALLBACK = tele.counter("serving.spec_fallback_rounds")
+_TM_SPEC_DRAFTED = tele.counter("serving.spec_drafted_tokens")
+_TM_SPEC_ACCEPTED = tele.counter("serving.spec_accepted_tokens")
+_TM_SPEC_ACCEPT_LEN = tele.histogram(
+    "serving.spec_accepted_per_step",
+    buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
+_TM_SPEC_NGRAM = tele.counter("serving.spec_drafts_ngram")
+_TM_SPEC_MODEL = tele.counter("serving.spec_drafts_model")
 # compile_counts re-exported as telemetry: the in-engine log stays the
 # tested contract; these make recompiles visible in ONE snapshot next
 # to everything else
 _TM_COMPILE_DECODE = tele.counter("serving.compiles_decode")
 _TM_COMPILE_PREFILL = tele.counter("serving.compiles_prefill")
 _TM_COMPILE_COPY = tele.counter("serving.compiles_copy")
+_TM_COMPILE_VERIFY = tele.counter("serving.compiles_verify")
+_TM_COMPILE_DRAFT = tele.counter("serving.compiles_draft")
 # robustness counters (doc/observability.md): every abnormal retirement
 # path is visible in the same snapshot as the latencies it protects
 _TM_SHED = tele.counter("serving.shed")
@@ -435,6 +462,40 @@ class InferenceEngine:
         changes scheduling (that is ROADMAP item 5's job). Mutable
         attributes. ``slo_target`` (default 0.99) is the attainment
         objective the burn rates are normalized against.
+    spec_k : int, optional
+        Draft length for speculative decoding (default: the
+        ``MXNET_SERVING_SPEC_K`` env var, else 4; only meaningful with
+        ``draft != "off"``). Each verify round the target model scores
+        up to ``spec_k`` drafted tokens per slot in ONE chunked
+        dispatch and emits the accepted prefix plus one corrected
+        token — up to ``spec_k + 1`` tokens per weights read instead
+        of 1. Raising it helps only while drafts keep getting
+        accepted; rejected positions are wasted chunk width.
+    draft : {"off", "ngram", "model"}, optional
+        Drafting source (default: the ``MXNET_SERVING_DRAFT`` env var,
+        else ``"off"``). ``"ngram"`` is the host-side prompt-lookup
+        drafter (:class:`~mxnet_tpu.serving.NgramDrafter` — no second
+        model: propose the continuation that followed the current
+        suffix earlier in the request's own prompt + output).
+        ``"model"`` drafts with a small draft LM (pass
+        ``draft_decoder``) sharing the slot-paged layout — one greedy
+        k-token proposal program plus its own per-bucket prefill.
+        Greedy outputs are byte-identical to ``draft="off"`` either
+        way (the target verifies every token in-program); sampled
+        requests accept a draft token only when it matches the
+        target's own ``fold_in(seed, position)`` draw, so the sampled
+        identity is preserved too (acceptance just gets rarer at hot
+        temperatures). Windowed-ring decoders refuse speculation
+        loudly (a ``UserWarning``; the chunk write would wrap rejected
+        drafts onto live ring rows — same bypass precedent as the
+        prefix cache) and serve with ``draft="off"``.
+    draft_decoder : Decoder, optional
+        The draft model for ``draft="model"`` (e.g. the 124M config
+        drafting for a 350M target, loaded from its own checkpoint —
+        ``from_checkpoint(draft_prefix=..., draft_epoch=...)`` builds
+        it for you). Must share ``max_len``, be non-windowed, and use
+        ``cache_block=None``; its vocabulary must cover the target's
+        token ids.
     flight_recorder : int, optional
         How many RETIRED requests keep their full flight-recorder
         timeline (submit → staged → admitted → prefix hit/copy →
@@ -452,7 +513,8 @@ class InferenceEngine:
                  prefill_chunk=None, overload=None,
                  round_timeout_ms=None, slo_ttft_ms=None,
                  slo_cadence_ms=None, slo_target=0.99,
-                 flight_recorder=None):
+                 flight_recorder=None, spec_k=None, draft=None,
+                 draft_decoder=None):
         if not isinstance(decoder, Decoder):
             raise MXNetError("InferenceEngine needs a Decoder, got %r"
                              % type(decoder).__name__)
@@ -580,6 +642,73 @@ class InferenceEngine:
             self._pool = None
             self._prefix = None
 
+        # speculative decoding (doc/serving.md "Speculative decoding")
+        if draft is None:
+            draft = os.environ.get("MXNET_SERVING_DRAFT") or "off"
+        if draft not in ("off", "ngram", "model"):
+            raise MXNetError(
+                "InferenceEngine: draft must be 'off', 'ngram' or "
+                "'model', got %r (MXNET_SERVING_DRAFT sets the "
+                "default)" % (draft,))
+        if spec_k is None:
+            spec_k = int(os.environ.get("MXNET_SERVING_SPEC_K", "")
+                         or 4)
+        self.spec_k = int(spec_k)
+        if draft != "off":
+            if self.spec_k < 1:
+                raise MXNetError(
+                    "InferenceEngine: spec_k must be >= 1 when draft "
+                    "is on, got %d (MXNET_SERVING_SPEC_K sets the "
+                    "default)" % self.spec_k)
+            if self.spec_k > self.max_len - 3:
+                raise MXNetError(
+                    "InferenceEngine: spec_k=%d leaves no room in the "
+                    "max_len=%d cache for a verify chunk (need "
+                    "spec_k <= max_len - 3)"
+                    % (self.spec_k, self.max_len))
+            if self._windowed:
+                # refuse LOUDLY, then serve unspeculated: the verify
+                # chunk write would wrap rejected drafts onto live
+                # ring rows (the prefix cache bypasses for the same
+                # absolute-position reason)
+                warnings.warn(
+                    "InferenceEngine: windowed-ring decoders do not "
+                    "compose with speculative decoding (the verify "
+                    "chunk would wrap rejected drafts onto live ring "
+                    "rows) — serving with draft='off'", UserWarning,
+                    stacklevel=2)
+                draft = "off"
+        self.spec_draft = draft
+        self._spec = draft != "off"
+        self._drafters = {}           # request id -> NgramDrafter
+        self._draft_dec = None
+        if self.spec_draft == "model":
+            if not isinstance(draft_decoder, Decoder):
+                raise MXNetError(
+                    "InferenceEngine: draft='model' needs a "
+                    "draft_decoder (a Decoder over the small draft "
+                    "LM), got %r" % type(draft_decoder).__name__)
+            if draft_decoder.max_len != self.max_len:
+                raise MXNetError(
+                    "InferenceEngine: draft_decoder.max_len=%d must "
+                    "equal the target's max_len=%d (the draft cache "
+                    "mirrors the slot clocks)"
+                    % (draft_decoder.max_len, self.max_len))
+            if draft_decoder._cache_block is not None:
+                raise MXNetError(
+                    "InferenceEngine: draft_decoder must be built "
+                    "with cache_block=None (slot addressing)")
+            if any(draft_decoder._node_window(n)
+                   for n in draft_decoder._mha):
+                raise MXNetError(
+                    "InferenceEngine: windowed draft models are not "
+                    "supported (the catch-up chunk would wrap junk "
+                    "onto live ring rows)")
+            self._draft_dec = draft_decoder
+            self._draft_caches = draft_decoder.init_cache(S)
+            self._draft_pos = [0] * S     # next draft-cache position
+            self._draft_pending = [[] for _ in range(S)]
+
         # host-side scheduler state
         self._pending = collections.deque()
         self._stager = StagedStream(_PendingSource(self._pending),
@@ -611,9 +740,11 @@ class InferenceEngine:
                       "prefix_hit_tokens": 0, "prefill_chunks": 0,
                       "prefix_copies": 0, "shed": 0, "deadline_missed": 0,
                       "cancelled": 0, "errors": 0, "watchdog_trips": 0,
-                      "restores": 0}
+                      "restores": 0, "spec_rounds": 0,
+                      "spec_fallback_rounds": 0, "spec_drafted": 0,
+                      "spec_accepted": 0}
 
-        # the three compiled program families; the log records one tag
+        # the compiled program families; the log records one tag
         # per TRACE (python side effects run at trace time only), so it
         # IS the compile count — tests pin the contract against it
         self._compile_log = []
@@ -624,6 +755,19 @@ class InferenceEngine:
                                 donate_argnums=self._donate)
         self._prefill_fns = {}
         self._copy_fns = {}
+        # speculative-decoding programs: ONE verify program (the whole
+        # contract extension) plus, for draft="model", one draft
+        # proposal program and a per-bucket draft prefill family
+        self._verify_fn = None
+        self._draft_fn = None
+        self._draft_prefill_fns = {}
+        if self._spec:
+            self._verify_fn = jax.jit(self._make_verify(),
+                                      donate_argnums=self._donate)
+            if self.spec_draft == "model":
+                self._draft_fn = jax.jit(
+                    self._make_draft(),
+                    donate_argnums=(2,) if on_chip else ())
         # observability plane: watchdog/liveness state read by
         # health() and the exposition server's /healthz, plus the
         # once-per-program introspection registration guard
@@ -641,15 +785,29 @@ class InferenceEngine:
                         overload=None, round_timeout_ms=None,
                         slo_ttft_ms=None, slo_cadence_ms=None,
                         slo_target=0.99, flight_recorder=None,
+                        spec_k=None, draft=None, draft_decoder=None,
+                        draft_prefix=None, draft_epoch=None,
                         **decoder_kwargs):
         """Checkpoint → serving engine in one call
         (``prefix-symbol.json`` + ``prefix-NNNN.params``, the reference
         format): builds the :class:`Decoder` via
         ``Decoder.from_checkpoint`` and wraps it. ``decoder_kwargs``
-        reach the decoder (``compute_dtype``, ``cache_dtype``, ...)."""
+        reach the decoder (``compute_dtype``, ``cache_dtype``, ...).
+        ``draft_prefix``/``draft_epoch`` load a SECOND (small)
+        checkpoint as the speculative draft model — implies
+        ``draft="model"`` unless overridden; the draft decoder
+        inherits ``compute_dtype`` but none of the cache-flavor
+        kwargs."""
         decoder_kwargs.setdefault("cache_block", None)
         dec = Decoder.from_checkpoint(prefix, epoch, max_len,
                                       **decoder_kwargs)
+        if draft_prefix is not None and draft_decoder is None:
+            draft_decoder = Decoder.from_checkpoint(
+                draft_prefix, 0 if draft_epoch is None else draft_epoch,
+                max_len, cache_block=None,
+                compute_dtype=decoder_kwargs.get("compute_dtype"))
+            if draft is None:
+                draft = "model"
         return cls(dec, slots=slots, prefill_buckets=prefill_buckets,
                    max_queue=max_queue, stage_depth=stage_depth,
                    drain_depth=drain_depth,
@@ -659,7 +817,8 @@ class InferenceEngine:
                    round_timeout_ms=round_timeout_ms,
                    slo_ttft_ms=slo_ttft_ms,
                    slo_cadence_ms=slo_cadence_ms, slo_target=slo_target,
-                   flight_recorder=flight_recorder)
+                   flight_recorder=flight_recorder, spec_k=spec_k,
+                   draft=draft, draft_decoder=draft_decoder)
 
     # -- compiled programs ----------------------------------------------
     def _make_step(self):
@@ -721,6 +880,72 @@ class InferenceEngine:
             return caches, state, outs          # outs [k, S]
 
         return step
+
+    def _make_verify(self):
+        """The ONE compiled verify program (doc/serving.md
+        "Speculative decoding"): per round, the target model scores
+        every slot's ``spec_k`` drafted tokens in one chunked run
+        (``Decoder.verify_step_slots`` — the multi-token cache append
+        plus in-program accepted-prefix computation) and emits the
+        accepted prefix + one corrected token per slot. Slots with
+        ``dlen == 0`` ride along and emit exactly their plain-decode
+        token; rounds with NO drafts at all dispatch the plain decode
+        program instead (the fallback path, counted)."""
+        dec = self._dec
+
+        def verify(params, aux, caches, state, drafts, dlen):
+            if not profiler.collecting():
+                self._compile_log.append("verify")
+                _TM_COMPILE_VERIFY.inc()
+            return dec.verify_step_slots(params, aux, caches, state,
+                                         drafts, dlen)
+
+        return verify
+
+    def _make_draft(self):
+        """The draft proposal program (``draft="model"``): catch the
+        draft cache up on the tokens the target emitted since last
+        round, then greedily propose ``spec_k`` tokens per slot
+        (``Decoder.draft_propose_slots``)."""
+        ddec = self._draft_dec
+        k = self.spec_k
+
+        def draft(params, aux, caches, pos, catchup, clen):
+            if not profiler.collecting():
+                self._compile_log.append("draft")
+                _TM_COMPILE_DRAFT.inc()
+            return ddec.draft_propose_slots(params, aux, caches, pos,
+                                            catchup, clen, k)
+
+        return draft
+
+    def _draft_prefill_fn(self, bucket):
+        """Per-bucket draft-cache prefill (``draft="model"``): write
+        the prompt's K/V into the DRAFT model's slot cache — no
+        sampling, no state vectors, just the cache build the proposal
+        program decodes from. The draft model prefills the WHOLE
+        prompt even on a prefix-cache hit (the pool holds target K/V
+        only; the draft model is small enough that re-prefilling
+        beats maintaining a second pool)."""
+        if bucket not in self._draft_prefill_fns:
+            ddec = self._draft_dec
+
+            def dprefill(params, aux, caches, slot, tokens, start,
+                         true_len):
+                if not profiler.collecting():
+                    self._compile_log.append(("draft_prefill", bucket))
+                    _TM_COMPILE_DRAFT.inc()
+                sub = ddec.slot_slice(caches, slot)
+                sub = ddec.clear_window_positions(
+                    sub, only_if=start == jnp.int32(0))
+                _, sub = ddec._run(params, aux, sub, start, tokens,
+                                   valid_len=start + true_len)
+                return ddec.slot_update(caches, slot, sub)
+
+            self._draft_prefill_fns[bucket] = jax.jit(
+                dprefill,
+                donate_argnums=(2,) if self._donate else ())
+        return self._draft_prefill_fns[bucket]
 
     def _prefill_fn(self, bucket):
         if bucket not in self._prefill_fns:
@@ -842,16 +1067,24 @@ class InferenceEngine:
 
     @property
     def compile_counts(self):
-        """{'decode': n, 'prefill': {bucket: n}, 'copy': {bucket: n}}
-        — the compile-count contract: after any workload, decode == 1,
-        each USED prefill bucket == 1 and each USED copy bucket == 1
-        (chunked prefill reuses the prefill buckets — chunk start is a
-        traced operand, so chunking adds NO programs; one copy program
-        covers both pool→slot and slot→pool). doc/serving.md."""
-        out = {"decode": 0, "prefill": {}, "copy": {}}
+        """{'decode': n, 'verify': n, 'prefill': {bucket: n},
+        'copy': {bucket: n}} — the compile-count contract: after any
+        workload, decode == 1, verify <= 1 (0 with speculation off or
+        never fired), each USED prefill bucket == 1 and each USED copy
+        bucket == 1 (chunked prefill reuses the prefill buckets —
+        chunk start is a traced operand, so chunking adds NO programs;
+        one copy program covers both pool→slot and slot→pool; the ONE
+        verify program serves every draft mix — drafts and their
+        lengths are traced operands). Engines with ``draft="model"``
+        additionally report ``'draft'`` (<= 1) and ``'draft_prefill'``
+        ({bucket: 1}). doc/serving.md."""
+        out = {"decode": 0, "verify": 0, "prefill": {}, "copy": {}}
+        if self.spec_draft == "model":
+            out["draft"] = 0
+            out["draft_prefill"] = {}
         for tag in self._compile_log:
-            if tag == "decode":
-                out["decode"] += 1
+            if isinstance(tag, str):
+                out[tag] += 1
             else:
                 fam = out[tag[0]]
                 fam[tag[1]] = fam.get(tag[1], 0) + 1
@@ -1097,6 +1330,7 @@ class InferenceEngine:
         req.error = error
         self._active.pop(req.id, None)
         self._watched.discard(req.id)
+        self._drafters.pop(req.id, None)
         if self.flight.enabled:
             extra = {"tokens": len(req.tokens)}
             if error is not None:
@@ -1299,6 +1533,13 @@ class InferenceEngine:
                   "insert": self._prefix is not None and depth < p
                   and p <= self.prefill_buckets[-1]}
             try:
+                if self.spec_draft == "ngram":
+                    # drafter context = prompt + emitted so far (the
+                    # resumed suffix rides in req.seq); drained tokens
+                    # append in _push_token
+                    self._drafters[req.id] = NgramDrafter(req.seq)
+                elif self.spec_draft == "model":
+                    self._draft_prefill_all(req, slot)
                 if self._prefix is not None:
                     if hit > 0:
                         self._prefix.acquire(entry)
@@ -1338,6 +1579,29 @@ class InferenceEngine:
             "InferenceEngine: request %r poisoned during admission/"
             "prefill (%s: %s) — retired alone, engine keeps serving"
             % (req.id, type(exc).__name__, exc)))
+
+    def _draft_prefill_all(self, req, slot):
+        """Build the DRAFT model's cache for a freshly admitted slot:
+        the whole ``req.seq`` in bucket-capped pieces, dispatched at
+        admission (the draft model is a fraction of the target's
+        FLOPs, so it is not chunk-budgeted like target prefill; it
+        also ignores prefix hits — the pool holds target K/V only).
+        Resets the slot's draft clock and pending-token queue."""
+        p = len(req.seq)
+        start = 0
+        top = self.prefill_buckets[-1]
+        while start < p:
+            piece = min(p - start, top)
+            bucket = self._bucket_for(piece)
+            chunk = np.zeros((1, bucket), np.int32)
+            chunk[0, :piece] = req.seq[start:start + piece]
+            self._draft_caches = self._draft_prefill_fn(bucket)(
+                self._draft_dec._params, self._draft_dec._aux,
+                self._draft_caches, np.int32(slot), chunk,
+                np.int32(start), np.int32(piece))
+            start += piece
+        self._draft_pos[slot] = p
+        self._draft_pending[slot] = []
 
     def _suffix_cost(self, n):
         """Prefill-work proxy for an ``n``-token suffix: total PADDED
@@ -1466,6 +1730,14 @@ class InferenceEngine:
     def _push_token(self, req, slot, t, now):
         assert t >= 0, "drained a token from a device-dead slot"
         req.tokens.append(int(t))
+        if self._spec:
+            dr = self._drafters.get(req.id)
+            if dr is not None:
+                dr.append(t)        # n-gram context stays current
+            if self._draft_dec is not None:
+                # the draft cache catches up on this token before the
+                # next proposal (_model_drafts)
+                self._draft_pending[slot].append(int(t))
         if req.t_first is None:
             req.t_first = now
             ttft_ms = (now - req.t_submit) * 1e3
@@ -1486,6 +1758,7 @@ class InferenceEngine:
             req.retire_reason = "eos" if hit_eos else "length"
             (_TM_RETIRED_EOS if hit_eos else _TM_RETIRED_LENGTH).inc()
             _TM_COMPLETED.inc()
+            self._drafters.pop(req.id, None)
             # cadence = wall time per decode interval THIS engine ran:
             # a resumed request's pre-crash tokens arrived before
             # t_first and must not inflate the denominator
@@ -1546,6 +1819,31 @@ class InferenceEngine:
                                      # slot was already released
             self._mirror[slot] = req
             self._push_token(req, slot, int(np.asarray(t0)), now)
+        elif entry[0] == "verify":
+            # [<=K+1, S] variable-width drain: row i is the i-th token
+            # a slot emitted this verify round, -1 where its accepted
+            # prefix ended (a slot that had no draft emits exactly
+            # row 0 — its plain-decode token). Accepted drafts =
+            # emitted - 1, observed per drafted slot.
+            rows, dlen = np.asarray(entry[1]), entry[2]
+            emitted = np.zeros((self.slots,), np.int64)
+            for row in rows:
+                for s in range(self.slots):
+                    req = self._mirror[s]
+                    t = int(row[s])
+                    if req is None or t < 0:
+                        continue
+                    emitted[s] += 1
+                    self._push_token(req, s, t, now)
+            acc = 0
+            for s in range(self.slots):
+                if dlen[s] > 0 and emitted[s] > 0:
+                    a = int(emitted[s]) - 1
+                    acc += a
+                    _TM_SPEC_ACCEPT_LEN.observe(a)
+            if acc:
+                self.stats["spec_accepted"] += acc
+                _TM_SPEC_ACCEPTED.inc(acc)
         else:
             rounds = np.asarray(entry[1])        # [steps_per_round, S]
             for row in rounds:
@@ -1553,6 +1851,150 @@ class InferenceEngine:
                     req = self._mirror[s]
                     if req is not None:
                         self._push_token(req, s, int(row[s]), now)
+
+    def _spec_round(self, busy):
+        """Try to dispatch ONE verify round (doc/serving.md
+        "Speculative decoding"): collect up to ``spec_k`` draft tokens
+        per decodable slot from the configured drafter, and if at
+        least one slot has a draft, run the verify program — one
+        chunked target dispatch emitting each slot's accepted prefix
+        plus one corrected token (``[<=K+1, S]`` drain). Returns False
+        (→ the caller dispatches the plain decode round, counted as a
+        fallback) when no slot drafted, or when ANY occupied slot sits
+        too near the cache end for the fixed-width chunk write
+        (``dynamic_update_slice`` clamps an out-of-range start, which
+        would shift the write onto live rows — the last few tokens of
+        a near-``max_len`` sequence always decode plainly)."""
+        K = self.spec_k
+        S = self.slots
+        parts = []
+        for s in range(S):
+            req = self._mirror[s]
+            if req is None:
+                continue
+            # the slot's device position (exact: spec drains eagerly)
+            pos = len(req.seq) + len(req.tokens) - req.resumed - 1
+            if pos + K + 2 > self.max_len:
+                return False
+            k_s = min(K, req.limit - len(req.tokens) - 1)
+            if k_s > 0:
+                parts.append((s, req, k_s))
+        for st in self._chunking:
+            # parked mid-prefill slots ride the chunk write too
+            if st["next"] + K + 2 > self.max_len:
+                return False
+        for entry in self._drain:
+            # a slot admitted THIS round (its prefill entry is still
+            # queued, so it is not in the mirror yet) is device-live
+            # at pos = len(seq) — it rides the chunk write like every
+            # slot and needs the same room
+            if entry[0] == "prefill" and not entry[1].done \
+                    and len(entry[1].seq) + K + 2 > self.max_len:
+                return False
+        if not parts:
+            return False
+        drafts = np.zeros((S, K), np.int32)
+        dlen = np.zeros((S,), np.int32)
+        if self.spec_draft == "ngram":
+            for s, req, k_s in parts:
+                dr = self._drafters.get(req.id)
+                prop = dr.propose(k_s) if dr is not None else []
+                if prop:
+                    drafts[s, :len(prop)] = prop
+                    dlen[s] = len(prop)
+            if not dlen.any():
+                return False
+            _TM_SPEC_NGRAM.inc(int(dlen.sum()))
+        else:
+            self._model_drafts(parts, drafts, dlen)
+            if not dlen.any():
+                return False
+            _TM_SPEC_MODEL.inc(int(dlen.sum()))
+        ndraft = int(dlen.sum())
+        self.stats["spec_drafted"] += ndraft
+        _TM_SPEC_DRAFTED.inc(ndraft)
+        with tele.span("serving.verify_round", cat="serving",
+                       slots_busy=busy, drafted=ndraft):
+            self._caches, self._state, out = self._verify_fn(
+                self._dec._params, self._dec._aux, self._caches,
+                self._state, drafts, dlen)
+        if "verify" not in self._prog_seen:
+            self._prog_seen.add("verify")
+            profiler.register_program(
+                "serving_verify", self._verify_fn,
+                (self._dec._params, self._dec._aux, self._caches,
+                 self._state, np.zeros((S, K), np.int32),
+                 np.zeros((S,), np.int32)))
+        self._drain.append(("verify", out, dlen))
+        self.stats["steps"] += 1
+        self.stats["spec_rounds"] += 1
+        _TM_ROUNDS.inc()
+        _TM_SPEC_ROUNDS.inc()
+        _TM_SLOTS_BUSY.observe(busy)
+        flt = _SERVING_FAULTS
+        if flt is not None:
+            flt.serving_crash()  # injected mid-round process death
+        return True
+
+    def _model_drafts(self, parts, drafts, dlen):
+        """Draft-model proposals (``draft="model"``): catch the draft
+        cache up on every token emitted since its last run (pending
+        queues fed by ``_push_token``), then one greedy ``spec_k``-token
+        proposal per slot — all in dispatches of the ONE draft
+        program. Pending longer than the catch-up width (after
+        fallback-round bursts) drains over several dispatches; only
+        the last one's proposals are used. Slots with nothing pending
+        ride along with an idempotent junk write above their head."""
+        K = self.spec_k
+        S = self.slots
+        W = K + 1
+        dd = self._draft_dec
+        # each slot's proposal is taken from the dispatch in which its
+        # catch-up COMPLETED: in a multi-dispatch drain (a fallback
+        # burst longer than W), a slot that finished early would
+        # otherwise ride later dispatches with a junk catch-up token
+        # and have its valid proposal overwritten by noise
+        final_props = np.zeros((S, K), np.int32)
+        proposed = set()
+        while True:
+            pos = np.zeros((S,), np.int32)
+            catchup = np.zeros((S, W), np.int32)
+            clen = np.ones((S,), np.int32)
+            again = False
+            newly_done = []
+            for s in range(S):
+                pos[s] = min(self._draft_pos[s], self.max_len - W)
+                pend = self._draft_pending[s]
+                if pend:
+                    n = min(len(pend), W)
+                    catchup[s, :n] = pend[:n]
+                    clen[s] = n
+                    del pend[:n]
+                    self._draft_pos[s] += n
+                    if pend:
+                        again = True
+                    else:
+                        newly_done.append(s)
+            self._draft_caches, props = self._draft_fn(
+                dd._params, dd._aux, self._draft_caches, pos, catchup,
+                clen)
+            if "draft" not in self._prog_seen:
+                self._prog_seen.add("draft")
+                profiler.register_program(
+                    "serving_draft", self._draft_fn,
+                    (dd._params, dd._aux, self._draft_caches, pos,
+                     catchup, clen))
+            if newly_done:
+                props = np.asarray(props)                   # [S, K]
+                for s in newly_done:
+                    final_props[s] = props[s]
+                    proposed.add(s)
+            if not again:
+                break
+        for s, req, k_s in parts:
+            if s in proposed:       # else: nothing pending fed the
+                drafts[s, :k_s] = final_props[s, :k_s]  # draft — skip
+                dlen[s] = k_s
 
     def step(self):
         """One scheduling round: retire cancelled/expired requests
@@ -1566,6 +2008,14 @@ class InferenceEngine:
         round — normal completions AND host retirements (check
         ``retire_reason``) — in completion order."""
         self._check_open()
+        if self._spec and self._drain:
+            # speculation drains EAGERLY: drafting needs the current
+            # context (the n-gram drafter and the draft-model catch-up
+            # read drained tokens) and exact per-slot positions; the
+            # tokens-per-dispatch the verify step buys replaces the
+            # drain-lag pipelining drain_depth bought (doc/serving.md)
+            while self._drain:
+                self._drain_one()
         self._sweep()
         # chunked prefill, Sarathi-style per-round budget: at most
         # ~prefill_chunk tokens of prefill work run between decode
@@ -1593,7 +2043,15 @@ class InferenceEngine:
             _TM_ADMITTED.observe(admitted)
         # slots still mid-prefill have nothing to decode: a round with
         # ONLY those resident would be pure wasted dispatch
-        if busy - len(self._chunking) > 0:
+        if busy - len(self._chunking) > 0 \
+                and not (self._spec and self._spec_round(busy)):
+            if self._spec:
+                # speculation armed but no slot had a usable draft
+                # (cold context, budget exhausted, or a slot too near
+                # the cache end for the chunk write): plain decode
+                # serves the round
+                _TM_SPEC_FALLBACK.inc()
+                self.stats["spec_fallback_rounds"] += 1
             with tele.span("serving.decode_round", cat="serving",
                            slots_busy=busy):
                 self._caches, self._state, out = self._step_fn(
@@ -1849,6 +2307,8 @@ class InferenceEngine:
                 "slo_cadence_ms": self.slo_cadence_ms,
                 "slo_target": self.slo_target,
                 "flight_recorder": self.flight.retain,
+                "spec_k": self.spec_k,
+                "draft": self.spec_draft,
             },
             "requests": reqs,
         }
@@ -1865,7 +2325,14 @@ class InferenceEngine:
         ``.tokens``; resumed sequences longer than the largest bucket
         admit in bucket-sized pieces automatically. Remaining deadline
         budgets carry over (an already-expired one retires on the
-        first round). Returns ``(engine, {request_id: Request})``."""
+        first round). Returns ``(engine, {request_id: Request})``.
+
+        Speculation knobs (``spec_k``/``draft``) restore with the
+        geometry; drafter context rebuilds from each request's
+        ``prompt + emitted`` at admission, so accept rates warm back
+        up immediately. A ``draft="model"`` snapshot needs the draft
+        model back: pass ``draft_decoder=...`` in ``overrides`` (the
+        snapshot is plain JSON and cannot carry weights)."""
         if not isinstance(snap, dict) or snap.get("version") != 1:
             raise MXNetError(
                 "InferenceEngine.restore: not an engine snapshot "

@@ -108,15 +108,21 @@ class FlightRecorder:
 
     def token(self, rid, n):
         """Sampled decode progress: called once per drained token with
-        the running count; records every ``token_sample``-th."""
+        the running count; records when the count CROSSES a
+        ``token_sample`` boundary. Crossing, not ``n %% sample == 0``:
+        a speculative verify round drains several accepted tokens at
+        once, so the running count may skip over an exact multiple —
+        the recorded event carries the true ``tokens=`` count either
+        way (multi-token cadence correctness, doc/serving.md)."""
         if not self.enabled:
             return
         with self._lock:
             fl = self._live.get(rid)
         if fl is None:
             return
+        prev = fl.tokens
         fl.tokens = n
-        if n % self.token_sample == 0:
+        if n // self.token_sample > prev // self.token_sample:
             self._append(fl, time.perf_counter(), "decode",
                          {"tokens": n})
 

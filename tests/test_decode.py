@@ -346,12 +346,20 @@ def test_decode_gqa_kv_cache():
                                    atol=0.05)
 
 
+@pytest.mark.slow
 def test_decode_sliding_window_ring_cache():
     """Sliding-window decode: the cache is a WINDOW-slot ring buffer
     (O(window) memory regardless of generation length), and the
     derived program — chunked prefill through the read-before-write
     ring, then single-token steps — matches the training graph's own
-    windowed forward exactly. Composes with rope, GQA, and int8."""
+    windowed forward exactly. Composes with rope, GQA, and int8.
+
+    Slow sweep (tier-1 budget, PR 10): ~30s of compiles across the 4
+    flavor cases; windowed decode keeps tier-1 coverage via
+    test_serving's window-flavor test (engine byte-compared against
+    this same offline windowed generate, rope included) and
+    test_window_prefill_pad_rows_do_not_corrupt_ring (exact ring K/V
+    and position equality against the dense forward)."""
     rng = np.random.RandomState(41)
     T, W = 16, 4
     cases = [dict(), dict(pos_encoding="rope"),

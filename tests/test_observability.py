@@ -165,6 +165,15 @@ def test_flight_recorder_token_sampling_and_disable():
     tl = fr.timeline(1)
     decode = [e for e in tl["events"] if e["event"] == "decode"]
     assert [e["tokens"] for e in decode] == [16, 32]
+    # multi-token drains (speculative verify) make the running count
+    # JUMP — sampling fires on boundary CROSSINGS, not exact
+    # multiples, and the event carries the true count (PR 10)
+    fr.start(2, prompt_len=1)
+    for n in (5, 15, 21, 30, 37):       # skips 16 and 32 exactly
+        fr.token(2, n)
+    decode = [e["tokens"] for e in fr.timeline(2)["events"]
+              if e["event"] == "decode"]
+    assert decode == [21, 37]
     # retain=0 disables recording entirely
     off = FlightRecorder(retain=0)
     off.start(1, prompt_len=1)

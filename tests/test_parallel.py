@@ -736,10 +736,16 @@ def test_pipeline_partition_validation():
         partition_stages(out)
 
 
+@pytest.mark.slow
 def test_pipeline_unequal_stages():
     """Stages with different layer counts (3 blocks over 2 stages) and
     therefore different parameter sets still train correctly — per-stage
-    programs, not shape-padded clones."""
+    programs, not shape-padded clones.
+
+    Slow sweep (tier-1 budget, PR 10): ~19s of compiles; tier-1
+    pipeline coverage stays broad via trainer_matches_single_device,
+    dp_pp_matches_single_device, multi_head, remat,
+    1f1b_activation_memory_bounded and pp_sharded_big_params."""
     from mxnet_tpu.models import get_transformer_lm
 
     vocab, B, T, E = 7, 4, 8, 8

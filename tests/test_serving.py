@@ -3,14 +3,27 @@ is the offline KV-cache Decoder itself — greedy engine outputs must be
 BYTE-IDENTICAL per request to ``Decoder.generate`` regardless of
 admission order, slot assignment, bucket padding, or co-resident
 requests, across every cache flavor. Also pins the compile-count
-contract (one decode program + one prefill program per used bucket) and
-the PR's decode-cache satellite (temperature is a traced operand).
+contract ({decode: 1, verify: <=1, prefill: 1/bucket, copy: 1/bucket})
+and the PR's decode-cache satellite (temperature is a traced operand).
+
+Speculative decoding is ON (``draft="ngram"``) for the default
+``_engine`` config and both shared engines, so nearly every identity
+test here ALSO pins "speculation changes nothing but speed": the
+oracle is the offline decoder, i.e. the spec-off output, and the
+admission-order / mid-stream / sampling / eos / chunked-prefix
+scenarios all run through the verify program whenever the drafter
+proposes. The spec-off engine is pinned by the from_checkpoint test
+(constructors default off) and by every pre-spec BENCH arm.
 
 Runtime discipline: every distinct ``(prompt_len, num_steps)`` oracle
 call and every engine compiles programs, which dominates this file on
 CPU — workloads reuse a small set of shapes, oracle outputs are cached,
 and one default-config engine is shared by the tests that only READ
-behavior (each still drains to idle)."""
+behavior (each still drains to idle); the first test's workload runs
+on the shared engine too (its compile pin holds for the whole
+module)."""
+import contextlib
+
 import numpy as np
 import pytest
 
@@ -60,6 +73,11 @@ def _engine(sym, params, **kw):
     # random prompts here would make copy-program compile counts
     # draw-dependent)
     kw.setdefault("prefix_cache_mb", 0)
+    # speculation ON by default (n-gram drafting): the oracle below IS
+    # the spec-off output, so every identity test doubles as a
+    # speculation byte-identity pin
+    kw.setdefault("draft", "ngram")
+    kw.setdefault("spec_k", 3)
     return InferenceEngine(Decoder(sym, params, max_len=T,
                                    cache_block=None), **kw)
 
@@ -81,6 +99,10 @@ def second_engine(lm):
     return _engine(sym, params)
 
 
+def _noop_ctx():
+    return contextlib.nullcontext()
+
+
 _ORACLE = {}
 
 
@@ -97,25 +119,43 @@ def _oracle(dec, prompt, n):
     return _ORACLE[key]
 
 
-def test_engine_mixed_lengths_slot_reuse_byte_identical(lm):
+def test_engine_mixed_lengths_slot_reuse_byte_identical(lm,
+                                                        shared_engine):
     """More requests than slots, mixed prompt/output lengths: every
     request byte-matches offline greedy decode; slots are recycled; the
     whole run (and a SECOND wave on the same engine) compiles exactly
-    one decode program + one prefill program per used bucket."""
+    one decode program, ONE verify program (speculation is on — the
+    engineered repetitive prompt guarantees the drafter proposes) and
+    one prefill program per used bucket. Runs on the module's shared
+    engine — first in the file, so the pin covers a cold engine; later
+    tests reuse the same programs (the contract holds module-wide)."""
     sym, params, dec = lm
     rng = np.random.RandomState(1)
-    eng = _engine(sym, params)
+    eng = shared_engine
     cases = [(2, 5), (4, 6), (7, 3), (4, 6), (2, 5), (7, 3), (6, 2)]
     reqs = [(p, n, eng.submit(p, max_tokens=n))
             for pl, n in cases
             for p in [rng.randint(0, VOCAB, (pl,))]]
+    # engineered speculation cases: a periodic prompt (the n-gram
+    # drafter must propose from the repeated suffix — verify compiles
+    # deterministically) and a prompt whose greedy continuation is
+    # self-repetitive enough to ACCEPT drafts (probed; seed-stable)
+    p_rep = np.array([1, 2, 3, 1, 2, 3, 1])
+    p_acc = np.array([0, 3, 3])
+    reqs.append((p_rep, 3, eng.submit(p_rep, max_tokens=3)))
+    reqs.append((p_acc, 13, eng.submit(p_acc, max_tokens=13)))
     done = eng.serve_forever()
-    assert len(done) == len(cases)
-    assert eng.stats["prefills"] == len(cases) > eng.slots  # slot reuse
+    assert len(done) == len(reqs)
+    assert eng.stats["prefills"] == len(reqs) > eng.slots  # slot reuse
     for p, n, r in reqs:
         np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
-    assert eng.compile_counts == {"decode": 1, "prefill": {4: 1, 8: 1},
-                                  "copy": {}}
+    assert eng.compile_counts == {"decode": 1, "verify": 1,
+                                  "prefill": {4: 1, 8: 1}, "copy": {}}
+    # the tentpole's point: drafts were proposed AND accepted — tokens
+    # landed more-than-one per verify dispatch, byte-identically
+    assert eng.stats["spec_rounds"] >= 1
+    assert eng.stats["spec_drafted"] >= 1
+    assert eng.stats["spec_accepted"] >= 1
 
     # PR 4 (telemetry): the per-request latency breakdown is fully
     # populated and ordered; every request here retires on its token
@@ -134,6 +174,13 @@ def test_engine_mixed_lengths_slot_reuse_byte_identical(lm):
     # compile_counts re-exported as telemetry (trace-time increments)
     assert snap["compiles_decode"] >= 1
     assert snap["compiles_prefill"] >= 2     # buckets 4 and 8
+    assert snap["compiles_verify"] >= 1
+    # speculation telemetry (doc/observability.md catalog)
+    assert snap["spec_rounds"] >= 1
+    assert snap["spec_drafted_tokens"] >= snap["spec_accepted_tokens"]
+    assert snap["spec_accepted_tokens"] >= 1
+    assert snap["spec_drafts_ngram"] >= 1
+    assert snap["spec_accepted_per_step"]["count"] >= 1
 
     # second wave on the SAME engine: zero new compiles, still exact
     wave2 = [(p, n, eng.submit(p, max_tokens=n))
@@ -142,8 +189,8 @@ def test_engine_mixed_lengths_slot_reuse_byte_identical(lm):
     eng.serve_forever()
     for p, n, r in wave2:
         np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
-    assert eng.compile_counts == {"decode": 1, "prefill": {4: 1, 8: 1},
-                                  "copy": {}}
+    assert eng.compile_counts == {"decode": 1, "verify": 1,
+                                  "prefill": {4: 1, 8: 1}, "copy": {}}
     assert eng.idle
 
 
@@ -151,17 +198,27 @@ def test_engine_multi_step_rounds_byte_identical(lm):
     """steps_per_round>1 (the dispatch-amortized decode round, one
     lax.scan program) changes scheduling granularity only: outputs
     stay byte-identical, including requests that retire MID-round
-    (budgets deliberately not multiples of the round length)."""
+    (budgets deliberately not multiples of the round length). With
+    speculation ON (the _engine default), rounds with drafts dispatch
+    the verify program and draftless rounds fall back to the 3-step
+    scan — both interleave in this workload and the accepting prompt
+    pins that multi-token verify drains compose with multi-token scan
+    drains."""
     sym, params, dec = lm
     rng = np.random.RandomState(11)
     eng = _engine(sym, params, steps_per_round=3)
     reqs = [(p, n, eng.submit(p, max_tokens=n))
             for pl, n in [(2, 5), (6, 2), (2, 5), (6, 2), (4, 1)]
             for p in [rng.randint(0, VOCAB, (pl,))]]
+    reqs.append((np.array([0, 3, 3]), 13,
+                 eng.submit(np.array([0, 3, 3]), max_tokens=13)))
     eng.serve_forever()
     for p, n, r in reqs:
         np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
-    assert eng.compile_counts["decode"] == 1
+    cc = eng.compile_counts
+    assert cc["decode"] == 1 and cc["verify"] <= 1
+    assert eng.stats["spec_rounds"] >= 1      # verify rounds ran
+    assert eng.stats["spec_fallback_rounds"] >= 1  # and scan rounds
     assert eng.idle
 
 
@@ -265,10 +322,18 @@ def test_engine_cache_flavors_match_offline(flavor):
         sym, deckw = _lm(window=6, pos_encoding="rope"), {}
     params = _init_params(sym, rng)
     dec = Decoder(sym, params, max_len=T, cache_block=None, **deckw)
-    eng = InferenceEngine(
-        Decoder(sym, params, max_len=T, cache_block=None, **deckw),
-        slots=2, prefill_buckets=(4, 8),
-        prefix_cache_mb=0.01, prefill_chunk=4)
+    # speculation requested on BOTH flavors: int8 verifies through the
+    # quantized cache; the windowed model must refuse LOUDLY (the
+    # verify chunk would wrap rejected drafts onto live ring rows —
+    # prefix-cache precedent) and serve with draft="off"
+    ctx = (pytest.warns(UserWarning, match="windowed")
+           if flavor == "window" else _noop_ctx())
+    with ctx:
+        eng = InferenceEngine(
+            Decoder(sym, params, max_len=T, cache_block=None, **deckw),
+            slots=2, prefill_buckets=(4, 8),
+            prefix_cache_mb=0.01, prefill_chunk=4,
+            spec_k=3, draft="ngram")
     # shared prefixes ON PURPOSE: the repeats hit the cache (int8),
     # same (prompt_len, max_tokens) shapes as before for oracle reuse
     base = rng.randint(0, VOCAB, (6,))
@@ -284,10 +349,52 @@ def test_engine_cache_flavors_match_offline(flavor):
     if flavor == "int8":
         assert eng.stats["prefix_hit_tokens"] > 0  # scales copied too
         assert eng.compile_counts["copy"]
+        assert eng.spec_draft == "ngram"       # int8 speculates
+        assert eng.compile_counts["verify"] <= 1
     else:
         assert eng._prefix is None and eng._pool is None  # the bypass
         assert eng.compile_counts["copy"] == {}
         assert eng.stats["prefill_chunks"] > len(cases)  # chunks ran
+        assert eng.spec_draft == "off"         # the loud ring bypass
+        assert eng.compile_counts["verify"] == 0
+        assert eng.stats["spec_rounds"] == 0
+
+
+def test_engine_draft_model_speculation(lm):
+    """draft="model": a draft decoder sharing the slot-paged layout
+    proposes K tokens per round (its own per-bucket prefill + ONE
+    proposal program), the target verifies — byte-identical outputs,
+    and with the draft sharing the target's weights every proposal
+    matches, so tokens land (accepted + 1) per verify dispatch (the
+    speedup mechanism, pinned as accepted > verify rounds). The
+    compile contract extends by exactly {draft: 1,
+    draft_prefill: 1/bucket}."""
+    sym, params, dec = lm
+    rng = np.random.RandomState(21)
+    eng = _engine(sym, params, draft="model",
+                  draft_decoder=Decoder(sym, params, max_len=T,
+                                        cache_block=None))
+    cases = [(rng.randint(0, VOCAB, (2,)), 5),
+             (rng.randint(0, VOCAB, (4,)), 6),
+             (rng.randint(0, VOCAB, (7,)), 3),
+             (np.array([0, 3, 3]), 13)]
+    reqs = [(p, n, eng.submit(p, max_tokens=n)) for p, n in cases]
+    eng.serve_forever()
+    for p, n, r in reqs:
+        np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
+    assert eng.compile_counts == {
+        "decode": 1, "verify": 1, "prefill": {4: 1, 8: 1}, "copy": {},
+        "draft": 1, "draft_prefill": {4: 1, 8: 1}}
+    # same weights -> drafts always match until a budget/eos stop:
+    # strictly more than one token per verify dispatch on average
+    assert eng.stats["spec_accepted"] > eng.stats["spec_rounds"] >= 1
+    assert mx.telemetry.snapshot()["serving"]["spec_drafts_model"] >= 1
+    # the snapshot carries the speculation knobs (restore() needs
+    # draft_decoder= handed back in overrides — plain JSON cannot
+    # carry weights)
+    geo = eng.snapshot()["engine"]
+    assert geo["draft"] == "model" and geo["spec_k"] == 3
+    assert eng.idle
 
 
 def test_engine_prefix_cache_chunked_byte_identical(lm):
@@ -336,6 +443,12 @@ def test_engine_prefix_cache_chunked_byte_identical(lm):
     assert cc["decode"] == 1
     assert cc["copy"] and all(v == 1 for v in cc["copy"].values())
     assert all(v == 1 for v in cc["prefill"].values())
+    # speculation rode the whole gauntlet (the _engine default is
+    # draft="ngram"): verify compiled at most once, and verify rounds
+    # actually served prefix-hit/chunked traffic byte-identically
+    assert cc["verify"] <= 1
+    assert eng.stats["spec_rounds"] + eng.stats["spec_fallback_rounds"] \
+        > 0
 
     # second wave, REVERSED admission order, same engine (zero new
     # compiles): hit/miss patterns differ completely, outputs must not
@@ -357,6 +470,24 @@ def test_engine_prefix_cache_chunked_byte_identical(lm):
     assert snap["prefix_cache_bytes"] >= 0
     assert snap["prefill_chunks_per_request"]["count"] >= len(cases)
     assert snap["compiles_copy"] >= 1
+
+    # near-cache-end guard regression: a prompt so long its head sits
+    # within spec_k+2 of max_len admits while an ACCEPTING co-resident
+    # keeps proposing drafts — the rounds carrying it (including the
+    # one where its final prefill entry is still undrained, the
+    # mirror-blind window) must fall back to plain decode instead of
+    # letting the fixed-width verify chunk write clamp onto its live
+    # rows. Corruption would break byte-identity below.
+    r_acc = eng.submit(np.array([0, 3, 3]), max_tokens=13)
+    for _ in range(3):
+        eng.step()                       # drafts begin flowing
+    p_end = rng.randint(0, VOCAB, (13,))
+    r_end = eng.submit(p_end, max_tokens=2)
+    eng.serve_forever()
+    np.testing.assert_array_equal(r_acc.result(),
+                                  _oracle(dec, np.array([0, 3, 3]), 13))
+    np.testing.assert_array_equal(r_end.result(), _oracle(dec, p_end, 2))
+    assert len(eng._compile_log) == log_len  # still zero new programs
 
 
 def test_window_prefill_pad_rows_do_not_corrupt_ring():
@@ -427,6 +558,46 @@ def test_engine_sampling_schedule_independent(lm, shared_engine,
     b = run(second_engine, [0, 1, "x"])
     np.testing.assert_array_equal(a, b)
     assert a.shape == (6,) and (a >= 0).all() and (a < VOCAB).all()
+
+
+def test_spec_multi_token_cadence_wall_clock_truth(lm, shared_engine):
+    """Satellite: K accepted tokens landing in ONE drain must not skew
+    the cadence metric. ``serving.token_cadence_ms`` divides the
+    request's decode wall time by its INTERVAL count (tokens − 1), so
+    a verify drain delivering several tokens at one instant still
+    reports the true per-token wall rate (the PR 9 restore-cadence
+    precedent: divide by what actually happened, not by drain events);
+    flight decode-progress events carry explicit ``tokens=`` counts
+    that keep ascending across multi-token drains."""
+    sym, params, dec = lm
+    eng = shared_engine
+    p = np.array([0, 3, 3])        # probed: its greedy continuation
+    acc0 = eng.stats["spec_accepted"]      # accepts n-gram drafts
+    before = mx.telemetry.snapshot()["serving"]["token_cadence_ms"]
+    old_sample = eng.flight.token_sample
+    eng.flight.token_sample = 2            # dense progress sampling
+    try:
+        r = eng.submit(p, max_tokens=13)
+        eng.serve_forever()
+    finally:
+        eng.flight.token_sample = old_sample
+    np.testing.assert_array_equal(r.result(), _oracle(dec, p, 13))
+    assert len(r.tokens) == 13
+    assert eng.stats["spec_accepted"] > acc0   # multi-token drains ran
+    after = mx.telemetry.snapshot()["serving"]["token_cadence_ms"]
+    assert after["count"] == before["count"] + 1
+    # the one new observation is wall-clock truth for THIS request
+    # (approx: the delta subtracts a long-accumulated float sum)
+    want = (r.t_done - r.t_first) / (len(r.tokens) - 1) * 1e3
+    got = after["sum"] - before["sum"]
+    assert got == pytest.approx(want, rel=1e-6, abs=1e-5)
+    # flight progress: explicit ascending token counts, every
+    # 2-crossing recorded even though several tokens share a drain
+    tl = eng.flight.timeline(r.id)
+    decode = [e["tokens"] for e in tl["events"]
+              if e["event"] == "decode"]
+    assert decode == [2, 4, 6, 8, 10, 12]
+    assert eng.idle
 
 
 def test_engine_from_checkpoint_and_estimator(lm, tmp_path):
@@ -511,6 +682,14 @@ def test_engine_validation(lm, shared_engine):
         eng.submit([1, 2], max_tokens=0)
     with pytest.raises(MXNetError, match="not finished"):
         eng.submit([1, 2], max_tokens=2).result()
+    # speculation knobs (PR satellite): bad source, useless K, and
+    # draft="model" without its decoder all fail at construction
+    with pytest.raises(MXNetError, match="draft must be"):
+        _engine(sym, params, draft="bogus")
+    with pytest.raises(MXNetError, match="spec_k"):
+        _engine(sym, params, spec_k=0)
+    with pytest.raises(MXNetError, match="draft_decoder"):
+        _engine(sym, params, draft="model")
     eng.serve_forever()  # leave the shared engine idle
 
 

@@ -71,9 +71,12 @@ def feng(lm):
 @pytest.fixture(scope="module")
 def ceng(lm):
     """The shared prefix-cache + chunked-prefill engine (1-slot pool —
-    2 KiB covers one 1-layer f32 slot)."""
+    2 KiB covers one 1-layer f32 slot). Speculation is ON (n-gram):
+    the crash/restore and poison scenarios below therefore pin that
+    fault recovery composes with draft-and-verify byte-identically."""
     eng = InferenceEngine(_mkdec(lm), slots=2, prefill_buckets=(4, 8),
-                          prefix_cache_mb=0.0021, prefill_chunk=3)
+                          prefix_cache_mb=0.0021, prefill_chunk=3,
+                          spec_k=3, draft="ngram")
     assert eng._prefix is not None and eng._prefix.capacity == 1
     return eng
 
@@ -399,6 +402,9 @@ def test_crash_mid_round_restore_byte_identical(lm, ceng):
     eng2, handles = InferenceEngine.restore(snap, _mkdec(lm))
     assert eng2.prefill_chunk == ceng.prefill_chunk
     assert eng2.overload == ceng.overload
+    # speculation knobs restore with the geometry (mid-sequence
+    # resumes keep drafting — drafter context rebuilds at admission)
+    assert eng2.spec_draft == "ngram" and eng2.spec_k == ceng.spec_k
     # fresh auto-drawn seeds never collide with resumed requests'
     assert eng2._auto_seed == ceng._auto_seed
     eng2.serve_forever()
@@ -411,7 +417,7 @@ def test_crash_mid_round_restore_byte_identical(lm, ceng):
         assert eng2._prefix.pinned == 0
     assert len(eng2._free) == eng2.slots
     cc = eng2.compile_counts
-    assert cc["decode"] == 1
+    assert cc["decode"] == 1 and cc["verify"] <= 1
     assert all(v == 1 for v in cc["prefill"].values())
     assert all(v == 1 for v in cc["copy"].values())
     # the crashed engine still drains clean too (same process: a REAL
@@ -420,7 +426,7 @@ def test_crash_mid_round_restore_byte_identical(lm, ceng):
     assert ceng._prefix.pinned == 0
     assert len(ceng._free) == ceng.slots
     cc = ceng.compile_counts
-    assert cc["decode"] == 1
+    assert cc["decode"] == 1 and cc["verify"] <= 1
     assert all(v == 1 for v in cc["prefill"].values())
     assert all(v == 1 for v in cc["copy"].values())
     eng2.close()
@@ -478,11 +484,17 @@ def test_flight_recorder_reconstructs_failed_request_over_http(lm,
 
     rng = np.random.RandomState(11)
     p_ok, p_bad = (rng.randint(0, VOCAB, (4,)) for _ in range(2))
-    r_ok = feng.submit(p_ok, max_tokens=3)
+    # explicit request ids: /requests and /flight/<id> aggregate over
+    # EVERY live engine in the process, and auto ids are per-engine
+    # ints — another engine lingering in a gc cycle (test_serving's
+    # module fixtures) can retire the same small int and shadow this
+    # engine's row in the keyed-table assertions below
+    r_ok = feng.submit(p_ok, max_tokens=3, request_id="flight-ok")
     feng.step()                  # r_ok admitted before the fault arms
     fi = FaultInjector()
     with fi.serving_h2d_failures(1):
-        r_bad = feng.submit(p_bad, max_tokens=3, deadline_ms=60000.0)
+        r_bad = feng.submit(p_bad, max_tokens=3, deadline_ms=60000.0,
+                            request_id="flight-bad")
         feng.serve_forever()
     assert r_bad.done and r_bad.retire_reason == "error"
     assert fi.log == [("h2d_fail", r_bad.id)]
@@ -554,8 +566,9 @@ def test_close_fails_pending_and_is_idempotent(lm, feng):
     feng.step()                  # > drain_depth: first token drains
     c2 = feng.submit(p, max_tokens=6)
     # every robustness path this file drove compiled NOTHING new (all
-    # prompts in this file share bucket 4 — one program, ever)
-    assert feng.compile_counts == {"decode": 1,
+    # prompts in this file share bucket 4 — one program, ever; feng
+    # serves spec-off, so verify never compiles)
+    assert feng.compile_counts == {"decode": 1, "verify": 0,
                                    "prefill": {4: 1}, "copy": {}}
     feng.close()
     assert c1.done and c1.retire_reason == "closed"

@@ -1,0 +1,99 @@
+"""The n-gram (prompt-lookup) drafter as a pure host-side unit
+(mxnet_tpu/serving/spec.py): proposal correctness, suffix-match edge
+cases, determinism, and snapshot/restore round-trips. ZERO compiles —
+modeled on tests/test_prefix_cache.py; the device-side verify of these
+proposals is pinned by tests/test_serving.py (byte-identity with
+speculation on)."""
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import NgramDrafter
+
+
+def test_proposal_follows_latest_suffix_match():
+    # context ...[7, 8] seen twice earlier with different followers:
+    # the LATEST occurrence wins
+    d = NgramDrafter([7, 8, 1, 2, 7, 8, 3, 4, 7, 8])
+    assert d.propose(2) == [3, 4]
+    # a walk past the context end continues the implied cycle
+    d2 = NgramDrafter([5, 6, 9, 5, 6])
+    assert d2.propose(8) == [9, 5, 6, 9, 5, 6, 9, 5]
+
+
+def test_longer_suffix_preferred_over_shorter():
+    # suffix [2, 3] matches at one spot; a bare [3] ALSO matches later
+    # — the 2-gram match is stronger evidence and must win
+    d = NgramDrafter([1, 2, 3, 9, 9, 3, 7, 2, 3], max_ngram=3)
+    assert d.propose(1) == [9]          # follows [2, 3], not the [3, 7]
+
+
+def test_prompt_output_boundary_overlap():
+    # the match STARTS in the "prompt" and the query suffix lives in
+    # the "output" — the drafter sees one flat context, so matches
+    # spanning the boundary work (the engine feeds prompt + emitted)
+    d = NgramDrafter([4, 5, 6, 1])      # prompt
+    for t in [4, 5]:                    # emitted tokens
+        d.append(t)
+    assert d.propose(2) == [6, 1]       # [4, 5] matched at the start
+
+
+def test_periodic_tail_self_overlap():
+    # an occurrence overlapping the query suffix itself continues a
+    # periodic tail: the walk past the context end steps back by the
+    # implied period, so proposals stay k long (a clipped 1-token
+    # proposal would cap acceptance at 1 on ...c c c runs)
+    d = NgramDrafter([9, 1, 2, 1, 2])
+    assert d.propose(3) == [1, 2, 1]
+    run = NgramDrafter([0, 7, 7, 7])
+    assert run.propose(4) == [7, 7, 7, 7]
+
+
+def test_k_longer_than_history_and_degenerate_contexts():
+    assert NgramDrafter([]).propose(4) == []
+    assert NgramDrafter([3]).propose(4) == []      # nothing earlier
+    assert NgramDrafter([3, 3]).propose(0) == []   # k < 1
+    # two tokens, suffix [3] matches position 0 -> a period-1 cycle
+    assert NgramDrafter([3, 3]).propose(4) == [3, 3, 3, 3]
+    # no repeated suffix anywhere: no proposal
+    assert NgramDrafter([1, 2, 3, 4, 5]).propose(4) == []
+
+
+def test_repeated_suffixes_pick_latest_match():
+    # [1] occurs three times before the tail; the proposal follows the
+    # LAST one (freshest continuation)
+    d = NgramDrafter([1, 7, 1, 8, 1, 9, 1], max_ngram=1)
+    assert d.propose(1) == [9]
+
+
+def test_determinism_and_append_extend():
+    ctx = [2, 4, 2, 4, 2]
+    a = NgramDrafter(ctx)
+    b = NgramDrafter(ctx[:3])
+    b.extend(ctx[3:])
+    assert len(a) == len(b) == 5
+    for _ in range(3):                  # same context, same proposal
+        assert a.propose(4) == b.propose(4) == [4, 2, 4, 2]
+
+
+def test_snapshot_restore_round_trip():
+    d = NgramDrafter([5, 1, 5, 1], max_ngram=2, min_ngram=2)
+    st = d.state()
+    import json
+    st = json.loads(json.dumps(st))     # plain-JSON like the engine's
+    d2 = NgramDrafter.from_state(st)
+    assert d2.propose(3) == d.propose(3) == [5, 1, 5]
+    assert d2.max_ngram == 2 and d2.min_ngram == 2
+    d2.append(9)                        # restored drafter keeps working
+    assert len(d2) == len(d) + 1
+
+
+def test_min_max_ngram_validation_and_bounds():
+    with pytest.raises(MXNetError, match="min_ngram"):
+        NgramDrafter([], min_ngram=0)
+    with pytest.raises(MXNetError, match="min_ngram"):
+        NgramDrafter([], min_ngram=3, max_ngram=2)
+    # min_ngram=2 refuses 1-gram grazes a min_ngram=1 drafter takes
+    loose = NgramDrafter([1, 2, 3, 2], min_ngram=1)
+    strict = NgramDrafter([1, 2, 3, 2], min_ngram=2)
+    assert loose.propose(1) == [3]
+    assert strict.propose(1) == []

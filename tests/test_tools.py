@@ -343,14 +343,22 @@ def test_dump_telemetry_serving_filter(tmp_path, capsys):
         "prefill_chunks_per_request": hist(4),
         "compiles_decode": 1, "compiles_prefill": 2,
         "compiles_copy": 2,
+        "spec_rounds": 5, "spec_fallback_rounds": 2,
+        "spec_drafted_tokens": 20, "spec_accepted_tokens": 15,
+        "spec_drafts_ngram": 20, "spec_drafts_model": 0,
+        "spec_accepted_per_step": hist(3),
     }}
     snap_path = tmp_path / "snap.json"
     snap_path.write_text(json.dumps(snap))
     dump_telemetry.main([str(snap_path), "--serving"])
     out = capsys.readouterr().out
     assert "hit_rate=0.75" in out and "hit_tokens=96" in out
+    # speculation line (PR 10): accept rate + drafter source mix +
+    # fallback rounds, next to the latency histograms they explain
+    assert "accept_rate=0.75" in out and "fallback_rounds=2" in out
+    assert "ngram=20" in out
     for key in ("ttft_ms", "token_cadence_ms", "prefix_lookup_ms",
-                "prefill_chunks_per_request"):
+                "prefill_chunks_per_request", "spec_accepted_per_step"):
         assert key in out
     # a snapshot with no serving section degrades gracefully
     (tmp_path / "empty.json").write_text("{}")

@@ -18,6 +18,15 @@ hit-rate axis shows where prefix-copy reuse starts paying off over
 re-prefilling, the chunk axis what bounding decode stalls costs in
 throughput. ``--no-prefix-sweep`` skips it.
 
+``--spec-ks`` adds a third sweep over ``bench.bench_serving_spec``
+(repetition-friendly few-shot-style workload): one cell per draft
+length K (0 = speculation off), same stream per seed, reporting
+tokens/s, cadence p99 and accepted tokens per target-model step —
+where the accept rate holds, tokens/s climbs with K at FLAT or better
+p99 (the draft-and-verify win); where drafts stop being accepted the
+wasted chunk width shows up as tokens/s falling below the K=0 cell.
+``--no-spec-sweep`` skips it.
+
 Run from the repo root::
 
     python tools/bench_serving.py                      # 124M, chip
@@ -77,6 +86,13 @@ def main():
     ap.add_argument("--prefix-requests", type=int, default=48,
                     help="requests per prefix-sweep cell")
     ap.add_argument("--no-prefix-sweep", action="store_true")
+    ap.add_argument("--spec-ks", type=int, nargs="+", default=[0, 4, 8],
+                    help="speculation sweep axis: draft length per "
+                         "cell (0 = spec off); n-gram drafting on a "
+                         "repetition-friendly workload")
+    ap.add_argument("--spec-requests", type=int, default=32,
+                    help="requests per speculation-sweep cell")
+    ap.add_argument("--no-spec-sweep", action="store_true")
     args = ap.parse_args()
 
     import bench
@@ -143,6 +159,23 @@ def main():
                 out["h%g_c%d" % (hr, chunk)] = cell
                 print("h%g_c%d: %r" % (hr, chunk, cell),
                       file=sys.stderr)
+    # speculation sweep: spec-off vs n-gram drafting at each K on the
+    # SAME repetition-friendly stream (byte-identical outputs across
+    # cells — only tokens-per-dispatch changes)
+    if not args.no_spec_sweep:
+        for k in args.spec_ks:
+            r = bench.bench_serving_spec(
+                slots=max(args.slots[0], 2), layers=args.layers,
+                embed=args.embed, heads=args.heads, vocab=args.vocab,
+                max_len=args.max_len, n_requests=args.spec_requests,
+                spec_k=k, seed=7)
+            cell = {key: r[key] for key in
+                    ("tokens_per_sec", "cadence_p50_ms",
+                     "cadence_p99_ms", "accept_per_step",
+                     "accept_rate", "fallback_rounds",
+                     "compile_programs")}
+            out["spec_k%d" % k] = cell
+            print("spec_k%d: %r" % (k, cell), file=sys.stderr)
     print(json.dumps(out, sort_keys=True))
 
 

@@ -98,6 +98,19 @@ def print_serving(snap, out=None):
               % (s.get("shed", 0), s.get("deadline_missed", 0),
                  s.get("cancelled", 0), s.get("request_errors", 0),
                  s.get("watchdog_trips", 0), s.get("restores", 0)))
+    drafted = s.get("spec_drafted_tokens", 0)
+    if s.get("spec_rounds", 0) or drafted:
+        accepted = s.get("spec_accepted_tokens", 0)
+        out.write("speculation:      rounds=%s fallback_rounds=%s "
+                  "drafted=%s accepted=%s accept_rate=%s "
+                  "sources ngram=%s model=%s\n"
+                  % (s.get("spec_rounds", 0),
+                     s.get("spec_fallback_rounds", 0), drafted,
+                     accepted,
+                     "n/a" if not drafted
+                     else "%.2f" % (accepted / float(drafted)),
+                     s.get("spec_drafts_ngram", 0),
+                     s.get("spec_drafts_model", 0)))
     if s.get("slo_ttft_attained", 0) or s.get("slo_ttft_missed", 0) \
             or s.get("slo_cadence_attained", 0) \
             or s.get("slo_cadence_missed", 0):
@@ -122,6 +135,7 @@ def print_serving(snap, out=None):
     out.write("\n%-28s %s\n" % ("per-request", "distribution"))
     for key in ("queue_wait_ms", "ttft_ms", "token_cadence_ms",
                 "prefix_lookup_ms", "prefill_chunks_per_request",
+                "spec_accepted_per_step",
                 "admitted_per_round", "slots_busy_per_round"):
         v = s.get(key)
         if _is_histogram(v):
