@@ -313,8 +313,16 @@ def bench_decode(prompt=64, layers=12, embed=768,
                    compute_dtype="bfloat16", cache_block=None)
     blocked = Decoder(sym, params, max_len=max_len,
                       compute_dtype="bfloat16", cache_block=128)
+    # Pallas paged-attention arm (ISSUE 11): reads only the live cache
+    # rows per step — on CPU the kernel runs under the interpreter (so
+    # wall clock under-sells it; the honest CPU win is bytes_accessed
+    # per token from the program gauges), on TPU it runs compiled
+    paged = Decoder(sym, params, max_len=max_len,
+                    compute_dtype="bfloat16", cache_block=None,
+                    attn_impl="paged")
     arms = {"full_b8": measure(full, steps_short, 8),
-            "block128_b8": measure(blocked, steps_short, 8)}
+            "block128_b8": measure(blocked, steps_short, 8),
+            "paged_b8": measure(paged, steps_short, 8)}
     # batch sweep pinned to the full-read decoder (stable arm names
     # across rounds; the sweep's point is batch scaling, not the
     # read-path contest the b8 pair above decides)
@@ -352,7 +360,8 @@ def bench_decode(prompt=64, layers=12, embed=768,
 
 
 def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
-                  max_len=1024, n_requests=96, seed=0, arrival_ms=1.0):
+                  max_len=1024, n_requests=96, seed=0, arrival_ms=1.0,
+                  attn_impl="dense", cache_dtype=None):
     """Continuous-batching serving engine (mxnet_tpu/serving/) under
     SATURATING load: Poisson arrivals far above service capacity (the
     queue never empties), mixed prompt lengths across the bucket set
@@ -370,8 +379,18 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
     tail is what co-residency costs a request, independent of queue
     wait (which saturating arrivals make unbounded by construction).
 
+    ``attn_impl``/``cache_dtype`` select the ISSUE 11 A/B arms: the
+    dense whole-cache read vs the Pallas paged kernel (live rows
+    only), at fp (bf16 compute) and int8-KV flavors — same workload,
+    same seeds, compile contract asserted per arm. The returned dict
+    also carries ``decode_bytes_accessed``/``decode_flops`` from the
+    XLA cost analysis of THIS arm's decode program (PR 9 program
+    gauges) — on CPU, where the Pallas interpreter's wall clock
+    under-sells the kernel, the bytes cut per dispatched round is the
+    honest win metric.
+
     Returns {"tokens_per_sec", "p50_ms_per_token", "p99_ms_per_token",
-    "slots", "requests", "tokens", "compile_programs"}.
+    "slots", "requests", "tokens", "compile_programs", ...}.
     """
     import jax.numpy as jnp
     from mxnet_tpu.models import get_transformer_lm
@@ -392,7 +411,8 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
     buckets = tuple(b for b in (64, 128, 256) if b <= max_len) \
         or (max_len,)
     dec = Decoder(sym, params, max_len=max_len,
-                  compute_dtype="bfloat16", cache_block=None)
+                  compute_dtype="bfloat16", cache_block=None,
+                  cache_dtype=cache_dtype)
 
     def workload(n, rs):
         """(prompt, max_tokens) mix: prompts spread over the bucket
@@ -439,7 +459,8 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
     # measures the cache and chunking on a workload built for them.
     engine = InferenceEngine(dec, slots=slots, prefill_buckets=buckets,
                              max_queue=4 * slots, steps_per_round=8,
-                             prefix_cache_mb=0, prefill_chunk=0)
+                             prefix_cache_mb=0, prefill_chunk=0,
+                             attn_impl=attn_impl)
     # warmup compiles BOTH program families for every bucket up front
     # (one prompt per bucket), so the timed run measures execution only
     wrs = np.random.RandomState(seed + 1)
@@ -454,6 +475,15 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
                                      for v in cc["prefill"].values()) \
         and not cc["copy"], \
         "compile-count contract violated: %r" % (cc,)
+    # this arm's decode-program cost analysis (the PR 9 program
+    # gauges, re-registered by THIS engine's first dispatch): the
+    # paged-vs-dense bytes_accessed delta per dispatched round is the
+    # memory-traffic cut the kernel exists for
+    from mxnet_tpu import profiler as _prof
+    import mxnet_tpu as _mx
+    _prof.collect_program_stats()
+    prog = _mx.telemetry.snapshot().get("program", {}) \
+        .get("serving_decode", {})
     return {
         "tokens_per_sec": round(toks / dt, 0),
         "p50_ms_per_token": round(float(np.percentile(tpot, 50)), 3),
@@ -462,6 +492,10 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
         "requests": n_requests,
         "tokens": toks,
         "compile_programs": programs,
+        "attn_impl": attn_impl,
+        "cache_dtype": cache_dtype or "bf16",
+        "decode_bytes_accessed": prog.get("bytes_accessed"),
+        "decode_flops": prog.get("flops"),
     }
 
 
@@ -1311,6 +1345,45 @@ def main():
     except Exception:
         traceback.print_exc()
         serving_overload = None
+    # paged-attention A/B (ISSUE 11): dense whole-cache reads vs the
+    # Pallas live-row kernel, fp and int8-KV flavors, same workload
+    # and seeds per pair; the compile contract is asserted inside each
+    # arm. bytes_accessed per decode dispatch (program gauges) is the
+    # traffic cut; tokens/s + cadence p99 are the wall-clock read.
+    try:
+        paged_pairs = {}
+        for flavor, cdt in (("fp", None), ("int8", "int8")):
+            dense_arm = bench_serving(attn_impl="dense",
+                                      cache_dtype=cdt)
+            paged_arm = bench_serving(attn_impl="paged",
+                                      cache_dtype=cdt)
+            paged_pairs["dense_%s" % flavor] = dense_arm
+            paged_pairs["paged_%s" % flavor] = paged_arm
+            paged_pairs["speedup_%s" % flavor] = \
+                None if not dense_arm["tokens_per_sec"] \
+                else round(paged_arm["tokens_per_sec"]
+                           / dense_arm["tokens_per_sec"], 2)
+            ba_d = dense_arm.get("decode_bytes_accessed")
+            ba_p = paged_arm.get("decode_bytes_accessed")
+            paged_pairs["bytes_accessed_ratio_%s" % flavor] = \
+                None if not ba_d or not ba_p else round(ba_p / ba_d, 3)
+        serving_paged = {
+            **paged_pairs,
+            "note": "attn_impl='paged' (Pallas paged-attention kernel "
+                    "— reads only each slot's live KV rows, int8 "
+                    "dequantized in-kernel) vs the dense whole-cache "
+                    "read, identical workload/seeds per pair, greedy "
+                    "outputs byte-identical (fp) by the engine "
+                    "contract; bytes_accessed_ratio = paged/dense "
+                    "decode-program bytes per dispatched round (XLA "
+                    "cost analysis) — the memory-traffic cut, the "
+                    "honest metric where the CPU interpreter blurs "
+                    "wall clock; tools/bench_serving.py --attn-impls "
+                    "sweeps this axis",
+        }
+    except Exception:
+        traceback.print_exc()
+        serving_paged = None
     def _dec_best_ms():
         if not dec_arms:
             return None
@@ -1379,6 +1452,7 @@ def main():
         },
         "serving_prefix_cache_chunked_prefill": serving_prefix,
         "serving_speculative_decoding": serving_spec,
+        "serving_paged_attention": serving_paged,
         "serving_overload_shed_vs_block": None if serving_overload is None
         else {
             **serving_overload,
@@ -1489,6 +1563,14 @@ def main():
             "serving_spec_speedup":
                 None if serving_spec is None
                 else serving_spec["speedup_k4"],
+            "decode_paged_speedup":
+                None if not dec_arms or not dec_arms.get("full_b8")
+                or not dec_arms.get("paged_b8")
+                else round(dec_arms["full_b8"]["ms_per_token"]
+                           / dec_arms["paged_b8"]["ms_per_token"], 2),
+            "serving_paged_p99_ms":
+                None if serving_paged is None
+                else serving_paged["paged_fp"]["p99_ms_per_token"],
             "cifar10_img_per_sec":
                 None if cifar is None else round(cifar, 1),
             "cifar10_vs_gtx980":

@@ -1107,7 +1107,7 @@ class FeedForward(BASE_ESTIMATOR):
                           prefix_cache_mb=None, prefill_chunk=None,
                           overload=None, round_timeout_ms=None,
                           spec_k=None, draft=None, draft_decoder=None,
-                          **decoder_kwargs):
+                          attn_impl=None, **decoder_kwargs):
         """Trained estimator → continuous-batching inference engine
         (``mxnet_tpu.serving.InferenceEngine``, doc/serving.md): the
         online-serving analogue of :meth:`predict`. Works on a fitted
@@ -1119,7 +1119,10 @@ class FeedForward(BASE_ESTIMATOR):
         knobs (load shedding policy, round watchdog — doc/serving.md
         "Serving under hostile traffic"); ``spec_k``/``draft``/
         ``draft_decoder`` arm speculative decoding (doc/serving.md
-        "Speculative decoding")."""
+        "Speculative decoding"); ``attn_impl="paged"`` serves
+        decode/verify through the Pallas paged-attention kernel that
+        reads only each slot's live KV rows (doc/serving.md "Paged
+        attention")."""
         from .parallel.decode import Decoder
         from .serving import InferenceEngine
 
@@ -1148,7 +1151,8 @@ class FeedForward(BASE_ESTIMATOR):
                                overload=overload,
                                round_timeout_ms=round_timeout_ms,
                                spec_k=spec_k, draft=draft,
-                               draft_decoder=draft_decoder)
+                               draft_decoder=draft_decoder,
+                               attn_impl=attn_impl)
 
     @staticmethod
     def load(prefix, epoch, ctx=None, **kwargs):

@@ -159,13 +159,18 @@ def rope_rotate(x, positions, base=10000.0):
     """Rotary position embedding (RoFormer / GPT-NeoX half-split form):
     rotate the two halves of each head dim by position-dependent angles,
     so q·k depends only on RELATIVE distance. x: [B, T, H, D] (D even);
-    positions: [T] absolute positions of these tokens."""
+    positions: [T] absolute positions of these tokens, or [B, T] when
+    each batch row sits at its own clock (the decoder's slot-paged
+    batched walk — every row gets its own angles)."""
     d = x.shape[-1]
     half = d // 2
     freq = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    ang = positions[:, None].astype(jnp.float32) * freq[None, :]
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = positions[..., None].astype(jnp.float32) * freq
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    if ang.ndim == 2:          # positions [T]: broadcast over batch
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    else:                      # positions [B, T]: per-row angles
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1).astype(x.dtype)

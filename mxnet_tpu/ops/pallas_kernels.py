@@ -31,7 +31,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention", "fused_linear", "striped_pair_attention",
-           "matmul_stats"]
+           "matmul_stats", "paged_attention", "default_paged_block_k"]
 
 
 def _use_interpret():
@@ -947,3 +947,241 @@ def matmul_stats(x, w, *, block_m=256, block_n=256, block_k=512,
     if interpret is None:
         interpret = _use_interpret()
     return _matmul_stats_core(x, w, block_m, block_n, block_k, interpret)
+
+
+# ---------------------------------------------------------------------------
+# paged attention — the serving engine's decode/verify read (ISSUE 11).
+#
+# The slot-paged KV cache is [S, max_len, Hkv, D] with every slot at its
+# own position; the dense read gathers (and, for int8, dequantizes) ALL
+# max_len rows per emitted token even when a slot is 40 tokens into a
+# 1024-row cache. This kernel walks only each slot's LIVE blocks: grid
+# over (slot, kv-head, kv-block) under a PrefetchScalarGridSpec — the
+# per-slot position vector is scalar-prefetched so the cache index
+# maps clamp every grid step past ceil((pos + C) / block_k) back to
+# the slot's last live block (a revisited block index, whose HBM->VMEM
+# copy Mosaic elides; the body is pl.when-gated off), i.e. the bound
+# cuts the DMA itself, not just the compute. Online-softmax scratch
+# accumulation merges blocks exactly (a reassociation, not an
+# approximation — the same argument as Decoder._blocked_attn), and
+# int8 caches dequantize per block IN the kernel from the side-scale
+# operands, so the cache is read once at 1 byte/elem instead of being
+# materialized as a full float copy first. C > 1 serves the chunked-query flavors: the
+# speculative verify step's [S, K+1] chunk and the draft model's
+# catch-up window (doc/serving.md "Paged attention").
+#
+# NOT ring-safe: a windowed ring stores rows at wrapped positions, so
+# "rows [0, pos+C)" is not the live set — the engine refuses loudly and
+# serves those models with the exact dense ring walk (UserWarning
+# precedent: speculation, prefix cache).
+
+
+def default_paged_block_k(max_len):
+    """KV rows per block for ``paged_attention``: the largest of
+    (128, 64, 32, 16, 8) dividing ``max_len`` (whole blocks keep the
+    in-kernel slices static), else ``max_len`` itself — a cache too
+    short/odd to block degenerates to one block, still bounded by the
+    position mask. ``MXNET_PAGED_BLOCK_K`` overrides."""
+    import os
+    override = os.environ.get("MXNET_PAGED_BLOCK_K")
+    if override:
+        b = int(override)
+        # validate HERE, naming the knob: an unvalidated 0/negative
+        # dies later inside a jitted serving trace (ZeroDivisionError
+        # at the divisibility check; negative iota shapes in Pallas)
+        # with no pointer back to the env var
+        if b <= 0 or max_len % b:
+            raise ValueError(
+                "MXNET_PAGED_BLOCK_K=%s must be a positive divisor of "
+                "the cache length %d" % (override, max_len))
+        return b
+    for b in (128, 64, 32, 16, 8):
+        if max_len % b == 0:
+            return b
+    return max_len
+
+
+def _paged_attn_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, block_k,
+                       chunk, n_blocks, scale, quant):
+    """One (slot, kv-head, kv-block) grid cell of the paged read.
+
+    The kv-block axis is a GRID dimension, not an in-kernel loop, so
+    the per-slot bound cuts the DMA itself: the cache BlockSpecs'
+    index maps (see ``paged_attention``) send every dead step back to
+    the slot's last live block — an unchanged block index, whose copy
+    Mosaic elides — and this body is ``pl.when``-gated off for them.
+    Online-softmax state (acc/l/m) lives in VMEM scratch carried
+    across the innermost grid sweep; the output block is written once,
+    on the final step. q block [G*C, D] (the kv head's G query heads x
+    C chunk rows, row r = g*C + c — the decoder's GQA fold order);
+    int8 caches dequantize per block from the row-scale operands.
+    int32 arithmetic throughout (the package enables x64 — see the
+    flash kernel's Mosaic i64 notes)."""
+    if quant:
+        ks_ref, vs_ref, o_ref, acc_ref, l_ref, m_ref = rest
+    else:
+        o_ref, acc_ref, l_ref, m_ref = rest
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+    p = pos_ref[s]
+    nkb = jnp.minimum(
+        lax.div(p + jnp.int32(chunk + block_k - 1), jnp.int32(block_k)),
+        jnp.int32(n_blocks))
+    neg_big = jnp.float32(-1e30)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+        m_ref[...] = jnp.full(m_ref.shape, neg_big, jnp.float32)
+
+    @pl.when(j < nkb)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)      # [G*C, D]
+        rows = q.shape[0]
+        kb = k_ref[0, :, 0, :]
+        vb = v_ref[0, :, 0, :]
+        if quant:
+            # in-kernel dequant: int8 rows x [bk, 1] f32 row scales —
+            # the same arithmetic as Decoder._read_cache, minus the
+            # full-cache float materialization
+            kb = kb.astype(jnp.float32) * ks_ref[0, :, 0, :]
+            vb = vb.astype(jnp.float32) * vs_ref[0, :, 0, :]
+        else:
+            kb = kb.astype(jnp.float32)
+            vb = vb.astype(jnp.float32)
+        sc = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) \
+            * scale
+        # query absolute positions: row r sits at chunk offset r % C
+        qpos = p + lax.rem(
+            lax.broadcasted_iota(jnp.int32, (rows, block_k), 0),
+            jnp.int32(chunk))
+        kpos = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (rows, block_k), 1)
+        mask = kpos <= qpos              # causal; also masks the tail
+        sc = jnp.where(mask, sc, neg_big)
+        m = m_ref[...]
+        new_m = jnp.maximum(m, jnp.max(sc, axis=1, keepdims=True))
+        pexp = jnp.where(mask, jnp.exp(sc - new_m), 0.0)
+        corr = jnp.exp(m - new_m)
+        l_ref[...] = l_ref[...] * corr \
+            + jnp.sum(pexp, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr \
+            + jnp.dot(pexp, vb, preferred_element_type=jnp.float32)
+        m_ref[...] = new_m
+
+    # row `pos` was written before the read, so block 0 always holds a
+    # valid key: the denominator is never the clamp
+    @pl.when(j == jnp.int32(n_blocks - 1))
+    def _emit():
+        o_ref[0, 0] = (acc_ref[...]
+                       / jnp.maximum(l_ref[...], 1e-30)
+                       ).astype(o_ref.dtype)
+
+
+def paged_attention(q, k, v, pos, *, k_scale=None, v_scale=None,
+                    scale=None, block_k=None, interpret=None):
+    """Slot-paged decode attention reading only the live KV rows.
+
+    q: [S, C, H, D] — each slot's C-token query chunk (C=1 plain
+    decode; C=K+1 the speculative verify chunk; C=W the draft
+    catch-up). k, v: [S, L, Hkv, D] cache buffers (float, or int8 with
+    ``k_scale``/``v_scale`` [S, L, Hkv] f32 row scales — dequantized
+    inside the kernel). pos: [S] int32, the chunk's start position per
+    slot: the chunk rows at [pos, pos+C) must already be WRITTEN (the
+    decoder writes before reading, same as the dense path), and each
+    query row attends keys [0, pos + its chunk offset]. Returns
+    [S, C, H, D] in q's dtype, f32 accumulation.
+
+    The kv-block walk is a grid dimension under a
+    ``PrefetchScalarGridSpec``: ``pos`` is scalar-prefetched, so the
+    cache index maps can clamp every step past a slot's live prefix
+    back to its last live block — a REVISITED block index whose
+    HBM->VMEM copy Mosaic elides — and the kernel body is
+    ``pl.when``-gated off there. Dead rows are therefore never
+    FETCHED, not merely never computed on (the distinction the dense
+    read and a naive full-plane BlockSpec both miss). Grouped-query
+    attention is native: each (slot, kv-head) pair streams one set of
+    K/V blocks past the kv head's whole query group. On TPU the
+    kernel runs compiled; on CPU (tests, the smoke bench) it runs
+    under the Pallas interpreter — same testing discipline as the
+    flash kernel above. NOTE the interpreter executes all
+    ``n_blocks`` grid steps (the revisit elision is a Mosaic
+    behavior), so CPU wall clock and XLA cost analysis both
+    under-sell the bound; doc/performance.md records the honest
+    smoke metrics."""
+    if interpret is None:
+        interpret = _use_interpret()
+    s_, c, h, d = q.shape
+    l_ = k.shape[1]
+    kv = k.shape[2]
+    g = h // kv
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    if block_k is None:
+        block_k = default_paged_block_k(l_)
+    if l_ % block_k:
+        raise ValueError(
+            "paged_attention: block_k=%d must divide the cache length "
+            "%d (whole blocks keep the grid static)" % (block_k, l_))
+    quant = (k_scale is not None) or (v_scale is not None)
+    if quant and (k_scale is None or v_scale is None):
+        raise ValueError("paged_attention: k_scale and v_scale must be "
+                         "passed together")
+    nb = l_ // block_k
+    pos = jnp.asarray(pos, jnp.int32)
+    # [S, C, H, D] -> [S, KV, G*C, D]: the head axis splits (kv, g),
+    # matching the decoder's GQA fold q.reshape(b, c, kv, g, d)
+    qg = q.transpose(0, 2, 1, 3).reshape(s_, kv, g, c, d) \
+        .reshape(s_, kv, g * c, d)
+
+    def live_j(si, j, pref):
+        # dead grid steps revisit the slot's LAST live block (same
+        # block index as the previous step -> Mosaic skips the copy;
+        # the kernel body is pl.when-gated off for them)
+        p = pref[si]
+        nkb = jnp.minimum(
+            lax.div(p + jnp.int32(c + block_k - 1),
+                    jnp.int32(block_k)),
+            jnp.int32(nb))
+        return jnp.minimum(j, nkb - 1)
+
+    def qmap(si, hi, j, pref):
+        return (si, hi, np.int32(0), np.int32(0))
+
+    def kmap(si, hi, j, pref):
+        return (si, live_j(si, j, pref), hi, np.int32(0))
+
+    in_specs = [
+        pl.BlockSpec((1, 1, g * c, d), qmap),
+        pl.BlockSpec((1, block_k, 1, d), kmap),
+        pl.BlockSpec((1, block_k, 1, d), kmap),
+    ]
+    operands = [qg, k, v]
+    if quant:
+        # scales ride as [S, L, KV, 1] so the in-kernel block is a
+        # 2-D [bk, 1] tile (Mosaic-friendly; broadcasts over D)
+        operands.append(k_scale.astype(jnp.float32)[..., None])
+        operands.append(v_scale.astype(jnp.float32)[..., None])
+        sspec = pl.BlockSpec((1, block_k, 1, 1), kmap)
+        in_specs.extend([sspec, sspec])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_, kv, nb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, g * c, d), qmap),
+        scratch_shapes=[
+            pltpu.VMEM((g * c, d), jnp.float32),   # acc
+            pltpu.VMEM((g * c, 1), jnp.float32),   # l
+            pltpu.VMEM((g * c, 1), jnp.float32),   # m
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_attn_kernel, block_k=block_k, chunk=c,
+                          n_blocks=nb, scale=float(scale), quant=quant),
+        out_shape=jax.ShapeDtypeStruct((s_, kv, g * c, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(pos, *operands)
+    return out.reshape(s_, kv, g, c, d).reshape(s_, h, c, d) \
+        .transpose(0, 2, 1, 3)

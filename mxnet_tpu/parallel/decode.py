@@ -30,6 +30,9 @@ char-rnn inference); attention-era decoding is a TPU-build extension.
 """
 from __future__ import annotations
 
+import os
+import warnings
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -123,11 +126,30 @@ class Decoder:
         practice but bit-parity tests use the default. Any float dtype
         string (e.g. ``"bfloat16"``) is also accepted and simply stores
         the cache at that dtype; default follows ``compute_dtype``.
+    attn_impl : {"dense", "paged"}, optional
+        Cache-read strategy (default: the ``MXNET_SERVING_ATTN_IMPL``
+        env var, else ``"dense"``). ``"paged"`` computes decode/verify
+        attention with the Pallas paged kernel
+        (``ops.pallas_kernels.paged_attention``): walk only each
+        sequence's LIVE cache rows — bounded by the (per-slot)
+        position — with online-softmax accumulation and in-kernel int8
+        dequantization, so the K/V buffers are read once at their
+        stored width instead of gathered (and, for int8, dequantized
+        to a full float copy) whole every step. Exact: online softmax
+        reassociates, it does not approximate — greedy outputs match
+        the dense path (float flavors byte-identical through the
+        serving gauntlet; int8 under the usual quantized-cache
+        tolerance). Mutually exclusive with ``cache_block`` (two
+        prefix-bounded read strategies); windowed ring models warn and
+        fall back to the exact dense ring walk (ring rows live at
+        wrapped positions, outside the kernel's [0, pos) contract).
+        The serving engine threads its own ``attn_impl`` through
+        ``_run_slots`` — doc/serving.md "Paged attention".
     """
 
     def __init__(self, symbol, params, max_len, aux_params=None,
                  compute_dtype=None, cache_block="auto",
-                 cache_dtype=None):
+                 cache_dtype=None, attn_impl=None):
         symbol = _logits_symbol(symbol)
         self._topo = symbol._topo()
         self._heads = symbol._heads
@@ -135,6 +157,25 @@ class Decoder:
             raise MXNetError("Decoder needs a single-output symbol, got %d"
                              % len(self._heads))
         self.max_len = int(max_len)
+        if attn_impl is None:
+            attn_impl = os.environ.get("MXNET_SERVING_ATTN_IMPL") \
+                or "dense"
+        if attn_impl not in ("dense", "paged"):
+            raise MXNetError(
+                "Decoder: attn_impl must be 'dense' or 'paged', got %r "
+                "(MXNET_SERVING_ATTN_IMPL sets the default)"
+                % (attn_impl,))
+        self._attn_impl = attn_impl
+        if attn_impl == "paged":
+            if cache_block == "auto":
+                # paged reads are already prefix-bounded; the blocked
+                # fori-loop read would be a second, slower strategy
+                cache_block = None
+            elif cache_block is not None:
+                raise MXNetError(
+                    "Decoder: attn_impl='paged' and cache_block are "
+                    "two prefix-bounded read strategies — pass "
+                    "cache_block=None with the paged kernel")
         if cache_block == "auto":
             cache_block = None if self.max_len <= 512 else 128
             if cache_block is not None and self.max_len % cache_block:
@@ -167,6 +208,21 @@ class Decoder:
                     "position-wise; the decode transform supports the "
                     "standard LM ops (%s)"
                     % (name, n.name, ", ".join(sorted(_POSITIONWISE))))
+
+        if self._attn_impl == "paged" \
+                and any(self._node_window(n) for n in self._mha):
+            # refuse LOUDLY, then serve exactly: ring rows live at
+            # WRAPPED positions, so "rows [0, pos+C)" is not the live
+            # set and the paged kernel cannot hold exactness — the
+            # dense ring walk (already O(window)) serves instead
+            # (UserWarning precedent: speculation, prefix cache)
+            warnings.warn(
+                "Decoder: attn_impl='paged' does not compose with "
+                "windowed ring caches (ring rows live at wrapped "
+                "positions, not a [0, pos) prefix) — serving with the "
+                "exact dense ring walk instead", UserWarning,
+                stacklevel=2)
+            self._attn_impl = "dense"
 
         arg_names = [n.name for n in self._topo if n.is_var]
         self._data_name = "data" if "data" in arg_names else arg_names[0]
@@ -298,7 +354,27 @@ class Decoder:
         BATCHING rule concatenates the index scalars without dtype
         promotion, so a traced per-slot ``pos`` (int32, via
         ``_run_slots``'s vmap) mixed with python-int literals trips
-        ``lax.concatenate`` otherwise."""
+        ``lax.concatenate`` otherwise.
+
+        A VECTOR ``pos`` ([B] int32 — the paged ``_run_slots`` batched
+        walk) scatters each batch row's chunk at its own positions
+        (value-identical to the vmapped per-lane update)."""
+        if jnp.ndim(pos) == 1:
+            p = jnp.asarray(pos, jnp.int32)
+            b, c = k.shape[0], k.shape[1]
+            rows = p[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :]
+            sidx = jnp.arange(b, dtype=jnp.int32)[:, None]
+            if self._cache_int8:
+                ck, ks, cv, vs = entry
+                k8, ksc = self._quantize_rows(k)
+                v8, vsc = self._quantize_rows(v)
+                return (ck.at[sidx, rows].set(k8),
+                        ks.at[sidx, rows].set(ksc),
+                        cv.at[sidx, rows].set(v8),
+                        vs.at[sidx, rows].set(vsc))
+            ck, cv = entry
+            return (ck.at[sidx, rows].set(k.astype(ck.dtype)),
+                    cv.at[sidx, rows].set(v.astype(cv.dtype)))
         z = jnp.int32(0)
         p = jnp.asarray(pos, jnp.int32)
         if self._cache_int8:
@@ -315,15 +391,34 @@ class Decoder:
                 lax.dynamic_update_slice(cv, v.astype(cv.dtype),
                                          (z, p, z, z)))
 
-    def _read_cache(self, entry, dtype):
+    def _read_cache(self, entry, dtype, limit=None):
         """Whole-cache K/V for the attention read: dequantized to
         ``dtype`` if int8, else returned at the stored dtype (jnp
-        promotion governs mixed cache/compute float dtypes)."""
+        promotion governs mixed cache/compute float dtypes).
+
+        ``limit`` (STATIC int, optional): read only rows [0, limit) —
+        the max live position of the dispatch, when the caller knows
+        it statically (offline generate/beam prefill at python-int
+        pos). The gather AND the int8 dequant skip the dead suffix
+        entirely. When the position is a traced operand (the engine's
+        bucketed prefill, every per-step read) shapes cannot shrink,
+        so the full read stays and the dead rows are MASKED at the
+        score stage instead — value-identical, pinned by
+        tests/test_paged_attention.py."""
         if self._cache_int8:
             ck, ks, cv, vs = entry
+            if limit is not None and limit < ck.shape[1]:
+                ck = lax.slice_in_dim(ck, 0, limit, axis=1)
+                ks = lax.slice_in_dim(ks, 0, limit, axis=1)
+                cv = lax.slice_in_dim(cv, 0, limit, axis=1)
+                vs = lax.slice_in_dim(vs, 0, limit, axis=1)
             return ((ck * ks[..., None]).astype(dtype),
                     (cv * vs[..., None]).astype(dtype))
-        return entry
+        ck, cv = entry
+        if limit is not None and limit < ck.shape[1]:
+            ck = lax.slice_in_dim(ck, 0, limit, axis=1)
+            cv = lax.slice_in_dim(cv, 0, limit, axis=1)
+        return ck, cv
 
     def _cached_mha(self, node, ins, entry, pos, valid_len=None):
         from ..ops.attention import MultiHeadAttention as _MHA
@@ -344,41 +439,76 @@ class Decoder:
             # their group broadcast equals the full forward's
             # rotate-after-repeat)
             from ..ops.attention import rope_rotate
-            posv = pos + jnp.arange(c)
+            if jnp.ndim(pos) == 1:   # per-slot clocks (paged walk)
+                posv = jnp.asarray(pos, jnp.int32)[:, None] \
+                    + jnp.arange(c, dtype=jnp.int32)
+            else:
+                posv = pos + jnp.arange(c)
             q = rope_rotate(q, posv, node.params["rope_base"])
             k = rope_rotate(k, posv, node.params["rope_base"])
         win = self._node_window(node)
         if win:
+            if jnp.ndim(pos) == 1:
+                raise MXNetError(
+                    "Decoder: the paged batched walk does not support "
+                    "windowed ring caches — serve windowed models with "
+                    "attn_impl='dense' (the construction-time fallback "
+                    "does this automatically)")
             o, entry = self._window_attn(q, k, v, entry, pos, win,
                                          valid_len)
             return jnp.einsum("bte,fe->btf", o.reshape(b, c, e),
                               wo) + bo, entry
         entry = self._write_cache(entry, k, v, pos)
-        if self._cache_block is not None and c == 1:
+        if self._attn_impl == "paged" or jnp.ndim(pos) == 1:
+            # Pallas paged attention (ops/pallas_kernels.py): walk only
+            # rows [0, pos+C) per slot, int8 dequantized IN the kernel
+            # from the side scales — the cache is read once at its
+            # stored width instead of being dequantized/gathered whole
+            from ..ops.pallas_kernels import paged_attention
+            posv = jnp.asarray(pos, jnp.int32) if jnp.ndim(pos) == 1 \
+                else jnp.full((b,), pos, jnp.int32)
+            if self._cache_int8:
+                ck, ks, cv, vs = entry
+                o = paged_attention(q, ck, cv, posv, k_scale=ks,
+                                    v_scale=vs)
+            else:
+                ck, cv = entry
+                o = paged_attention(q, ck, cv, posv)
+        elif self._cache_block is not None and c == 1:
             o = self._blocked_attn(q, entry, pos)
-        elif kv == h:
-            ck, cv = self._read_cache(entry, q.dtype)
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / float(np.sqrt(d))
-            kpos = jnp.arange(self.max_len)[None, None, None, :]
-            qpos = pos + jnp.arange(c)[None, None, :, None]
-            s = jnp.where(kpos <= qpos, s,
-                          jnp.float32(-1e30).astype(s.dtype))
-            o = jnp.einsum("bhqk,bkhd->bqhd",
-                           jax.nn.softmax(s, axis=-1), cv)
         else:
-            # GQA: grouped einsums read the kv-head cache directly —
-            # query heads fold to [B, C, Hkv, G, D] and contract
-            # against their shared K/V head, no repeated cache copy
-            ck, cv = self._read_cache(entry, q.dtype)
-            qg = q.reshape(b, c, kv, h // kv, d)
-            s = jnp.einsum("bqKgd,bkKd->bKgqk", qg,
-                           ck) / float(np.sqrt(d))
-            kpos = jnp.arange(self.max_len)[None, None, None, None, :]
-            qpos = pos + jnp.arange(c)[None, None, None, :, None]
-            s = jnp.where(kpos <= qpos, s,
-                          jnp.float32(-1e30).astype(s.dtype))
-            o = jnp.einsum("bKgqk,bkKd->bqKgd",
-                           jax.nn.softmax(s, axis=-1), cv)
+            # dense read. A STATIC dispatch position (offline
+            # generate/beam prefill call _run with a python-int pos)
+            # bounds the live rows statically: the gather/dequant is
+            # clamped to [0, pos+c) instead of masking all max_len
+            # rows (the masked full read remains for traced positions,
+            # where shapes cannot shrink — see _read_cache)
+            limit = self.max_len
+            if isinstance(pos, (int, np.integer)):
+                limit = min(self.max_len, int(pos) + c)
+            ck, cv = self._read_cache(entry, q.dtype, limit=limit)
+            if kv == h:
+                s = jnp.einsum("bqhd,bkhd->bhqk", q,
+                               ck) / float(np.sqrt(d))
+                kpos = jnp.arange(limit)[None, None, None, :]
+                qpos = pos + jnp.arange(c)[None, None, :, None]
+                s = jnp.where(kpos <= qpos, s,
+                              jnp.float32(-1e30).astype(s.dtype))
+                o = jnp.einsum("bhqk,bkhd->bqhd",
+                               jax.nn.softmax(s, axis=-1), cv)
+            else:
+                # GQA: grouped einsums read the kv-head cache directly —
+                # query heads fold to [B, C, Hkv, G, D] and contract
+                # against their shared K/V head, no repeated cache copy
+                qg = q.reshape(b, c, kv, h // kv, d)
+                s = jnp.einsum("bqKgd,bkKd->bKgqk", qg,
+                               ck) / float(np.sqrt(d))
+                kpos = jnp.arange(limit)[None, None, None, None, :]
+                qpos = pos + jnp.arange(c)[None, None, None, :, None]
+                s = jnp.where(kpos <= qpos, s,
+                              jnp.float32(-1e30).astype(s.dtype))
+                o = jnp.einsum("bKgqk,bkKd->bqKgd",
+                               jax.nn.softmax(s, axis=-1), cv)
         return jnp.einsum("bte,fe->btf", o.reshape(b, c, e), wo) + bo, \
             entry
 
@@ -578,6 +708,13 @@ class Decoder:
                 continue
             if name == "PositionalEmbedding":
                 x, posp = ins
+                if jnp.ndim(pos) == 1:
+                    # per-slot clocks (paged batched walk): gather each
+                    # batch row's positions from the table
+                    idx = jnp.asarray(pos, jnp.int32)[:, None] \
+                        + jnp.arange(x.shape[1], dtype=jnp.int32)
+                    env[(id(n), 0)] = x + jnp.take(posp, idx, axis=0)
+                    continue
                 # all-int32 indices: see _write_cache on the vmapped
                 # batching rule's strict index dtypes
                 rows = lax.dynamic_slice(
@@ -614,12 +751,37 @@ class Decoder:
     # reuse the exact decode math above (quantized, windowed, GQA, rope
     # included) with zero duplication.
 
-    def _run_slots(self, params, aux, caches, pos, tokens):
+    def _run_slots(self, params, aux, caches, pos, tokens, impl=None):
         """Per-slot-position ``_run``: ``pos`` [S] int32 positions (one
         per cache slot), ``tokens`` [S, C] → (logits [S, C, V], updated
-        caches). vmap over the slot axis — each lane is a b=1 ``_run``
-        at its own traced position, so cache writes become per-slot
-        scatters and masks follow each slot's own clock."""
+        caches).
+
+        ``impl`` (default: the decoder's own ``attn_impl``) picks the
+        read strategy. ``"dense"`` vmaps over the slot axis — each lane
+        is a b=1 ``_run`` at its own traced position, so cache writes
+        become per-slot scatters and masks follow each slot's own
+        clock, and every lane gathers (and, for int8, dequantizes) all
+        ``max_len`` cache rows. ``"paged"`` runs ONE batched walk with
+        the position VECTOR: position-wise ops see [S, C, E] directly,
+        cache writes scatter per slot, and the attention read is the
+        Pallas paged kernel (ops/pallas_kernels.py) that touches only
+        each slot's live rows — the serving decode/verify hot path's
+        memory-traffic lever (doc/serving.md "Paged attention")."""
+        if impl is None:
+            impl = self._attn_impl
+        elif impl == "dense" and self._attn_impl == "paged":
+            # a paged decoder's _cached_mha always takes the kernel
+            # path — honoring "dense" here would silently serve paged
+            # anyway, so refuse (mirrors the engine's constructor
+            # check): build a dense decoder to serve dense
+            raise MXNetError(
+                "Decoder: impl='dense' requested on a decoder built "
+                "with attn_impl='paged' — build the decoder dense "
+                "(the engine threads its own attn_impl per dispatch)")
+        if impl == "paged":
+            return self._run(params, aux, caches,
+                             jnp.asarray(pos, jnp.int32), tokens)
+
         def one(slot_caches, p, t):
             # vmap hands each lane the slot's cache WITHOUT its leading
             # axis; _run wants b=1 buffers — re-add and strip it
@@ -706,7 +868,7 @@ class Decoder:
         return jax.tree_util.tree_map(write, caches, rows)
 
     def verify_step_slots(self, params, aux, caches, state, drafts,
-                          dlen):
+                          dlen, impl=None):
         """Speculative draft-and-verify decode step over all S slots
         (the serving engine's verify program — doc/serving.md
         "Speculative decoding").
@@ -748,7 +910,7 @@ class Decoder:
         chunk = jnp.concatenate(
             [tok[:, None], drafts.astype(jnp.int32)], axis=1)
         logits, caches = self._run_slots(params, aux, caches, pos,
-                                         chunk)            # [S,K+1,V]
+                                         chunk, impl=impl)  # [S,K+1,V]
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         def with_sampling(_):
@@ -793,7 +955,7 @@ class Decoder:
         return caches, state2, jnp.stack(outs)              # [K+1, S]
 
     def draft_propose_slots(self, params, aux, caches, pos, catchup,
-                            clen, k):
+                            clen, k, impl=None):
         """Greedy k-token proposal from a DRAFT model sharing the
         slot-paged layout (the serving engine's draft program —
         ``InferenceEngine(draft="model")``).
@@ -809,7 +971,7 @@ class Decoder:
         sampled requests the target's verify still gates acceptance
         against ITS sample, the draft just matches less often."""
         logits, caches = self._run_slots(params, aux, caches, pos,
-                                         catchup)           # [S, W, V]
+                                         catchup, impl=impl)  # [S,W,V]
         idx = jnp.clip(clen - 1, 0, catchup.shape[1] - 1)
         lastlog = jnp.take_along_axis(
             logits, idx[:, None, None], axis=1)[:, 0]       # [S, V]
@@ -819,7 +981,7 @@ class Decoder:
         def body(carry, _):
             caches, p, t = carry
             lg, caches = self._run_slots(params, aux, caches, p,
-                                         t[:, None])
+                                         t[:, None], impl=impl)
             nx = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
             return (caches, p + 1, nx), nx
 

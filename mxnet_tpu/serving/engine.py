@@ -163,6 +163,11 @@ _TM_SLOTS_BUSY = tele.histogram(
     "serving.slots_busy_per_round",
     buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128))
 _TM_OCCUPANCY = tele.gauge("serving.slot_occupancy")
+# info gauge: which attention impl the decode/verify programs trace —
+# 1 = paged (Pallas live-row kernel), 0 = dense. Set at construction;
+# with several engines in one process the gauge reflects the engine
+# built last (the one-engine-per-process SLO note applies).
+_TM_ATTN_IMPL = tele.gauge("serving.attn_impl")
 # prefix cache + chunked prefill (all host-side: the lookup is a trie
 # walk, the copy/chunk spans time dispatches — nothing crosses the
 # device boundary beyond the programs themselves)
@@ -505,6 +510,25 @@ class InferenceEngine:
         ``MXNET_SERVING_FLIGHT_RECORDER`` env var, else 256; 0
         disables recording. Host-side, bounded (doc/observability.md
         "The flight recorder").
+    attn_impl : {"dense", "paged"}, optional
+        Cache-read strategy for the decode / verify / draft programs
+        (default: the decoder's own ``attn_impl``, itself defaulted
+        from ``MXNET_SERVING_ATTN_IMPL``, else ``"dense"``).
+        ``"paged"`` traces them over the Pallas paged-attention kernel
+        (``ops.pallas_kernels.paged_attention``): each slot's read
+        walks only its LIVE cache rows — bounded by the per-slot
+        position vector — with in-kernel int8 dequantization, cutting
+        the per-token HBM traffic that dominates decode (the cache is
+        read once at its stored width instead of gathered, and for
+        int8 dequantized to a full float copy, whole every step).
+        Greedy outputs stay byte-identical to ``"dense"`` in float
+        flavors (online softmax is a reassociation); int8 carries the
+        usual quantized-cache tolerance. The compile-count contract is
+        unchanged — same program families, different kernels inside.
+        Windowed-ring decoders warn and serve dense (ring rows live at
+        wrapped positions); prefill keeps the dense bucketed programs
+        (compute-bound, traced start). ``snapshot()``/``restore()``
+        carry the knob. doc/serving.md "Paged attention".
     """
 
     def __init__(self, decoder, slots=8, prefill_buckets=None,
@@ -514,7 +538,7 @@ class InferenceEngine:
                  round_timeout_ms=None, slo_ttft_ms=None,
                  slo_cadence_ms=None, slo_target=0.99,
                  flight_recorder=None, spec_k=None, draft=None,
-                 draft_decoder=None):
+                 draft_decoder=None, attn_impl=None):
         if not isinstance(decoder, Decoder):
             raise MXNetError("InferenceEngine needs a Decoder, got %r"
                              % type(decoder).__name__)
@@ -628,6 +652,37 @@ class InferenceEngine:
                              "be >= 0 (0 disables the prefix cache)")
         self._windowed = any(decoder._node_window(n)
                              for n in decoder._mha)
+        # attention impl (doc/serving.md "Paged attention"): which
+        # cache-read strategy the decode/verify/draft programs trace —
+        # threaded into every Decoder._run_slots dispatch, so one
+        # decoder can serve under either impl (the A/B bench and the
+        # identity tests share weights across engines)
+        if attn_impl is None:
+            attn_impl = decoder._attn_impl
+        if attn_impl not in ("dense", "paged"):
+            raise MXNetError(
+                "InferenceEngine: attn_impl must be 'dense' or "
+                "'paged', got %r (MXNET_SERVING_ATTN_IMPL sets the "
+                "default)" % (attn_impl,))
+        if attn_impl == "dense" and decoder._attn_impl == "paged":
+            raise MXNetError(
+                "InferenceEngine: attn_impl='dense' over a Decoder "
+                "built with attn_impl='paged' — build the decoder "
+                "dense; the engine threads its own attn_impl into the "
+                "slot programs")
+        if attn_impl == "paged" and self._windowed:
+            # refuse LOUDLY, then serve exactly (prefix-cache /
+            # speculation precedent): ring rows live at wrapped
+            # positions, outside the paged kernel's [0, pos) contract
+            warnings.warn(
+                "InferenceEngine: windowed-ring decoders do not "
+                "compose with attn_impl='paged' (ring rows live at "
+                "wrapped positions, not a [0, pos) prefix) — serving "
+                "with the exact dense ring walk instead", UserWarning,
+                stacklevel=2)
+            attn_impl = "dense"
+        self.attn_impl = attn_impl
+        _TM_ATTN_IMPL.set(1 if attn_impl == "paged" else 0)
         slot_bytes = sum(x.nbytes for x in
                          jax.tree_util.tree_leaves(self._caches)) // S
         pool_slots = 0
@@ -787,7 +842,7 @@ class InferenceEngine:
                         slo_target=0.99, flight_recorder=None,
                         spec_k=None, draft=None, draft_decoder=None,
                         draft_prefix=None, draft_epoch=None,
-                        **decoder_kwargs):
+                        attn_impl=None, **decoder_kwargs):
         """Checkpoint → serving engine in one call
         (``prefix-symbol.json`` + ``prefix-NNNN.params``, the reference
         format): builds the :class:`Decoder` via
@@ -818,12 +873,14 @@ class InferenceEngine:
                    slo_ttft_ms=slo_ttft_ms,
                    slo_cadence_ms=slo_cadence_ms, slo_target=slo_target,
                    flight_recorder=flight_recorder, spec_k=spec_k,
-                   draft=draft, draft_decoder=draft_decoder)
+                   draft=draft, draft_decoder=draft_decoder,
+                   attn_impl=attn_impl)
 
     # -- compiled programs ----------------------------------------------
     def _make_step(self):
         dec = self._dec
         k_rounds = self.steps_per_round
+        impl = self.attn_impl
 
         def one_step(caches, state, params, aux):
             pos, tok, live, temp, keys, eos, last = state
@@ -831,7 +888,7 @@ class InferenceEngine:
             # logits for the next one (frozen slots rewrite their last
             # token in place — idempotent)
             logits, caches = dec._run_slots(params, aux, caches, pos,
-                                            tok[:, None])
+                                            tok[:, None], impl=impl)
             logits = logits[:, 0]
             nxt_pos = pos + 1
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -892,13 +949,14 @@ class InferenceEngine:
         token; rounds with NO drafts at all dispatch the plain decode
         program instead (the fallback path, counted)."""
         dec = self._dec
+        impl = self.attn_impl
 
         def verify(params, aux, caches, state, drafts, dlen):
             if not profiler.collecting():
                 self._compile_log.append("verify")
                 _TM_COMPILE_VERIFY.inc()
             return dec.verify_step_slots(params, aux, caches, state,
-                                         drafts, dlen)
+                                         drafts, dlen, impl=impl)
 
         return verify
 
@@ -909,13 +967,15 @@ class InferenceEngine:
         (``Decoder.draft_propose_slots``)."""
         ddec = self._draft_dec
         k = self.spec_k
+        impl = self.attn_impl
 
         def draft(params, aux, caches, pos, catchup, clen):
             if not profiler.collecting():
                 self._compile_log.append("draft")
                 _TM_COMPILE_DRAFT.inc()
             return ddec.draft_propose_slots(params, aux, caches, pos,
-                                            catchup, clen, k)
+                                            catchup, clen, k,
+                                            impl=impl)
 
         return draft
 
@@ -2309,6 +2369,7 @@ class InferenceEngine:
                 "flight_recorder": self.flight.retain,
                 "spec_k": self.spec_k,
                 "draft": self.spec_draft,
+                "attn_impl": self.attn_impl,
             },
             "requests": reqs,
         }

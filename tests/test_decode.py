@@ -300,50 +300,64 @@ def test_decode_int8_kv_cache():
                    cache_dtype=np.int8)._cache_int8
 
 
-def test_decode_gqa_kv_cache():
-    """Grouped-query attention decodes against a kv-head-sized cache
-    (the group factor smaller): greedy tokens equal the iterated
-    full-forward oracle, logits match at every step, and the grouped
-    path composes with cache_block, int8 caches, and rope."""
-    rng = np.random.RandomState(31)
+def _gqa_kv_cache_case(h, kv, extra, rng):
+    """One grouped-query decode identity case: kv-head-sized cache,
+    logits vs the iterated full-forward oracle at every step, blocked
+    reads byte-equal, int8 prefill within tolerance."""
     T = 12
-    # (heads, kv): kv=1 (MQA), kv==h (degenerate), and h=4/kv=2 — the
-    # regime where BOTH the kv axis and the group axis are non-trivial,
-    # which is what catches a (g, kv)-vs-(kv, g) head-order mixup in
-    # the grouped einsums
-    cases = [(HEADS, 1, {}), (HEADS, 2, {}), (4, 2, {}),
-             (HEADS, 1, dict(pos_encoding="rope")),
-             (4, 2, dict(pos_encoding="rope"))]
-    for h, kv, extra in cases:
-        sym = get_transformer_lm(VOCAB, num_layers=2, embed_dim=EMBED,
-                                 num_heads=h, impl="dense",
-                                 num_kv_heads=kv, **extra)
-        params = _init_params(sym, T, 2, rng)
-        dec = Decoder(sym, params, max_len=T)
-        assert dec.init_cache(2)[0][0].shape == (2, T, kv, EMBED // h)
+    sym = get_transformer_lm(VOCAB, num_layers=2, embed_dim=EMBED,
+                             num_heads=h, impl="dense",
+                             num_kv_heads=kv, **extra)
+    params = _init_params(sym, T, 2, rng)
+    dec = Decoder(sym, params, max_len=T)
+    assert dec.init_cache(2)[0][0].shape == (2, T, kv, EMBED // h)
 
-        toks = rng.randint(0, VOCAB, (2, T))
-        want = _full_logits(sym, params, toks)
-        caches = dec.init_cache(2)
-        got, caches = dec.prefill(caches, toks[:, :6])
-        np.testing.assert_allclose(np.asarray(got), want[:, :6],
-                                   rtol=1e-5, atol=1e-5)
-        for pos in range(6, T):
-            logits, caches = dec.step(caches, pos, toks[:, pos])
-            np.testing.assert_allclose(np.asarray(logits), want[:, pos],
-                                       rtol=1e-5, atol=1e-5, err_msg=str(pos))
+    toks = rng.randint(0, VOCAB, (2, T))
+    want = _full_logits(sym, params, toks)
+    caches = dec.init_cache(2)
+    got, caches = dec.prefill(caches, toks[:, :6])
+    np.testing.assert_allclose(np.asarray(got), want[:, :6],
+                               rtol=1e-5, atol=1e-5)
+    for pos in range(6, T):
+        logits, caches = dec.step(caches, pos, toks[:, pos])
+        np.testing.assert_allclose(np.asarray(logits), want[:, pos],
+                                   rtol=1e-5, atol=1e-5, err_msg=str(pos))
 
-        blocked = Decoder(sym, params, max_len=T, cache_block=4)
-        prompt = rng.randint(0, VOCAB, (2, 3))
-        np.testing.assert_array_equal(
-            np.asarray(blocked.generate(prompt, num_steps=7)),
-            np.asarray(dec.generate(prompt, num_steps=7)))
+    blocked = Decoder(sym, params, max_len=T, cache_block=4)
+    prompt = rng.randint(0, VOCAB, (2, 3))
+    np.testing.assert_array_equal(
+        np.asarray(blocked.generate(prompt, num_steps=7)),
+        np.asarray(dec.generate(prompt, num_steps=7)))
 
-        q8 = Decoder(sym, params, max_len=T, cache_dtype="int8",
-                     cache_block=4)
-        got8, _ = q8.prefill(q8.init_cache(2), toks[:, :6])
-        np.testing.assert_allclose(np.asarray(got8), want[:, :6],
-                                   atol=0.05)
+    q8 = Decoder(sym, params, max_len=T, cache_dtype="int8",
+                 cache_block=4)
+    got8, _ = q8.prefill(q8.init_cache(2), toks[:, :6])
+    np.testing.assert_allclose(np.asarray(got8), want[:, :6],
+                               atol=0.05)
+
+
+def test_decode_gqa_kv_cache_core():
+    """Grouped-query attention decodes against a kv-head-sized cache:
+    the h=4/kv=2 + rope case — the regime where BOTH the kv axis and
+    the group axis are non-trivial, which is what catches a
+    (g, kv)-vs-(kv, g) head-order mixup in the grouped einsums — stays
+    tier-1; the full (heads, kv) sweep moved to the slow sweep (PR 11
+    budget relief, PR 4/5/9/10 precedent; further tier-1 GQA coverage:
+    test_transformer_gqa_lm_trains and test_paged_attention's
+    GQA+rope decoder-level identity)."""
+    _gqa_kv_cache_case(4, 2, dict(pos_encoding="rope"),
+                       np.random.RandomState(31))
+
+
+@pytest.mark.slow
+def test_decode_gqa_kv_cache():
+    """The remaining (heads, kv) grid: kv=1 (MQA), kv==h (degenerate),
+    h=4/kv=2 plain, MQA+rope — each the same oracle gauntlet as the
+    tier-1 core case."""
+    rng = np.random.RandomState(31)
+    for h, kv, extra in [(HEADS, 1, {}), (HEADS, 2, {}), (4, 2, {}),
+                         (HEADS, 1, dict(pos_encoding="rope"))]:
+        _gqa_kv_cache_case(h, kv, extra, rng)
 
 
 @pytest.mark.slow

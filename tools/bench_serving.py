@@ -18,6 +18,12 @@ hit-rate axis shows where prefix-copy reuse starts paying off over
 re-prefilling, the chunk axis what bounding decode stalls costs in
 throughput. ``--no-prefix-sweep`` skips it.
 
+``--attn-impls dense paged`` adds one ``bench.bench_serving`` cell per
+attention impl (ISSUE 11): the dense whole-cache read vs the Pallas
+paged kernel that walks only each slot's live KV rows, same stream per
+seed — each cell reports tokens/s, cadence p50/p99, and the decode
+program's ``bytes_accessed`` per dispatch (the traffic-cut metric).
+
 ``--spec-ks`` adds a third sweep over ``bench.bench_serving_spec``
 (repetition-friendly few-shot-style workload): one cell per draft
 length K (0 = speculation off), same stream per seed, reporting
@@ -93,6 +99,13 @@ def main():
     ap.add_argument("--spec-requests", type=int, default=32,
                     help="requests per speculation-sweep cell")
     ap.add_argument("--no-spec-sweep", action="store_true")
+    ap.add_argument("--attn-impls", nargs="+", default=[],
+                    help="attention-impl sweep axis (e.g. dense "
+                         "paged): one bench_serving cell per impl at "
+                         "the first slots/arrival setting — paged = "
+                         "the Pallas live-row kernel; cells report "
+                         "tokens/s, cadence p50/p99, and the decode "
+                         "program's bytes_accessed per dispatch")
     args = ap.parse_args()
 
     import bench
@@ -176,6 +189,23 @@ def main():
                      "compile_programs")}
             out["spec_k%d" % k] = cell
             print("spec_k%d: %r" % (k, cell), file=sys.stderr)
+    # attention-impl sweep (ISSUE 11): dense whole-cache reads vs the
+    # Pallas paged kernel on the same stream/seed — the
+    # bytes_accessed cell is the per-dispatch decode traffic from the
+    # XLA cost analysis (the honest CPU metric; wall clock under the
+    # Pallas interpreter under-sells the kernel)
+    for impl in args.attn_impls:
+        r = bench.bench_serving(
+            slots=args.slots[0], layers=args.layers, embed=args.embed,
+            heads=args.heads, vocab=args.vocab, max_len=args.max_len,
+            n_requests=args.requests, seed=3,
+            arrival_ms=args.arrival_ms[0], attn_impl=impl)
+        cell = {k: r[k] for k in
+                ("tokens_per_sec", "p50_ms_per_token",
+                 "p99_ms_per_token", "decode_bytes_accessed",
+                 "compile_programs")}
+        out["impl_%s" % impl] = cell
+        print("impl_%s: %r" % (impl, cell), file=sys.stderr)
     print(json.dumps(out, sort_keys=True))
 
 

@@ -128,6 +128,26 @@ def print_serving(snap, out=None):
                      s.get("slo_cadence_burn_1m", 0),
                      s.get("slo_cadence_burn_5m", 0),
                      s.get("slo_cadence_burn_1h", 0)))
+    # attention impl + decode memory traffic (ISSUE 11): the
+    # serving.attn_impl info gauge names the cache-read strategy; the
+    # PR 9 program gauges give the decode program's bytes per
+    # dispatched round, and tokens/rounds approximates tokens per
+    # dispatch — their quotient is the ~bytes/token the paged kernel
+    # exists to cut (compare a dense and a paged snapshot directly)
+    impl_g = s.get("attn_impl")
+    prog = snap.get("program") if isinstance(snap, dict) else None
+    decp = (prog or {}).get("serving_decode", {})
+    ba = decp.get("bytes_accessed")
+    if impl_g is not None or ba is not None:
+        rounds = s.get("rounds", 0)
+        toks = s.get("tokens", 0)
+        per_tok = ("%.3g" % (ba * rounds / toks)
+                   if ba and rounds and toks else "n/a")
+        out.write("attention:        impl=%s decode bytes_accessed=%s"
+                  "/dispatch ~%s/token\n"
+                  % ("n/a" if impl_g is None
+                     else ("paged" if impl_g else "dense"),
+                     "n/a" if ba is None else "%.6g" % ba, per_tok))
     out.write("compiles:         decode=%s prefill=%s copy=%s\n"
               % (s.get("compiles_decode", 0),
                  s.get("compiles_prefill", 0),
