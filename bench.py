@@ -906,6 +906,218 @@ def bench_serving_overload(slots=16, layers=12, embed=768, heads=12,
     }
 
 
+def bench_serving_replay(slots=8, layers=12, embed=768, heads=12,
+                         vocab=32000, max_len=1024, n_requests=64,
+                         seed=0, burst=6, burst_gap_ms=80.0,
+                         shared_len=96, tail_len=16, long_len=384,
+                         out_tokens=(24, 32, 48), chunk=128,
+                         spec_k=4, steps_per_round=8,
+                         prefix_cache_mb=256):
+    """Day-in-the-life replay arm (ISSUE 13, the capture/replay bench
+    ROADMAP item 5 asks for): capture a BURSTY mixed-traffic run once
+    — arrivals in synchronized bursts of ``burst`` (the p99-hostile
+    shape Poisson smooths away), a mix of shared-prefix, long-prompt
+    and short unique requests — then replay the SAME capture with
+    ``tools/replay_serving.py``'s machinery on fresh engines per
+    config, ``verify`` on: every replay must reproduce the captured
+    tokens byte-identically while the config under test (speculation
+    off; chunking off) moves only the latencies.
+
+    The record run serves with the full stack armed (prefix cache +
+    chunked prefill + n-gram speculation + capture). Reported per
+    arm: tokens/s, TTFT p50, cadence p99, verified counts (asserted
+    complete), and the compile contract. ``capture_overhead_frac``
+    is a clean A/B of the rolling tape: the same-config WARM replay
+    with capture off vs an identical warm replay with capture armed
+    (same schedule, same prefix-cache state — comparing against the
+    record run instead would confound capture cost with cache
+    warmth)."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+    from mxnet_tpu.models import get_transformer_lm
+    from mxnet_tpu.parallel import Decoder
+    from mxnet_tpu.serving import InferenceEngine, load_capture
+    from tools import replay_serving
+
+    sym = get_transformer_lm(vocab, num_layers=layers, embed_dim=embed,
+                             num_heads=heads, impl="flash")
+    rng = np.random.RandomState(seed)
+    shapes = {"data": (8, max_len), "softmax_label": (8, max_len)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    params = {n: jnp.asarray(rng.uniform(-0.05, 0.05, sh)
+                             .astype(np.float32))
+              for n, sh in zip(sym.list_arguments(), arg_shapes)
+              if n not in shapes}
+    buckets = tuple(b for b in (64, 128, 256, 512) if b <= max_len) \
+        or (max_len,)
+    shared_len = min(shared_len, max_len // 4)
+    long_len = min(long_len, max_len // 2)
+    chunk = min(chunk, buckets[-1])
+
+    def decoder():
+        return Decoder(sym, params, max_len=max_len,
+                       compute_dtype="bfloat16", cache_block=None)
+
+    base_cfg = dict(slots=slots, prefill_buckets=buckets,
+                    max_queue=4 * max(slots, burst),
+                    steps_per_round=steps_per_round,
+                    prefix_cache_mb=prefix_cache_mb,
+                    prefill_chunk=chunk, draft="ngram", spec_k=spec_k)
+
+    wl_rng = np.random.RandomState(seed + 1)
+    shared = wl_rng.randint(0, vocab, (shared_len,))
+
+    def workload(n, rs):
+        """Bursty mixed day-in-the-life traffic: arrival offsets come
+        in bursts (every member of a burst arrives at the same
+        instant), prompts mix shared-prefix / long / short-unique."""
+        reqs, arrivals, t = [], [], 0.0
+        for i in range(n):
+            if i % burst == 0 and i:
+                t += float(rs.exponential(burst_gap_ms * 1e-3))
+            arrivals.append(t)
+            u = rs.uniform()
+            if u < 0.5:
+                p = np.concatenate(
+                    [shared, rs.randint(0, vocab, (tail_len,))])
+            elif u < 0.75:
+                p = rs.randint(0, vocab, (long_len,))
+            else:
+                p = rs.randint(0, vocab, (tail_len * 3,))
+            reqs.append((p, int(rs.choice(out_tokens))))
+        return reqs, arrivals
+
+    cap_dir = tempfile.mkdtemp(prefix="mx_bench_capture_")
+    try:
+        engine = InferenceEngine(decoder(), capture_dir=cap_dir,
+                                 **base_cfg)
+        # warmup compiles every program family up front (captured too
+        # — the replay arms then re-serve the warmup, which keeps the
+        # record-vs-replay comparison apples-to-apples); the shared
+        # prefix is served once so the timed run starts with the
+        # cache warm, like bench_serving_prefix
+        wrs = np.random.RandomState(seed + 2)
+        for b in buckets:
+            engine.submit(wrs.randint(0, vocab, (min(b - 8,
+                                                     max_len - 64),)),
+                          max_tokens=8)
+        engine.submit(np.concatenate(
+            [shared, wrs.randint(0, vocab, (tail_len,))]),
+            max_tokens=8)
+        engine.serve_forever()
+
+        reqs, arrivals = workload(n_requests,
+                                  np.random.RandomState(seed + 3))
+        t0 = time.perf_counter()
+        handles, i = [], 0
+        while i < len(reqs) or not engine.idle:
+            now = time.perf_counter() - t0
+            while i < len(reqs) and arrivals[i] <= now \
+                    and engine.queued() < engine.max_queue:
+                prompt, mt = reqs[i]
+                handles.append(engine.submit(prompt, max_tokens=mt))
+                i += 1
+            engine.step()
+        dt = time.perf_counter() - t0
+        toks = sum(len(h.tokens) for h in handles)
+        cc = engine.compile_counts
+        assert cc["decode"] == 1 and cc["verify"] <= 1 \
+            and all(v == 1 for v in cc["prefill"].values()) \
+            and all(v == 1 for v in cc["copy"].values()), \
+            "compile-count contract violated: %r" % (cc,)
+        cap_path = engine.capture.path
+        cap_bytes = engine.capture.bytes_written
+        engine.close()
+        cap = load_capture(cap_path)
+        # record-run throughput measured from the CAPTURE itself, over
+        # the full captured timeline (warmup included) — the same
+        # window and submit stream the replay arms span, so
+        # capture_overhead_frac diffs like against like; `toks`/`dt`
+        # from the timed loop above cover only the bursty window and
+        # would over-read the record run by the warmup gap
+        first_t = min(s["t"] for s in cap["submits"])
+        last_t = max(r["t"] for r in cap["retires"].values())
+        rec_toks = sum(len(r["tokens"])
+                       for r in cap["retires"].values())
+        record = {
+            "tokens_per_sec": round(rec_toks / (last_t - first_t), 1),
+            "burst_window_tokens_per_sec": round(toks / dt, 1),
+            "requests": n_requests,
+            "capture_bytes": cap_bytes,
+            "capture_records": len(cap["submits"])
+            + len(cap["retires"]) + 1,
+            **replay_serving.recorded_latency(cap),
+        }
+
+        arms = {}
+        total_verified = total_mismatch = 0
+        for name, overrides in (
+                ("same_config", {}),
+                ("spec_off", {"draft": "off"}),
+                ("chunk_off", {"prefill_chunk": 0})):
+            eng = replay_serving.build_engine(cap, decoder(),
+                                              **overrides)
+            # two passes: the first pays this fresh engine's compiles
+            # inside the replay window (verify still on), the SECOND
+            # is the warm latency/throughput read — comparable to the
+            # record run, which also ran warmed (the compile contract
+            # pins that pass 2 added zero programs)
+            cold = replay_serving.replay(cap, eng, timing="recorded",
+                                         verify=True)
+            rep = replay_serving.replay(cap, eng, timing="recorded",
+                                        verify=True)
+            cc = eng.compile_counts
+            assert cc["decode"] == 1 and cc["verify"] <= 1 \
+                and all(v == 1 for v in cc["prefill"].values()) \
+                and all(v == 1 for v in cc["copy"].values()), \
+                "replay %s compile contract violated: %r" % (name, cc)
+            eng.close()
+            total_verified += rep["verified"] + rep["verified_prefix"]
+            total_mismatch += len(cold["mismatches"]) \
+                + len(rep["mismatches"])
+            arms[name] = {k: rep[k] for k in
+                          ("tokens_per_sec", "ttft_p50_ms",
+                           "cadence_p50_ms", "cadence_p99_ms",
+                           "verified", "verified_prefix",
+                           "verify_skipped")}
+            arms[name]["mismatches"] = len(rep["mismatches"])
+            arms[name]["cold_ttft_p50_ms"] = cold["ttft_p50_ms"]
+        assert total_mismatch == 0, \
+            "replay verify found %d mismatches" % total_mismatch
+        # capture-overhead A/B: the cost of the rolling tape measured
+        # like against like — same config, same recorded schedule,
+        # both on their WARM pass (the capture-off side is the
+        # same_config arm above; comparing either against the record
+        # run would confound capture cost with prefix-cache state,
+        # since a second service of the same stream takes hits the
+        # first never had). Positive = capture costs wall time.
+        cap2_dir = tempfile.mkdtemp(prefix="mx_bench_capture_ab_")
+        try:
+            eng_on = replay_serving.build_engine(cap, decoder(),
+                                                 capture_dir=cap2_dir)
+            replay_serving.replay(cap, eng_on, timing="recorded")
+            rep_on = replay_serving.replay(cap, eng_on,
+                                           timing="recorded")
+            eng_on.close()
+        finally:
+            shutil.rmtree(cap2_dir, ignore_errors=True)
+        same_tps = arms["same_config"]["tokens_per_sec"]
+        on_tps = rep_on["tokens_per_sec"]
+        return {
+            "record": record,
+            **arms,
+            "verified_total": total_verified,
+            "capture_on_warm_tokens_per_sec": on_tps,
+            "capture_overhead_frac":
+                None if not on_tps
+                else round(same_tps / on_tps - 1.0, 4),
+        }
+    finally:
+        shutil.rmtree(cap_dir, ignore_errors=True)
+
+
 def bench_recordio_io():
     """C++ ImageRecordIOIter: run tools/bench_io.py in a CLEAN
     subprocess (no jax): on this 1-core container the jax/axon runtime
@@ -1055,7 +1267,16 @@ def bench_telemetry_overhead(batch=256, chain_steps=10, pairs=40,
     (the deployed configuration: a Prometheus scraper is always
     there). The scraper load lands on BOTH configs — the contract
     stays "collection costs <= 2% of the step", now measured under
-    live exposition."""
+    live exposition. Since ISSUE 13 the serving traffic capture is
+    ALSO armed process-wide (``MXNET_SERVING_CAPTURE_DIR``) for the
+    A/B — capture writes ride the serving submit/retire paths, never
+    the train step, and this pins that arming the knob alone costs
+    the step path nothing (the serving-path cost of a ROLLING capture
+    is measured by ``bench_serving_replay``'s
+    ``capture_overhead_frac``)."""
+    import shutil
+    import tempfile
+
     import mxnet_tpu as mx
     from mxnet_tpu import telemetry as tele
 
@@ -1117,6 +1338,9 @@ def bench_telemetry_overhead(batch=256, chain_steps=10, pairs=40,
     scraper_thread = threading.Thread(target=scraper, daemon=True,
                                       name="bench-scraper")
     scraper_thread.start()
+    cap_dir = tempfile.mkdtemp(prefix="mx_bench_overhead_capture_")
+    prev_cap = os.environ.get("MXNET_SERVING_CAPTURE_DIR")
+    os.environ["MXNET_SERVING_CAPTURE_DIR"] = cap_dir
     try:
         chain()  # warmup/compile
         for attempt in range(3):
@@ -1139,6 +1363,11 @@ def bench_telemetry_overhead(batch=256, chain_steps=10, pairs=40,
         if own_server:
             tele.stop_server()
         pause.__exit__(None, None, None)
+        if prev_cap is None:
+            os.environ.pop("MXNET_SERVING_CAPTURE_DIR", None)
+        else:
+            os.environ["MXNET_SERVING_CAPTURE_DIR"] = prev_cap
+        shutil.rmtree(cap_dir, ignore_errors=True)
     assert overhead <= 0.02, (
         "telemetry-on fused step is %.2f%% slower than telemetry-off "
         "(budget: 2%%) — off %.3f ms/step, on %.3f ms/step "
@@ -1150,6 +1379,7 @@ def bench_telemetry_overhead(batch=256, chain_steps=10, pairs=40,
         "overhead_frac": round(overhead, 4),
         "asserted_within": 0.02,
         "exposition_server": True,
+        "capture_armed": True,
         "scrape_interval_s": scrape_interval_s,
         "scrapes": scrapes[0],
     }
@@ -1384,6 +1614,13 @@ def main():
     except Exception:
         traceback.print_exc()
         serving_paged = None
+    # capture/replay day-in-the-life (ISSUE 13): bursty mixed traffic
+    # captured once, replayed per config with byte-identity verified
+    try:
+        serving_replay = bench_serving_replay()
+    except Exception:
+        traceback.print_exc()
+        serving_replay = None
     def _dec_best_ms():
         if not dec_arms:
             return None
@@ -1453,6 +1690,21 @@ def main():
         "serving_prefix_cache_chunked_prefill": serving_prefix,
         "serving_speculative_decoding": serving_spec,
         "serving_paged_attention": serving_paged,
+        "serving_time_machine_replay": None if serving_replay is None
+        else {
+            **serving_replay,
+            "note": "bursty mixed traffic (bursts of 6, shared-prefix/"
+                    "long/short mix) captured once via "
+                    "MXNET_SERVING_CAPTURE_DIR machinery, then "
+                    "replayed at recorded inter-arrival gaps on fresh "
+                    "engines per config with --verify semantics: every "
+                    "arm reproduces the captured tokens "
+                    "byte-identically (asserted), only latencies move; "
+                    "capture_overhead_frac = record-run wall cost of "
+                    "the rolling tape vs the capture-off same-config "
+                    "replay; tools/replay_serving.py replays any "
+                    "production capture the same way",
+        },
         "serving_overload_shed_vs_block": None if serving_overload is None
         else {
             **serving_overload,
@@ -1571,6 +1823,12 @@ def main():
             "serving_paged_p99_ms":
                 None if serving_paged is None
                 else serving_paged["paged_fp"]["p99_ms_per_token"],
+            "serving_replay_verified":
+                None if serving_replay is None
+                else serving_replay["verified_total"],
+            "serving_replay_p99_ms":
+                None if serving_replay is None
+                else serving_replay["same_config"]["cadence_p99_ms"],
             "cifar10_img_per_sec":
                 None if cifar is None else round(cifar, 1),
             "cifar10_vs_gtx980":

@@ -1107,7 +1107,8 @@ class FeedForward(BASE_ESTIMATOR):
                           prefix_cache_mb=None, prefill_chunk=None,
                           overload=None, round_timeout_ms=None,
                           spec_k=None, draft=None, draft_decoder=None,
-                          attn_impl=None, **decoder_kwargs):
+                          attn_impl=None, capture_dir=None,
+                          **decoder_kwargs):
         """Trained estimator → continuous-batching inference engine
         (``mxnet_tpu.serving.InferenceEngine``, doc/serving.md): the
         online-serving analogue of :meth:`predict`. Works on a fitted
@@ -1152,6 +1153,7 @@ class FeedForward(BASE_ESTIMATOR):
                                round_timeout_ms=round_timeout_ms,
                                spec_k=spec_k, draft=draft,
                                draft_decoder=draft_decoder,
+                               capture_dir=capture_dir,
                                attn_impl=attn_impl)
 
     @staticmethod

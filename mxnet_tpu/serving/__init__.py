@@ -26,6 +26,7 @@ and a :meth:`InferenceEngine.close` shutdown path
 (:class:`EngineClosed`) — all host-side, the compiled program
 families above are frozen.
 """
+from .capture import CaptureStream, load_capture
 from .engine import (InferenceEngine, Request, EngineOverloaded,
                      EngineClosed, EngineStuck)
 from .flight import FlightRecorder
@@ -33,5 +34,6 @@ from .prefix import PrefixCache
 from .spec import NgramDrafter
 
 __all__ = ["InferenceEngine", "Request", "PrefixCache",
-           "FlightRecorder", "NgramDrafter",
+           "FlightRecorder", "NgramDrafter", "CaptureStream",
+           "load_capture",
            "EngineOverloaded", "EngineClosed", "EngineStuck"]

@@ -100,6 +100,7 @@ from .. import profiler
 from .. import telemetry as tele
 from ..io import StagedStream
 from ..parallel.decode import Decoder
+from .capture import CaptureStream
 from .flight import FlightRecorder
 from .prefix import PrefixCache
 from .spec import NgramDrafter
@@ -231,6 +232,36 @@ _SLO_CADENCE_WINDOWS = (
     (60.0, tele.gauge("serving.slo_cadence_burn_1m")),
     (300.0, tele.gauge("serving.slo_cadence_burn_5m")),
     (3600.0, tele.gauge("serving.slo_cadence_burn_1h")))
+# round-phase attribution (doc/observability.md "Round-phase
+# attribution"): where one step()'s wall time went. Every phase is a
+# same-thread perf_counter interval the step already brackets; "sched"
+# is the unattributed remainder (host scheduling — sweeps, queue
+# bookkeeping, chunk math), so the phases SUM to the round wall time
+# by construction. Sub-ms buckets: decode rounds are ms-scale.
+_PHASE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                  25.0, 50.0, 100.0, 500.0, 5000.0)
+_TM_PHASE = {
+    "sched": tele.histogram("serving.round_phase_ms.sched",
+                            buckets=_PHASE_BUCKETS),
+    "prefix_lookup": tele.histogram(
+        "serving.round_phase_ms.prefix_lookup",
+        buckets=_PHASE_BUCKETS),
+    "h2d": tele.histogram("serving.round_phase_ms.h2d",
+                          buckets=_PHASE_BUCKETS),
+    "prefill": tele.histogram("serving.round_phase_ms.prefill",
+                              buckets=_PHASE_BUCKETS),
+    "copy": tele.histogram("serving.round_phase_ms.copy",
+                           buckets=_PHASE_BUCKETS),
+    "dispatch": tele.histogram("serving.round_phase_ms.dispatch",
+                               buckets=_PHASE_BUCKETS),
+    "drain": tele.histogram("serving.round_phase_ms.drain",
+                            buckets=_PHASE_BUCKETS),
+}
+_TM_ROUND_WALL = tele.histogram("serving.round_wall_ms",
+                                buckets=_PHASE_BUCKETS)
+# bounded per-engine ledger of recent rounds (GET /rounds); the
+# histograms above are the fleet view, the ledger is the incident view
+_ROUND_LEDGER = 256
 
 
 class Request:
@@ -529,6 +560,21 @@ class InferenceEngine:
         wrapped positions); prefill keeps the dense bucketed programs
         (compute-bound, traced start). ``snapshot()``/``restore()``
         carry the knob. doc/serving.md "Paged attention".
+    capture_dir : str, optional
+        Traffic capture (the serving time machine's record half —
+        doc/observability.md): when set (default: the
+        ``MXNET_SERVING_CAPTURE_DIR`` env var, else off), the engine
+        appends a crash-safe JSONL record per accepted submit (arrival
+        time, prompt, sampling identity, deadlines) and per retirement
+        (emitted tokens, reason, TTFT/cadence) to its own
+        ``mx_capture_<pid>_<n>.jsonl`` in this directory, size-bounded
+        by ``MXNET_SERVING_CAPTURE_MB`` (default 64; ``capture_mb``
+        overrides). ``tools/replay_serving.py`` replays a capture
+        byte-identically on a fresh engine — any config change can be
+        validated offline against yesterday's traffic
+        (``--verify``). Flushed per record: a killed process leaves a
+        readable log. ``snapshot()`` carries the knob, so capture
+        continues across a crash cycle (fresh file, same directory).
     """
 
     def __init__(self, decoder, slots=8, prefill_buckets=None,
@@ -538,7 +584,8 @@ class InferenceEngine:
                  round_timeout_ms=None, slo_ttft_ms=None,
                  slo_cadence_ms=None, slo_target=0.99,
                  flight_recorder=None, spec_k=None, draft=None,
-                 draft_decoder=None, attn_impl=None):
+                 draft_decoder=None, attn_impl=None, capture_dir=None,
+                 capture_mb=None):
         if not isinstance(decoder, Decoder):
             raise MXNetError("InferenceEngine needs a Decoder, got %r"
                              % type(decoder).__name__)
@@ -548,6 +595,7 @@ class InferenceEngine:
                 "cache_block prefix-bounded reads (per-slot positions); "
                 "build the Decoder with cache_block=None")
         self._dec = decoder
+        self._t0 = time.perf_counter()   # ledger/capture time origin
         self.max_len = decoder.max_len
         self.slots = int(slots)
         if self.slots < 1:
@@ -829,6 +877,22 @@ class InferenceEngine:
         self._last_ok_t = time.perf_counter()
         self._watchdog_stuck_t = None
         self._prog_seen = set()
+        # round-phase attribution: _phase is the accumulator dict
+        # while a step() is in flight (instrumented sites add their
+        # same-thread perf_counter intervals), _rounds the bounded
+        # ledger GET /rounds reads
+        self._phase = None
+        self._rounds = collections.deque(maxlen=_ROUND_LEDGER)
+        self._round_no = 0
+        # traffic capture: opened LAST so the header carries the final
+        # geometry (windowed-ring fallbacks included); a disabled
+        # stream (knob unset) is a no-op on every path
+        self.capture = CaptureStream.open(
+            capture_dir, capture_mb,
+            dict(self._geometry(), max_len=self.max_len), self._t0)
+        # resolved (env default included) so snapshot() carries it
+        self.capture_dir = os.path.dirname(self.capture.path) \
+            if self.capture.enabled else None
         _ENGINES.add(self)
 
     # -- construction ---------------------------------------------------
@@ -842,7 +906,8 @@ class InferenceEngine:
                         slo_target=0.99, flight_recorder=None,
                         spec_k=None, draft=None, draft_decoder=None,
                         draft_prefix=None, draft_epoch=None,
-                        attn_impl=None, **decoder_kwargs):
+                        attn_impl=None, capture_dir=None,
+                        **decoder_kwargs):
         """Checkpoint → serving engine in one call
         (``prefix-symbol.json`` + ``prefix-NNNN.params``, the reference
         format): builds the :class:`Decoder` via
@@ -874,7 +939,7 @@ class InferenceEngine:
                    slo_cadence_ms=slo_cadence_ms, slo_target=slo_target,
                    flight_recorder=flight_recorder, spec_k=spec_k,
                    draft=draft, draft_decoder=draft_decoder,
-                   attn_impl=attn_impl)
+                   attn_impl=attn_impl, capture_dir=capture_dir)
 
     # -- compiled programs ----------------------------------------------
     def _make_step(self):
@@ -1112,11 +1177,13 @@ class InferenceEngine:
         """Bucket ``length`` and dispatch the copy program (prefix-hit
         admission or retention insert)."""
         bucket = self._bucket_for(length)
+        tc0 = time.perf_counter()
         with tele.span("serving.prefix_copy", cat="serving",
                        bucket=bucket, to_pool=bool(dst_pool)):
             self._caches, self._pool = self._copy_fn(bucket)(
                 self._caches, self._pool, np.int32(src), np.int32(dst),
                 np.bool_(src_pool), np.bool_(dst_pool))
+        self._phase_add("copy", time.perf_counter() - tc0)
         if ("copy", bucket) not in self._prog_seen:
             self._prog_seen.add(("copy", bucket))
             profiler.register_program(
@@ -1177,6 +1244,16 @@ class InferenceEngine:
         the error rides the staged tuple to admission, where the
         request retires with reason ``"error"`` instead of unwinding
         ``step()`` from inside the stager fill."""
+        th0 = time.perf_counter()
+        try:
+            return self._place_prompt_inner(req)
+        finally:
+            # the stager is inline, so fills run inside _admit and the
+            # time lands on the round in flight (the _phase guard
+            # drops it when no round is)
+            self._phase_add("h2d", time.perf_counter() - th0)
+
+    def _place_prompt_inner(self, req):
         try:
             p = len(req.seq)
             if (self.prefill_chunk and p > self.prefill_chunk) \
@@ -1336,6 +1413,7 @@ class InferenceEngine:
         if req._deadline is not None or req._ttft_deadline is not None:
             self._watched.add(rid)
         self.stats["submitted"] += 1
+        self.capture.submit(req)
         if self.flight.enabled:
             meta = {"prompt_len": int(prompt.size),
                     "max_tokens": max_tokens}
@@ -1403,6 +1481,7 @@ class InferenceEngine:
         if self.slo_ttft_ms is not None and req.t_first is None \
                 and reason == "deadline":
             _TM_SLO_TTFT_MISS.inc()
+        self.capture.retire(req)
         if reason == "deadline":
             _TM_DEADLINE.inc()
             self.stats["deadline_missed"] += 1
@@ -1544,10 +1623,13 @@ class InferenceEngine:
             try:
                 hit, entry, depth = 0, None, 0
                 if self._prefix is not None:
+                    tl0 = time.perf_counter()
                     with tele.span("serving.prefix_lookup",
                                    cat="serving",
                                    hist=_TM_PREFIX_LOOKUP_MS):
                         depth, entry = self._prefix.lookup(req.seq)
+                    self._phase_add("prefix_lookup",
+                                    time.perf_counter() - tl0)
                     # a FULL hit still re-prefills the last prompt
                     # token: the cache retains K/V only, and the first
                     # generated token needs the last position's logits
@@ -1650,6 +1732,7 @@ class InferenceEngine:
         p = len(req.seq)
         start = 0
         top = self.prefill_buckets[-1]
+        td0 = time.perf_counter()
         while start < p:
             piece = min(p - start, top)
             bucket = self._bucket_for(piece)
@@ -1660,6 +1743,7 @@ class InferenceEngine:
                 self._draft_caches, np.int32(slot), chunk,
                 np.int32(start), np.int32(piece))
             start += piece
+        self._phase_add("prefill", time.perf_counter() - td0)
         self._draft_pos[slot] = p
         self._draft_pending[slot] = []
 
@@ -1710,6 +1794,7 @@ class InferenceEngine:
             chunk[0, :piece] = req.seq[start:start + piece]
             dev = chunk
         fn = self._prefill_fn(bucket)
+        tp0 = time.perf_counter()
         with tele.span("serving.prefill", cat="serving", bucket=bucket,
                        slot=slot, start=start):
             self._caches, self._state, t0 = fn(
@@ -1719,6 +1804,7 @@ class InferenceEngine:
                 _raw_key(req.seed),
                 np.int32(-1 if req.eos_id is None else req.eos_id),
                 np.int32(req.limit - req.resumed))
+        self._phase_add("prefill", time.perf_counter() - tp0)
         if ("prefill", bucket) not in self._prog_seen:
             self._prog_seen.add(("prefill", bucket))
             # post-dispatch arrays carry the same avals the dispatch
@@ -1835,6 +1921,7 @@ class InferenceEngine:
             self._watched.discard(req.id)
             self._release_slot(slot)
             self.stats["completed"] += 1
+            self.capture.retire(req)
             self.flight.retire(req.id, req.retire_reason,
                                tokens=len(req.tokens))
             self._done_buf.append(req)
@@ -1865,7 +1952,21 @@ class InferenceEngine:
                     % self.round_timeout_ms)
             time.sleep(0.001)
 
+    def _phase_add(self, key, dt):
+        """Attribute ``dt`` seconds of the in-flight round to a phase
+        (no-op outside step() — e.g. a submit-path capture write)."""
+        acc = self._phase
+        if acc is not None:
+            acc[key] = acc.get(key, 0.0) + dt
+
     def _drain_one(self):
+        t0 = time.perf_counter()
+        try:
+            self._drain_one_inner()
+        finally:
+            self._phase_add("drain", time.perf_counter() - t0)
+
+    def _drain_one_inner(self):
         entry = self._drain[0]       # peek: a watchdog trip must not
         self._guard_ready(entry[3] if entry[0] == "prefill"
                           else entry[1])  # lose the undrained round
@@ -1973,11 +2074,13 @@ class InferenceEngine:
         ndraft = int(dlen.sum())
         self.stats["spec_drafted"] += ndraft
         _TM_SPEC_DRAFTED.inc(ndraft)
+        tv0 = time.perf_counter()
         with tele.span("serving.verify_round", cat="serving",
                        slots_busy=busy, drafted=ndraft):
             self._caches, self._state, out = self._verify_fn(
                 self._dec._params, self._dec._aux, self._caches,
                 self._state, drafts, dlen)
+        self._phase_add("dispatch", time.perf_counter() - tv0)
         if "verify" not in self._prog_seen:
             self._prog_seen.add("verify")
             profiler.register_program(
@@ -2035,9 +2138,11 @@ class InferenceEngine:
                         again = True
                     else:
                         newly_done.append(s)
+            tdf0 = time.perf_counter()
             self._draft_caches, props = self._draft_fn(
                 dd._params, dd._aux, self._draft_caches, pos, catchup,
                 clen)
+            self._phase_add("dispatch", time.perf_counter() - tdf0)
             if "draft" not in self._prog_seen:
                 self._prog_seen.add("draft")
                 profiler.register_program(
@@ -2066,77 +2171,157 @@ class InferenceEngine:
         ``drain_depth`` dispatches old (all of them once nothing is in
         flight). Returns the requests that finished since the last
         round — normal completions AND host retirements (check
-        ``retire_reason``) — in completion order."""
+        ``retire_reason``) — in completion order.
+
+        Every non-idle round also lands a row in the bounded
+        round-phase ledger (:meth:`round_table`, ``GET /rounds``) and
+        feeds the ``serving.round_phase_ms.*`` histograms: the round's
+        wall time decomposed into drain / prefix lookup / h2d staging /
+        prefill / copy / decode-verify dispatch, with host scheduling
+        as the exact remainder — the phases sum to the round wall time
+        by construction (doc/observability.md "Round-phase
+        attribution")."""
         self._check_open()
-        if self._spec and self._drain:
-            # speculation drains EAGERLY: drafting needs the current
-            # context (the n-gram drafter and the draft-model catch-up
-            # read drained tokens) and exact per-slot positions; the
-            # tokens-per-dispatch the verify step buys replaces the
-            # drain-lag pipelining drain_depth bought (doc/serving.md)
-            while self._drain:
+        rt0 = time.perf_counter()
+        self._phase = {}
+        dispatched = None
+        try:
+            if self._spec and self._drain:
+                # speculation drains EAGERLY: drafting needs the
+                # current context (the n-gram drafter and the
+                # draft-model catch-up read drained tokens) and exact
+                # per-slot positions; the tokens-per-dispatch the
+                # verify step buys replaces the drain-lag pipelining
+                # drain_depth bought (doc/serving.md)
+                while self._drain:
+                    self._drain_one()
+            self._sweep()
+            # chunked prefill, Sarathi-style per-round budget: at most
+            # ~prefill_chunk tokens of prefill work run between decode
+            # rounds — ONE piece of the oldest parked request, then
+            # admissions' first pieces until the budget is spent
+            # (_admit holds the overflow request for next round).
+            # Resident decoders therefore stall at most one budget's
+            # worth of prefill per round, however many long prompts
+            # are in flight.
+            self._round_budget = self.prefill_chunk or float("inf")
+            if self._chunking:
+                st = self._chunking.popleft()
+                try:
+                    if not self._advance_chunk(st):
+                        self._chunking.append(st)
+                except Exception as e:   # noqa: BLE001 — poisoned
+                    self._poison(st, e)
+            admitted = self._admit()
+            busy = self.slots - len(self._free)
+            _TM_OCCUPANCY.set(busy)
+            if admitted or busy:
+                # zero-admission rounds COUNT while work is resident
+                # (they are what admission starvation looks like — the
+                # histogram's 0 bucket exists for them); only
+                # fully-idle polls are not a scheduling round
+                _TM_ADMITTED.observe(admitted)
+            # slots still mid-prefill have nothing to decode: a round
+            # with ONLY those resident would be pure wasted dispatch
+            if busy - len(self._chunking) > 0:
+                if self._spec and self._spec_round(busy):
+                    dispatched = "verify"
+                else:
+                    if self._spec:
+                        # speculation armed but no slot had a usable
+                        # draft (cold context, budget exhausted, or a
+                        # slot too near the cache end for the chunk
+                        # write): plain decode serves the round
+                        _TM_SPEC_FALLBACK.inc()
+                        self.stats["spec_fallback_rounds"] += 1
+                    td0 = time.perf_counter()
+                    with tele.span("serving.decode_round",
+                                   cat="serving", slots_busy=busy):
+                        self._caches, self._state, out = self._step_fn(
+                            self._dec._params, self._dec._aux,
+                            self._caches, self._state)
+                    self._phase_add("dispatch",
+                                    time.perf_counter() - td0)
+                    dispatched = "decode"
+                    if "decode" not in self._prog_seen:
+                        self._prog_seen.add("decode")
+                        profiler.register_program(
+                            "serving_decode", self._step_fn,
+                            (self._dec._params, self._dec._aux,
+                             self._caches, self._state))
+                    self._drain.append(("step", out))
+                    self.stats["steps"] += 1
+                    _TM_ROUNDS.inc()
+                    _TM_SLOTS_BUSY.observe(busy)
+                    flt = _SERVING_FAULTS
+                    if flt is not None:
+                        flt.serving_crash()   # injected process death
+            while len(self._drain) > (self._drain_depth if self._busy()
+                                      else 0):
                 self._drain_one()
-        self._sweep()
-        # chunked prefill, Sarathi-style per-round budget: at most
-        # ~prefill_chunk tokens of prefill work run between decode
-        # rounds — ONE piece of the oldest parked request, then
-        # admissions' first pieces until the budget is spent (_admit
-        # holds the overflow request for next round). Resident
-        # decoders therefore stall at most one budget's worth of
-        # prefill per round, however many long prompts are in flight.
-        self._round_budget = self.prefill_chunk or float("inf")
-        if self._chunking:
-            st = self._chunking.popleft()
-            try:
-                if not self._advance_chunk(st):
-                    self._chunking.append(st)
-            except Exception as e:   # noqa: BLE001 — poisoned request
-                self._poison(st, e)
-        admitted = self._admit()
-        busy = self.slots - len(self._free)
-        _TM_OCCUPANCY.set(busy)
-        if admitted or busy:
-            # zero-admission rounds COUNT while work is resident (they
-            # are what admission starvation looks like — the histogram's
-            # 0 bucket exists for them); only fully-idle polls are
-            # not a scheduling round
-            _TM_ADMITTED.observe(admitted)
-        # slots still mid-prefill have nothing to decode: a round with
-        # ONLY those resident would be pure wasted dispatch
-        if busy - len(self._chunking) > 0 \
-                and not (self._spec and self._spec_round(busy)):
-            if self._spec:
-                # speculation armed but no slot had a usable draft
-                # (cold context, budget exhausted, or a slot too near
-                # the cache end for the chunk write): plain decode
-                # serves the round
-                _TM_SPEC_FALLBACK.inc()
-                self.stats["spec_fallback_rounds"] += 1
-            with tele.span("serving.decode_round", cat="serving",
-                           slots_busy=busy):
-                self._caches, self._state, out = self._step_fn(
-                    self._dec._params, self._dec._aux,
-                    self._caches, self._state)
-            if "decode" not in self._prog_seen:
-                self._prog_seen.add("decode")
-                profiler.register_program(
-                    "serving_decode", self._step_fn,
-                    (self._dec._params, self._dec._aux, self._caches,
-                     self._state))
-            self._drain.append(("step", out))
-            self.stats["steps"] += 1
-            _TM_ROUNDS.inc()
-            _TM_SLOTS_BUSY.observe(busy)
-            flt = _SERVING_FAULTS
-            if flt is not None:
-                flt.serving_crash()  # injected mid-round process death
-        while len(self._drain) > (self._drain_depth if self._busy()
-                                  else 0):
-            self._drain_one()
-        self._last_ok_t = time.perf_counter()
-        self._slo_tick(self._last_ok_t)
+            self._last_ok_t = time.perf_counter()
+            self._slo_tick(self._last_ok_t)
+            self._record_round(rt0, busy, admitted, dispatched)
+        finally:
+            self._phase = None
         done_now, self._done_buf = self._done_buf, []
         return done_now
+
+    def _record_round(self, rt0, busy, admitted, dispatched):
+        """Land the finished round in the phase ledger + histograms.
+        Pure-idle polls (nothing resident, admitted, or drained) are
+        not scheduling rounds and are skipped; an aborted round (a
+        watchdog trip unwinding step()) records nothing — its drain
+        retries next round."""
+        acc = self._phase
+        wall = time.perf_counter() - rt0
+        if not (admitted or busy or acc):
+            return
+        # host scheduling = the unattributed remainder (sweep, queue
+        # bookkeeping, chunk math, drafter proposals). The attributed
+        # phases are disjoint same-thread intervals inside
+        # [rt0, now], so the remainder is >= 0 up to float error —
+        # clamped, and the phases sum to wall_ms exactly.
+        acc["sched"] = max(0.0, wall - sum(acc.values()))
+        phases_ms = {k: round(v * 1e3, 4) for k, v in acc.items()}
+        for k, v in phases_ms.items():
+            _TM_PHASE[k].observe(v)
+        _TM_ROUND_WALL.observe(wall * 1e3)
+        self._round_no += 1
+        self._rounds.append({
+            "round": self._round_no,
+            "t_s": round(rt0 - self._t0, 4),
+            "wall_ms": round(wall * 1e3, 4),
+            "slots_busy": busy,
+            "admitted": admitted,
+            "dispatched": dispatched,
+            "phases_ms": phases_ms,
+        })
+
+    def round_table(self, n=None):
+        """The last ``n`` (default: all retained, bounded at 256)
+        round-phase ledger rows, oldest first — what ``GET /rounds``
+        serves. Plain dicts: round number, start time (s since engine
+        construction), wall ms, occupancy, admissions, which program
+        the round dispatched (``decode``/``verify``/None), and the
+        per-phase ms decomposition (summing to ``wall_ms``)."""
+        # exposition-server threads read while the engine thread
+        # appends; deque APPEND is atomic but ITERATION over a
+        # mutating deque raises RuntimeError — retry instead of
+        # holding a lock on the per-round hot path (the window is one
+        # append; a scrape must never silently drop the engine)
+        for _ in range(8):
+            try:
+                rows = list(self._rounds)
+                break
+            except RuntimeError:
+                continue
+        else:
+            rows = []
+        if n is not None:
+            n = max(0, int(n))
+            rows = rows[-n:] if n else []
+        return [dict(r, phases_ms=dict(r["phases_ms"])) for r in rows]
 
     # -- observability plane (doc/observability.md) ---------------------
     def _slo_tick(self, now=None):
@@ -2308,6 +2493,7 @@ class InferenceEngine:
         self._held = None
         self._drain.clear()
         self._stager.close()
+        self.capture.close()
 
     def __enter__(self):
         return self
@@ -2352,26 +2538,38 @@ class InferenceEngine:
         return {
             "version": 1,
             "auto_seed": self._auto_seed,
-            "engine": {
-                "slots": self.slots,
-                "prefill_buckets": list(self.prefill_buckets),
-                "max_queue": self.max_queue,
-                "stage_depth": self.stage_depth,
-                "drain_depth": self._drain_depth,
-                "steps_per_round": self.steps_per_round,
-                "prefix_cache_mb": self.prefix_cache_mb,
-                "prefill_chunk": self.prefill_chunk,
-                "overload": self.overload,
-                "round_timeout_ms": self.round_timeout_ms,
-                "slo_ttft_ms": self.slo_ttft_ms,
-                "slo_cadence_ms": self.slo_cadence_ms,
-                "slo_target": self.slo_target,
-                "flight_recorder": self.flight.retain,
-                "spec_k": self.spec_k,
-                "draft": self.spec_draft,
-                "attn_impl": self.attn_impl,
-            },
+            "engine": self._geometry(),
             "requests": reqs,
+        }
+
+    def _geometry(self):
+        """Engine geometry as plain JSON — every constructor knob a
+        fresh engine needs to serve the same way. Shared by
+        :meth:`snapshot` (restore() feeds it back) and the traffic
+        capture's header (``tools/replay_serving.py`` rebuilds from
+        it). ``capture_dir`` rides along for the crash cycle
+        (None inside the capture header itself — it is written before
+        the knob resolves, and replay must not re-capture by
+        default)."""
+        return {
+            "slots": self.slots,
+            "prefill_buckets": list(self.prefill_buckets),
+            "max_queue": self.max_queue,
+            "stage_depth": self.stage_depth,
+            "drain_depth": self._drain_depth,
+            "steps_per_round": self.steps_per_round,
+            "prefix_cache_mb": self.prefix_cache_mb,
+            "prefill_chunk": self.prefill_chunk,
+            "overload": self.overload,
+            "round_timeout_ms": self.round_timeout_ms,
+            "slo_ttft_ms": self.slo_ttft_ms,
+            "slo_cadence_ms": self.slo_cadence_ms,
+            "slo_target": self.slo_target,
+            "flight_recorder": self.flight.retain,
+            "spec_k": self.spec_k,
+            "draft": self.spec_draft,
+            "attn_impl": self.attn_impl,
+            "capture_dir": getattr(self, "capture_dir", None),
         }
 
     @classmethod

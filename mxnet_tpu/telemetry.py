@@ -311,13 +311,18 @@ def reset():
         _state.trace_dropped = 0
 
 
-def snapshot():
+def snapshot(prefix=None):
     """Nested dict of every metric, keyed by the dotted name's
     segments: ``serving.ttft_ms`` lands at
     ``snap["serving"]["ttft_ms"]``. Counters/gauges are scalars,
-    histograms small dicts (count/sum/mean/min/max/p50/p99/buckets)."""
+    histograms small dicts (count/sum/mean/min/max/p50/p99/buckets).
+    ``prefix`` restricts to names starting with it (e.g.
+    ``"serving."`` — what ``/snapshot?prefix=serving.`` serves a
+    fleet scraper that only wants the serving subtree)."""
     with _state.lock:
         items = sorted(_state.metrics.items())
+    if prefix:
+        items = [(n, m) for n, m in items if n.startswith(prefix)]
     names = {name for name, _ in items}
     out = {}
     for name, m in items:
@@ -346,14 +351,19 @@ def snapshot():
 _PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
 
-def to_prometheus():
+def to_prometheus(prefix=None):
     """Prometheus text exposition of the registry (the shape a
     ``/metrics`` endpoint would serve). Dots become underscores;
     counters gain the conventional ``_total`` suffix; histograms emit
-    cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count``."""
+    cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count``.
+    ``prefix`` filters by DOTTED name prefix (pre-mangling:
+    ``prefix="serving."`` keeps every ``mxnet_serving_*`` family) —
+    the ``/metrics?prefix=`` subtree scrape."""
     lines = []
     with _state.lock:
         items = sorted(_state.metrics.items())
+    if prefix:
+        items = [(n, m) for n, m in items if n.startswith(prefix)]
     for name, m in items:
         base = "mxnet_" + _PROM_BAD.sub("_", name)
         if m.kind == "counter":

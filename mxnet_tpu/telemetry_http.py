@@ -3,15 +3,24 @@
 Until now every consumer of ``mx.telemetry`` lived INSIDE the process:
 ``snapshot()`` and ``to_prometheus()`` are Python calls. This module
 puts them on the wire — a stdlib ``http.server`` daemon thread serving
-five strictly read-only GET endpoints:
+strictly read-only GET endpoints:
 
 ``/metrics``
     Prometheus text exposition (``to_prometheus()``), refreshed with
     the best-effort program/device introspection gauges and the
     serving SLO burn rates before rendering — what a Prometheus
     scraper or the ROADMAP item 1 admission router polls.
+    ``?prefix=serving.`` restricts to one dotted-name subtree (a
+    fleet scraper pulling only the serving metrics).
 ``/snapshot``
-    ``snapshot()`` as JSON (non-finite floats serialized as null).
+    ``snapshot()`` as JSON (non-finite floats serialized as null);
+    honors the same ``?prefix=`` filter.
+``/rounds``
+    Recent round-phase ledgers across every engine: each serving
+    round's wall time decomposed into drain / prefix lookup / h2d /
+    prefill / copy / dispatch / host-scheduling phases
+    (``?n=<rows>``, default 64 per engine) — where a p99 round's
+    time actually went.
 ``/requests``
     Live + recently-retired serving request table across every engine
     in the process.
@@ -95,16 +104,39 @@ def _scrub(obj):
     return obj
 
 
-def _route(path):
-    """Dispatch one GET: returns (status, content_type, body bytes)."""
+def _route(path, query=None):
+    """Dispatch one GET: returns (status, content_type, body bytes).
+    ``query`` is the parsed query string (first value per key):
+    ``/metrics`` and ``/snapshot`` honor ``?prefix=<dotted-prefix>``
+    (a fleet scraper pulling only the ``serving.`` subtree),
+    ``/rounds`` honors ``?n=<rows>``."""
+    query = query or {}
+    prefix = query.get("prefix") or None
     if path in ("/metrics", "/metrics/"):
         _refresh()
         return (200, "text/plain; version=0.0.4; charset=utf-8",
-                telemetry.to_prometheus().encode())
+                telemetry.to_prometheus(prefix=prefix).encode())
     if path in ("/snapshot", "/snapshot/"):
         _refresh()
-        body = json.dumps(_scrub(telemetry.snapshot()), sort_keys=True)
+        body = json.dumps(_scrub(telemetry.snapshot(prefix=prefix)),
+                          sort_keys=True)
         return 200, "application/json", body.encode()
+    if path in ("/rounds", "/rounds/"):
+        # recent round-phase ledgers (read-only — the engine appends,
+        # this copies): one block per engine, newest rounds last
+        try:
+            n = max(1, int(query.get("n", 64)))
+        except (TypeError, ValueError):
+            n = 64
+        engines = []
+        for i, e in enumerate(_engines()):
+            try:
+                engines.append({"engine": i,
+                                "rounds": e.round_table(n)})
+            except Exception:
+                continue
+        return (200, "application/json",
+                json.dumps({"engines": _scrub(engines)}).encode())
     if path in ("/requests", "/requests/"):
         rows = []
         for e in _engines():
@@ -151,7 +183,8 @@ def _route(path):
     if path in ("/", ""):
         return (200, "application/json", json.dumps(
             {"endpoints": ["/metrics", "/snapshot", "/requests",
-                           "/flight/<request_id>", "/healthz"]}
+                           "/flight/<request_id>", "/rounds",
+                           "/healthz"]}
         ).encode())
     return (404, "application/json",
             json.dumps({"error": "unknown path %r" % path}).encode())
@@ -163,7 +196,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
     def do_GET(self):             # noqa: N802 — http.server contract
         try:
-            status, ctype, body = _route(self.path.split("?", 1)[0])
+            from urllib.parse import parse_qsl
+            path, _, qs = self.path.partition("?")
+            query = dict(parse_qsl(qs))
+            status, ctype, body = _route(path, query)
         except Exception as e:    # noqa: BLE001 — a scrape never kills
             _log.warning("telemetry http: %s handling %r", e, self.path)
             status, ctype = 500, "application/json"

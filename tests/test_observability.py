@@ -523,6 +523,196 @@ def test_metric_catalog_lint_detects_drift(tmp_path):
     assert stale == ["sub.gone_metric"]
 
 
+def test_env_knob_lint_is_clean():
+    """Every MXNET_* env var the package reads has a doc/env_var.md
+    row and every documented knob is still read somewhere — the knob
+    catalog can't rot either (ISSUE 13 satellite; the check found
+    MXNET_CONV_NHWC / MXNET_PAGED_BLOCK_K / MXNET_TPU_INIT_TIMEOUT
+    undocumented on arrival)."""
+    from tools import lint_metrics
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    undocumented, stale = lint_metrics.lint_env(root)
+    assert not undocumented, (
+        "env knobs read under mxnet_tpu/ but missing from "
+        "doc/env_var.md: %s" % undocumented)
+    assert not stale, (
+        "env knobs documented in doc/env_var.md but no longer read "
+        "anywhere: %s" % stale)
+
+
+def test_env_knob_lint_detects_drift(tmp_path):
+    """Self-test with injected drift: an undocumented environ read
+    (get AND subscript forms) and a stale doc row both trip; a knob
+    mentioned only in a docstring/comment does NOT count as read; a
+    knob read outside mxnet_tpu/ (tools/, tests/) satisfies the stale
+    check but is not required to be documented."""
+    from tools import lint_metrics
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        '"""Docstring naming MXNET_DOC_ONLY must not count."""\n'
+        'import os\n'
+        'A = os.environ.get("MXNET_REAL_KNOB", "1")\n'
+        'B = os.environ["MXNET_SUBSCRIPT_KNOB"]\n'
+        'C = os.getenv("MXNET_GETENV_KNOB")\n'
+        '# os.environ.get("MXNET_COMMENTED") must not count\n'
+        'err = "set MXNET_MENTIONED to change this"\n')
+    tools_dir = tmp_path / "tools"
+    tools_dir.mkdir()
+    (tools_dir / "t.py").write_text(
+        'import os\nX = os.environ.get("MXNET_TOOL_KNOB")\n')
+    doc = tmp_path / "doc"
+    doc.mkdir()
+    (doc / "env_var.md").write_text(
+        "# Env\n\n"
+        "| Variable | Default | Effect |\n"
+        "|---|---|---|\n"
+        "| `MXNET_REAL_KNOB` | `1` | Real. |\n"
+        "| `MXNET_GONE_KNOB` | unset | Stale. |\n"
+        "| `MXNET_TOOL_KNOB` | unset | Read under tools/ only. |\n\n"
+        "| Reference variable | Where |\n"
+        "|---|---|\n"
+        "| `MXNET_SUBSUMED` | excluded table — must not count |\n")
+    undocumented, stale = lint_metrics.lint_env(str(tmp_path))
+    assert sorted(undocumented) == ["MXNET_GETENV_KNOB",
+                                    "MXNET_SUBSCRIPT_KNOB"]
+    assert stale == ["MXNET_GONE_KNOB"]
+
+
+# -- ?prefix= subtree filter + /rounds (ISSUE 13) ----------------------
+
+def test_http_prefix_filter_metrics_and_snapshot(server):
+    """/metrics?prefix= and /snapshot?prefix= serve only the named
+    dotted subtree — and the filtered exposition still obeys the line
+    grammar (TYPE before samples, cumulative buckets)."""
+    tele.counter("t13.pref_events").inc(2)
+    tele.histogram("t13.pref_ms").observe(1.0)
+    tele.gauge("other13.unrelated").set(5)
+    status, _, text = _get(server.url + "/metrics?prefix=t13.")
+    assert status == 200
+    declared = set()
+    for line in text.rstrip("\n").splitlines():
+        assert _PROM_LINE.match(line), line
+        if line.startswith("# TYPE "):
+            declared.add(line.split()[2])
+        elif not line.startswith("#"):
+            name = re.split(r"[ {]", line, 1)[0]
+            assert name.startswith("mxnet_t13_"), \
+                "unfiltered family leaked: %r" % name
+    assert "mxnet_t13_pref_events_total" in declared
+    assert "mxnet_other13_unrelated" not in text
+    status, _, body = _get(server.url + "/snapshot?prefix=t13.")
+    snap = json.loads(body)
+    assert set(snap) == {"t13"}
+    assert snap["t13"]["pref_events"] == 2
+    # unfiltered scrape still carries everything
+    _, _, body = _get(server.url + "/snapshot")
+    assert "other13" in json.loads(body)
+
+
+def test_http_rounds_endpoint_reads_ledgers():
+    """/rounds aggregates engine.round_table(n) across the registry
+    (read-only; ?n= bounds rows per engine; engines without a ledger
+    are skipped, not errors)."""
+    from mxnet_tpu.serving import engine as engine_mod
+
+    class _LedgerStub:
+        flight = FlightRecorder(retain=0)
+
+        def __init__(self):
+            self.rows = [
+                {"round": i, "t_s": i * 0.1, "wall_ms": 1.5,
+                 "slots_busy": 1, "admitted": 0,
+                 "dispatched": "decode",
+                 "phases_ms": {"sched": 0.5, "dispatch": 1.0}}
+                for i in range(5)]
+
+        def round_table(self, n=None):
+            return self.rows[-n:] if n else list(self.rows)
+
+    class _NoLedger:                    # pre-ledger engine shape
+        flight = FlightRecorder(retain=0)
+
+    stub = _LedgerStub()
+    engine_mod._ENGINES.add(stub)
+    engine_mod._ENGINES.add(_NoLedger())
+    srv = tele.serve(port=0)
+    try:
+        def stub_blocks(doc):
+            # other live engines may share the registry (it is
+            # process-wide) — key on the stub's distinctive wall_ms
+            return [b for b in doc["engines"]
+                    if b["rounds"]
+                    and b["rounds"][-1].get("wall_ms") == 1.5]
+
+        _, _, body = _get(srv.url + "/rounds")
+        (eng,) = stub_blocks(json.loads(body))  # no-ledger stub skipped
+        assert len(eng["rounds"]) == 5
+        assert eng["rounds"][-1]["phases_ms"]["dispatch"] == 1.0
+        _, _, body = _get(srv.url + "/rounds?n=2")
+        assert len(stub_blocks(json.loads(body))[0]["rounds"]) == 2
+        _, _, body = _get(srv.url + "/rounds?n=bogus")  # degrade
+        assert len(stub_blocks(json.loads(body))[0]["rounds"]) == 5
+        _, _, body = _get(srv.url + "/")
+        assert "/rounds" in body
+        req = urllib.request.Request(srv.url + "/rounds", data=b"x",
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 405       # strictly read-only
+    finally:
+        engine_mod._ENGINES.discard(stub)
+        tele.stop_server()
+
+
+def test_http_healthz_multi_engine_itemizes_stuck_and_healthy():
+    """ISSUE 13 satellite: one STUCK engine next to one healthy one
+    must 503 the process (the router signal) while the payload
+    itemizes BOTH engines, so an operator sees which replica-internal
+    engine tripped (PR 9 only pinned the single-engine case)."""
+    from mxnet_tpu.serving import engine as engine_mod
+
+    class _Stub:
+        flight = FlightRecorder(retain=0)
+
+        def __init__(self, name, stuck):
+            self.name, self.stuck = name, stuck
+
+        def request_table(self):
+            return []
+
+        def health(self):
+            return {"closed": False, "stuck": self.stuck,
+                    "watchdog_trips": int(self.stuck),
+                    "slots": 2, "name": self.name}
+
+    healthy = _Stub("healthy", stuck=False)
+    wedged = _Stub("wedged", stuck=True)
+    engine_mod._ENGINES.add(healthy)
+    engine_mod._ENGINES.add(wedged)
+    srv = tele.serve(port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.url + "/healthz")
+        assert e.value.code == 503
+        doc = json.loads(e.value.read())
+        assert doc["status"] == "stuck"
+        by_name = {h["name"]: h for h in doc["engines"]
+                   if "name" in h}
+        assert set(by_name) == {"healthy", "wedged"}
+        assert by_name["wedged"]["stuck"] is True
+        assert by_name["healthy"]["stuck"] is False
+        # the healthy engine alone flips the process back to 200
+        engine_mod._ENGINES.discard(wedged)
+        status, _, body = _get(srv.url + "/healthz")
+        assert status == 200
+        assert json.loads(body)["status"] == "ok"
+    finally:
+        engine_mod._ENGINES.discard(healthy)
+        engine_mod._ENGINES.discard(wedged)
+        tele.stop_server()
+
+
 # -- dump_telemetry --url / --watch ------------------------------------
 
 def test_dump_telemetry_url_and_watch_read_live_server(capsys):

@@ -323,6 +323,61 @@ def test_dump_telemetry_snapshot_and_trace(tmp_path, capsys):
     assert "trace events" in out
 
 
+def test_bench_compare_detects_regressions(tmp_path, capsys):
+    """tools/bench_compare.py (ISSUE 13 satellite): two BENCH_extra
+    runs diff on shared numeric keys with direction-aware regression
+    verdicts — tokens/s falling and latency rising both trip past the
+    threshold, improvements and unjudged keys do not, and the
+    `telemetry` subtree is excluded."""
+    from tools import bench_compare
+
+    old = {
+        "serving": {"tokens_per_sec": 1000.0, "p99_ms": 10.0,
+                    "requests": 48},
+        "resnet50_b256_bf16": 2500.0,
+        "telemetry": {"serving": {"tokens": 999}},
+        "gone_key": 1.0,
+        "config_note": "text values are skipped",
+    }
+    new = {
+        "serving": {"tokens_per_sec": 800.0,      # -20%: regression
+                    "p99_ms": 12.0,               # +20%: regression
+                    "requests": 12},              # unjudged direction
+        "resnet50_b256_bf16": 2600.0,             # +4%: improvement
+        "telemetry": {"serving": {"tokens": 1}},  # excluded subtree
+        "new_key": 2.0,
+    }
+    res = bench_compare.compare(old, new, threshold_pct=5.0)
+    assert sorted(res["regressions"]) == \
+        ["serving.p99_ms", "serving.tokens_per_sec"]
+    by_key = {r["key"]: r for r in res["rows"]}
+    assert by_key["serving.tokens_per_sec"]["delta_pct"] == -20.0
+    assert by_key["serving.p99_ms"]["regressed"]
+    assert not by_key["resnet50_b256_bf16"]["regressed"]
+    assert not by_key["serving.requests"]["regressed"]
+    assert by_key["serving.requests"]["direction"] is None
+    assert "telemetry.serving.tokens" not in by_key
+    assert res["only_old"] == ["config_note", "gone_key"]
+    assert res["only_new"] == ["new_key"]
+    # threshold is configurable: at 25% nothing regresses
+    assert not bench_compare.compare(old, new,
+                                     threshold_pct=25.0)["regressions"]
+    # key filter narrows the comparison
+    res_f = bench_compare.compare(old, new, key_filter="resnet")
+    assert [r["key"] for r in res_f["rows"]] == ["resnet50_b256_bf16"]
+    # CLI: non-zero exit on regression, zero when under threshold
+    old_p = tmp_path / "old.json"
+    new_p = tmp_path / "new.json"
+    old_p.write_text(json.dumps(old))
+    new_p.write_text(json.dumps(new))
+    assert bench_compare.main([str(old_p), str(new_p)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out and "serving.tokens_per_sec" in out
+    assert bench_compare.main([str(old_p), str(new_p),
+                               "--threshold", "25"]) == 0
+    assert "2 regression(s)" not in capsys.readouterr().out
+
+
 def test_dump_telemetry_serving_filter(tmp_path, capsys):
     """--serving (PR 5 satellite): the per-request prefix/chunk stats
     tabulate next to TTFT and cadence — one view answers whether the
@@ -347,12 +402,27 @@ def test_dump_telemetry_serving_filter(tmp_path, capsys):
         "spec_drafted_tokens": 20, "spec_accepted_tokens": 15,
         "spec_drafts_ngram": 20, "spec_drafts_model": 0,
         "spec_accepted_per_step": hist(3),
+        # ISSUE 13: round-phase attribution + capture counters
+        "round_phase_ms": {"sched": hist(0.2), "dispatch": hist(2.0),
+                           "drain": hist(0.3), "prefill": hist(1.5)},
+        "round_wall_ms": hist(4.0),
+        "capture_records": 9, "capture_skipped": 1,
+        "capture_bytes": 4096.0,
     }}
     snap_path = tmp_path / "snap.json"
     snap_path.write_text(json.dumps(snap))
     dump_telemetry.main([str(snap_path), "--serving"])
     out = capsys.readouterr().out
     assert "hit_rate=0.75" in out and "hit_tokens=96" in out
+    # phase-breakdown table: phases sorted by total share, wall row
+    # appended, capture line present
+    assert "round phase" in out and "share" in out
+    table = out[out.index("round phase"):]
+    assert table.index("dispatch") < table.index("prefill") < \
+        table.index("drain") < table.index("sched")
+    assert "(round wall)" in out
+    assert "capture:" in out and "records=9" in out \
+        and "skipped=1" in out
     # speculation line (PR 10): accept rate + drafter source mix +
     # fallback rounds, next to the latency histograms they explain
     assert "accept_rate=0.75" in out and "fallback_rounds=2" in out

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Diff two ``BENCH_extra.json`` runs so the committed BENCH_r*
+trajectory is actually consumable: which numbers moved, by how much,
+and did anything regress past a threshold.
+
+Every bench round writes hundreds of numbers; eyeballing two JSON
+blobs misses regressions and over-reads noise. This tool flattens
+both files to dotted keys, diffs the SHARED numeric keys, and judges
+each against a direction inferred from the key name:
+
+* **higher-is-better** (``*tokens_per_sec*``, ``*img_per_sec*``,
+  ``*speedup*``, ``*tflops*``, ``*accept*``, ``*mfu*``,
+  ``*goodput*``): a drop beyond the threshold is a regression;
+* **lower-is-better** (``*_ms``, ``*_ms_per_*``, ``*overhead*``,
+  ``*_pct``, ``*bytes_accessed*``): a rise beyond the threshold is a
+  regression;
+* everything else (counts, configs, ratios of unknown polarity) is
+  reported but never judged.
+
+Usage::
+
+    python tools/bench_compare.py OLD.json NEW.json
+    python tools/bench_compare.py OLD.json NEW.json --threshold 10
+    python tools/bench_compare.py OLD.json NEW.json --keys serving
+
+Exit status 1 when any judged key regressed by more than
+``--threshold`` percent (default 5) — wire it into a trend check.
+``--keys`` substring-filters which flattened keys are compared (the
+``telemetry`` snapshot subtree is always skipped: per-run
+distributions, not comparable headline numbers).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_HIGHER = ("tokens_per_sec", "img_per_sec", "speedup", "tflops",
+           "accept", "mfu", "goodput", "samples_per_sec", "hit_tokens")
+_LOWER = ("_ms", "overhead", "_pct", "bytes_accessed", "_bytes",
+          "spread")
+
+
+def flatten(doc, prefix=""):
+    """Nested dict/list → {dotted.key: leaf}; list indices become
+    segments. The ``telemetry`` subtree is dropped (raw histograms —
+    run-length-dependent, not a comparable headline)."""
+    out = {}
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            if prefix == "" and k == "telemetry":
+                continue
+            out.update(flatten(v, "%s%s." % (prefix, k)))
+    elif isinstance(doc, (list, tuple)):
+        for i, v in enumerate(doc):
+            out.update(flatten(v, "%s%d." % (prefix, i)))
+    else:
+        out[prefix[:-1]] = doc
+    return out
+
+
+def direction(key):
+    """+1 higher-is-better, -1 lower-is-better, 0 unjudged. First
+    match wins, higher-is-better checked first (``*_ms`` would
+    otherwise claim ``tokens_per_sec_ms``-style names never used)."""
+    low = key.lower()
+    if any(tok in low for tok in _HIGHER):
+        return 1
+    if any(tok in low for tok in _LOWER):
+        return -1
+    return 0
+
+
+def compare(old_doc, new_doc, threshold_pct=5.0, key_filter=None):
+    """Returns ``{"rows": [...], "regressions": [...],
+    "only_old": [...], "only_new": [...]}``. Rows are
+    ``(key, old, new, delta_pct, judged_direction, regressed)`` for
+    every shared key whose values are both numeric; ``delta_pct`` is
+    ``(new - old) / |old| * 100`` (None when old == 0)."""
+    old_f = flatten(old_doc)
+    new_f = flatten(new_doc)
+    if key_filter:
+        old_f = {k: v for k, v in old_f.items() if key_filter in k}
+        new_f = {k: v for k, v in new_f.items() if key_filter in k}
+    shared = sorted(set(old_f) & set(new_f))
+    rows, regressions = [], []
+    for k in shared:
+        o, n = old_f[k], new_f[k]
+        if isinstance(o, bool) or isinstance(n, bool) \
+                or not isinstance(o, (int, float)) \
+                or not isinstance(n, (int, float)):
+            continue
+        delta = None if o == 0 else (n - o) / abs(o) * 100.0
+        d = direction(k)
+        regressed = (delta is not None and d != 0
+                     and d * delta < -abs(threshold_pct))
+        rows.append({"key": k, "old": o, "new": n,
+                     "delta_pct": None if delta is None
+                     else round(delta, 2),
+                     "direction": {1: "higher", -1: "lower",
+                                   0: None}[d],
+                     "regressed": regressed})
+        if regressed:
+            regressions.append(k)
+    return {
+        "rows": rows,
+        "regressions": regressions,
+        "only_old": sorted(set(old_f) - set(new_f)),
+        "only_new": sorted(set(new_f) - set(old_f)),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Diff two BENCH_extra.json runs (shared numeric "
+                    "keys, %% delta, regression verdicts)")
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--threshold", type=float, default=5.0,
+                    help="regression threshold in percent (default 5)")
+    ap.add_argument("--keys", default=None,
+                    help="only compare flattened keys containing this "
+                         "substring")
+    ap.add_argument("--all", action="store_true",
+                    help="print every shared key, not just movers")
+    args = ap.parse_args(argv)
+    with open(args.old) as f:
+        old_doc = json.load(f)
+    with open(args.new) as f:
+        new_doc = json.load(f)
+    res = compare(old_doc, new_doc, threshold_pct=args.threshold,
+                  key_filter=args.keys)
+    for row in res["rows"]:
+        moved = row["delta_pct"] is not None \
+            and abs(row["delta_pct"]) >= args.threshold
+        if not (args.all or moved or row["regressed"]):
+            continue
+        print("%s %-60s %12g -> %-12g %s"
+              % ("REGRESSED" if row["regressed"]
+                 else ("  moved  " if moved else "         "),
+                 row["key"], row["old"], row["new"],
+                 "n/a" if row["delta_pct"] is None
+                 else "%+.1f%%" % row["delta_pct"]))
+    if res["only_old"]:
+        print("%d key(s) only in %s" % (len(res["only_old"]), args.old))
+    if res["only_new"]:
+        print("%d key(s) only in %s" % (len(res["only_new"]), args.new))
+    print("compared %d shared numeric keys; %d regression(s) past "
+          "%.1f%%" % (len(res["rows"]), len(res["regressions"]),
+                      args.threshold))
+    return 1 if res["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,7 +31,10 @@ wait / TTFT / token cadence) tabulated NEXT TO the prefix-cache and
 chunked-prefill stats that explain them (hit tokens saved, lookup
 cost, chunks per request, pool bytes, compile counts) — the one-look
 answer to "did the cache/chunking actually move TTFT and p99". On a
-trace file it filters to ``serving.`` spans.
+trace file it filters to ``serving.`` spans. Since ISSUE 13 it also
+prints the round-phase breakdown (``serving.round_phase_ms.*`` —
+drain / prefill / dispatch / host-sched shares of the round wall
+time) and the traffic-capture counters.
 """
 from __future__ import annotations
 
@@ -148,10 +151,43 @@ def print_serving(snap, out=None):
                   % ("n/a" if impl_g is None
                      else ("paged" if impl_g else "dense"),
                      "n/a" if ba is None else "%.6g" % ba, per_tok))
+    if s.get("capture_records", 0) or s.get("capture_skipped", 0):
+        out.write("capture:          records=%s skipped=%s bytes=%s\n"
+                  % (s.get("capture_records", 0),
+                     s.get("capture_skipped", 0),
+                     s.get("capture_bytes", 0)))
     out.write("compiles:         decode=%s prefill=%s copy=%s\n"
               % (s.get("compiles_decode", 0),
                  s.get("compiles_prefill", 0),
                  s.get("compiles_copy", 0)))
+    # round-phase breakdown (ISSUE 13): where a scheduling round's
+    # wall time went, as total-ms shares — the one-look answer to
+    # "is the engine device-bound or stuck in host scheduling"
+    phases = s.get("round_phase_ms")
+    if isinstance(phases, dict) and any(
+            _is_histogram(v) and v["count"] for v in phases.values()):
+        total = sum(v.get("sum", 0) for v in phases.values()
+                    if _is_histogram(v))
+        out.write("\n%-16s %8s %12s %10s %10s %7s\n"
+                  % ("round phase", "rounds", "total_ms", "mean_ms",
+                     "p99_ms", "share"))
+        for name in sorted(phases,
+                           key=lambda n: -(phases[n].get("sum", 0)
+                                           if _is_histogram(phases[n])
+                                           else 0)):
+            v = phases[name]
+            if not _is_histogram(v) or not v["count"]:
+                continue
+            out.write("%-16s %8d %12.3f %10.4f %10.4f %6.1f%%\n"
+                      % (name, v["count"], v["sum"],
+                         v["sum"] / v["count"], v.get("p99") or 0,
+                         100.0 * v["sum"] / total if total else 0))
+        wall = s.get("round_wall_ms")
+        if _is_histogram(wall) and wall["count"]:
+            out.write("%-16s %8d %12.3f %10.4f %10.4f\n"
+                      % ("(round wall)", wall["count"], wall["sum"],
+                         wall["sum"] / wall["count"],
+                         wall.get("p99") or 0))
     out.write("\n%-28s %s\n" % ("per-request", "distribution"))
     for key in ("queue_wait_ms", "ttft_ms", "token_cadence_ms",
                 "prefix_lookup_ms", "prefill_chunks_per_request",

@@ -1,6 +1,7 @@
 #!/usr/bin/env python
-"""Metric-catalog lint: the telemetry names in the code and the
-catalog in ``doc/observability.md`` must agree, both ways.
+"""Metric-catalog + env-knob lint: the telemetry names and the
+``MXNET_*`` environment knobs in the code must agree with their doc
+catalogs (``doc/observability.md`` / ``doc/env_var.md``), both ways.
 
 The catalog rotted once before (PR 9 found rows the code no longer
 emitted and counters the doc never learned about), and a catalog that
@@ -23,12 +24,26 @@ patterns against the registrations the code CAN'T express as literals
 (``tools/lint_metrics.py`` cannot see runtime f-strings; the pattern
 row documents the family instead).
 
+The env-knob check (ISSUE 13) works the same way for
+``doc/env_var.md``:
+
+* **code → doc**: every ``MXNET_*`` literal READ from the environment
+  under ``mxnet_tpu/`` (``os.environ.get``/``os.getenv``/
+  ``os.environ[...]`` — AST-detected, so a knob merely mentioned in a
+  docstring or error message doesn't count) must have a row in an
+  env_var.md table whose header's first cell is ``Variable``.
+* **doc → code**: every ``MXNET_*`` name in those tables must still be
+  read SOMEWHERE in the repo (``mxnet_tpu/``, ``tools/``, ``tests/``,
+  top-level ``*.py`` — knobs like test-harness switches are
+  legitimately read outside the package).
+
 Usage::
 
     python tools/lint_metrics.py            # lint the repo, exit 1 on drift
     python tools/lint_metrics.py --list     # dump both name sets
 
-``tests/test_observability.py`` runs :func:`lint` as a tier-1 test.
+``tests/test_observability.py`` runs :func:`lint` and
+:func:`lint_env` as tier-1 tests.
 """
 from __future__ import annotations
 
@@ -102,6 +117,110 @@ def doc_metric_names(doc_path):
     return exact, patterns
 
 
+_ENV_NAME_RE = re.compile(r"^MXNET_[A-Z][A-Z0-9_]*$")
+
+
+def _is_environ_read(node):
+    """Is this AST Call/Subscript an environment read whose key is a
+    string literal? Covers ``os.environ.get(k, ...)``,
+    ``os.getenv(k)`` and ``os.environ[k]``."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            return None
+        if f.attr == "getenv":
+            return node.args[0].value
+        if f.attr == "get" and isinstance(f.value, ast.Attribute) \
+                and f.value.attr == "environ":
+            return node.args[0].value
+        return None
+    if isinstance(node, ast.Subscript):
+        if isinstance(node.value, ast.Attribute) \
+                and node.value.attr == "environ" \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            return node.slice.value
+    return None
+
+
+def code_env_names(*roots):
+    """``MXNET_*`` env-var names actually READ (environ.get/getenv/
+    environ[...]) under the given files/directories —
+    {name: [file:line, ...]}, paths relative to each root."""
+    out = {}
+    paths = []
+    for root in roots:
+        if os.path.isfile(root):
+            paths.append((os.path.dirname(root) or ".", root))
+            continue
+        for sub, _dirs, files in os.walk(root):
+            if "__pycache__" in sub:
+                continue
+            paths.extend((root, os.path.join(sub, fn))
+                         for fn in files if fn.endswith(".py"))
+    for root, path in paths:
+        try:
+            tree = ast.parse(open(path).read(), filename=path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            name = _is_environ_read(node)
+            if name and _ENV_NAME_RE.match(name):
+                out.setdefault(name, []).append(
+                    "%s:%d" % (os.path.relpath(path, root),
+                               node.lineno))
+    return out
+
+
+def doc_env_names(doc_path):
+    """``MXNET_*`` names from the env_var.md tables whose header's
+    first cell is ``Variable`` (the knob catalogs; the
+    reference-knobs-subsumed table has a different header and is
+    excluded on purpose — those knobs no longer exist)."""
+    names = set()
+    in_table = False
+    for line in open(doc_path):
+        line = line.rstrip()
+        if not line.startswith("|"):
+            in_table = False
+            continue
+        cells = [c.strip() for c in line.strip("|").split("|")]
+        if not cells:
+            continue
+        if cells[0] == "Variable":
+            in_table = True
+            continue
+        if not in_table or set(cells[0]) <= {"-", " ", ":"}:
+            continue
+        for name in re.findall(r"`([^`]+)`", cells[0]):
+            if _ENV_NAME_RE.match(name):
+                names.add(name)
+    return names
+
+
+def lint_env(repo_root):
+    """Returns ``(undocumented, stale)`` for the env-knob catalog:
+    knobs read under ``mxnet_tpu/`` missing from ``doc/env_var.md``,
+    and documented knobs no longer read anywhere (package, tools,
+    tests, or top-level scripts)."""
+    pkg_reads = code_env_names(os.path.join(repo_root, "mxnet_tpu"))
+    wide_roots = [os.path.join(repo_root, d)
+                  for d in ("mxnet_tpu", "tools", "tests")]
+    wide_roots += [os.path.join(repo_root, f)
+                   for f in os.listdir(repo_root)
+                   if f.endswith(".py")]
+    all_reads = code_env_names(*[r for r in wide_roots
+                                 if os.path.exists(r)])
+    documented = doc_env_names(os.path.join(repo_root, "doc",
+                                            "env_var.md"))
+    undocumented = {n: s for n, s in sorted(pkg_reads.items())
+                    if n not in documented}
+    stale = sorted(documented - set(all_reads))
+    return undocumented, stale
+
+
 def _pattern_re(pat):
     parts = re.split(r"(<[^>]*>|\*)", pat)
     rx = "".join(".+" if p.startswith("<") or p == "*"
@@ -162,6 +281,10 @@ def main(argv=None):
         print("doc (%d + %d patterns):" % (len(exact), len(patterns)))
         for n in sorted(exact | patterns):
             print("  %s" % n)
+        env = code_env_names(os.path.join(args.root, "mxnet_tpu"))
+        print("env knobs read (%d):" % len(env))
+        for n in sorted(env):
+            print("  %s  (%s)" % (n, env[n][0]))
         return 0
     undocumented, stale = lint(args.root)
     for name, sites in undocumented.items():
@@ -170,11 +293,20 @@ def main(argv=None):
     for name in stale:
         print("STALE: %s documented in doc/observability.md but no "
               "longer registered anywhere under mxnet_tpu/" % name)
-    if undocumented or stale:
-        print("metric catalog drift: %d undocumented, %d stale"
-              % (len(undocumented), len(stale)))
+    env_undoc, env_stale = lint_env(args.root)
+    for name, sites in env_undoc.items():
+        print("UNDOCUMENTED KNOB: %s  (read at %s) — add a row to "
+              "doc/env_var.md" % (name, ", ".join(sites)))
+    for name in env_stale:
+        print("STALE KNOB: %s documented in doc/env_var.md but no "
+              "longer read anywhere in the repo" % name)
+    if undocumented or stale or env_undoc or env_stale:
+        print("catalog drift: %d undocumented + %d stale metrics, "
+              "%d undocumented + %d stale env knobs"
+              % (len(undocumented), len(stale), len(env_undoc),
+                 len(env_stale)))
         return 1
-    print("metric catalog clean")
+    print("metric + env-knob catalogs clean")
     return 0
 
 

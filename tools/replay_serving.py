@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Deterministic serving replay: the playback half of the serving time
+machine (doc/observability.md "The serving time machine").
+
+A capture (``MXNET_SERVING_CAPTURE_DIR`` /
+``InferenceEngine(capture_dir=...)``) holds everything a request's
+output is a function of — prompt tokens, token budget, eos id, and the
+sampling identity ``(seed, temperature)`` (draws are
+``fold_in(seed, position)``, schedule-independent) — plus the arrival
+times and the engine geometry. Because the engine's outputs are
+byte-identical across admission orders, speculation, chunking, prefix
+hits and snapshot/restore, replaying those submits on a FRESH engine
+reproduces the captured tokens exactly; ``--verify`` asserts it. That
+turns any production capture into an offline test case and an A/B
+bench: replay yesterday's p99 blowup against a config change
+(``--spec-k/--draft/--prefill-chunk/--prefix-cache-mb/--slots/...``)
+and read the latency diff against the recorded run.
+
+Usage::
+
+    # validate a config change against captured traffic, byte-exact
+    python tools/replay_serving.py CAPTURE.jsonl \
+        --checkpoint ckpt/lm --epoch 3 --verify --prefill-chunk 128
+
+    # as-fast-as-possible capacity read instead of recorded pacing
+    python tools/replay_serving.py CAPTURE.jsonl \
+        --checkpoint ckpt/lm --epoch 3 --timing max
+
+``--timing recorded`` (default) re-paces submissions at the captured
+inter-arrival gaps — the day-in-the-life read: same burstiness, so
+TTFT/cadence compare directly against the ``recorded`` block in the
+report. ``--timing max`` submits as fast as backpressure allows — the
+capacity read. Deadlines are NOT replayed (they are wall-clock
+properties of the original run, not of the request content; a replay
+on a cold engine would spuriously expire them) — deadline-retired
+captures replay to their full continuation, and ``--verify`` checks
+byte-identity only for requests the capture saw complete normally
+(``eos``/``length``), prefix-matching the partial tokens of the rest.
+
+Exit status: non-zero when ``--verify`` finds any mismatch (or the
+engine config cannot serve a captured request at all).
+
+The library surface (``load_capture`` re-exported from
+``mxnet_tpu.serving``, :func:`replay`, :func:`build_engine`) is what
+``bench.bench_serving_replay`` and tests/test_serving_replay.py
+drive with in-memory engines — no checkpoint file needed.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.serving.capture import load_capture  # noqa: E402
+
+# capture-header keys that are NOT InferenceEngine constructor kwargs
+_NON_CTOR_KEYS = ("max_len", "capture_dir")
+
+
+def build_engine(cap, decoder, **overrides):
+    """Rebuild the captured engine geometry over ``decoder`` (the same
+    weights), with ``overrides`` applied — the ``--slots/--spec-k/...``
+    config axes. Replay engines do not re-capture unless an override
+    asks for it."""
+    from mxnet_tpu.serving import InferenceEngine
+
+    cfg = {k: v for k, v in cap["engine"].items()
+           if k not in _NON_CTOR_KEYS}
+    cfg["prefill_buckets"] = tuple(cfg["prefill_buckets"])
+    cfg.update(overrides)
+    return InferenceEngine(decoder, **cfg)
+
+
+def _percentile(xs, q):
+    return round(float(np.percentile(xs, q)), 3) if xs else None
+
+
+def _latency_summary(ttft, cadence):
+    return {
+        "ttft_p50_ms": _percentile(ttft, 50),
+        "ttft_p99_ms": _percentile(ttft, 99),
+        "cadence_p50_ms": _percentile(cadence, 50),
+        "cadence_p99_ms": _percentile(cadence, 99),
+    }
+
+
+def recorded_latency(cap):
+    """The captured run's own latency summary (from the retire
+    records) — what the replay's numbers diff against."""
+    ttft = [r["ttft_ms"] for r in cap["retires"].values()
+            if r.get("ttft_ms") is not None]
+    cadence = [r["cadence_ms"] for r in cap["retires"].values()
+               if r.get("cadence_ms") is not None]
+    return _latency_summary(ttft, cadence)
+
+
+def replay(cap, engine, timing="recorded", verify=False):
+    """Replay a loaded capture on ``engine``; returns the report dict.
+
+    ``timing="recorded"`` paces submissions at the captured arrival
+    offsets (wall clock from replay start); ``"max"`` submits as fast
+    as backpressure allows. ``verify=True`` byte-compares each
+    replayed output against the captured tokens: full equality where
+    the capture retired normally (``eos``/``length``), prefix
+    equality where it was cut short host-side (deadline/cancel/shed —
+    the replay generates the full continuation the cut run only
+    started)."""
+    if timing not in ("recorded", "max"):
+        raise ValueError("timing must be 'recorded' or 'max', got %r"
+                         % (timing,))
+    submits = sorted(cap["submits"], key=lambda r: r["t"])
+    handles = []                      # (record, Request) pairs
+    t0 = time.perf_counter()
+    i = 0
+    while i < len(submits) or not engine.idle:
+        now = time.perf_counter() - t0
+        if timing == "recorded" and i < len(submits) and engine.idle \
+                and submits[i]["t"] > now:
+            # nothing resident and the next captured arrival is in
+            # the future: sleep toward it instead of busy-spinning
+            # step() through a sparse capture's inter-burst gaps
+            # (50 ms cap keeps pacing accurate)
+            time.sleep(min(submits[i]["t"] - now, 0.05))
+            now = time.perf_counter() - t0
+        while i < len(submits) \
+                and engine.queued() < engine.max_queue \
+                and (timing == "max" or submits[i]["t"] <= now):
+            rec = submits[i]
+            req = engine.submit(
+                np.asarray(rec["prompt"], np.int32),
+                max_tokens=rec["max_tokens"],
+                eos_id=rec.get("eos_id"),
+                temperature=rec.get("temperature", 0.0),
+                seed=rec.get("seed"),
+                request_id=rec["id"],
+                _resume_tokens=tuple(rec.get("resume_tokens", ())))
+            handles.append((rec, req))
+            i += 1
+        engine.step()
+    dt = time.perf_counter() - t0
+
+    toks = sum(len(h.tokens) - h.resumed for _, h in handles)
+    ttft = [(h.t_first - h.t_submit) * 1e3 for _, h in handles
+            if h.t_first is not None]
+    cadence = [(h.t_done - h.t_first)
+               / (len(h.tokens) - h.resumed - 1) * 1e3
+               for _, h in handles
+               if h.t_first is not None and h.t_done is not None
+               and len(h.tokens) - h.resumed > 1]
+    report = {
+        "requests": len(submits),
+        "replayed": len(handles),
+        "tokens": toks,
+        "tokens_per_sec": round(toks / dt, 1) if dt else None,
+        "wall_s": round(dt, 3),
+        "timing": timing,
+        **_latency_summary(ttft, cadence),
+        "recorded": recorded_latency(cap),
+    }
+    if verify:
+        verified, prefix_ok, skipped, mismatches = 0, 0, 0, []
+        for rec, h in handles:
+            want = cap["retires"].get(rec["id"])
+            if want is None:
+                skipped += 1          # capture died before this retire
+                continue
+            got = np.asarray(h.tokens, np.int64)
+            ref = np.asarray(want["tokens"], np.int64)
+            if want["reason"] in ("eos", "length"):
+                ok = got.shape == ref.shape and bool((got == ref).all())
+                verified += ok
+            else:
+                # host-cut capture: the replayed run must CONTAIN the
+                # cut run's tokens as a prefix
+                ok = len(ref) <= len(got) \
+                    and bool((got[:len(ref)] == ref).all())
+                prefix_ok += ok
+            if not ok:
+                mismatches.append({
+                    "id": rec["id"], "reason": want["reason"],
+                    "captured": len(ref), "replayed": len(got)})
+        report["verified"] = verified
+        report["verified_prefix"] = prefix_ok
+        report["verify_skipped"] = skipped
+        report["mismatches"] = mismatches
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Replay a serving traffic capture on a fresh "
+                    "engine (doc/observability.md 'The serving time "
+                    "machine')")
+    ap.add_argument("capture", help="mx_capture_*.jsonl file")
+    ap.add_argument("--checkpoint", required=True,
+                    help="checkpoint prefix (prefix-symbol.json + "
+                         "prefix-NNNN.params) — the SAME weights the "
+                         "capture was served with")
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--max-len", type=int, default=None,
+                    help="decoder max_len (default: the capture "
+                         "header's)")
+    ap.add_argument("--timing", choices=("recorded", "max"),
+                    default="recorded")
+    ap.add_argument("--verify", action="store_true",
+                    help="assert replayed outputs byte-match the "
+                         "captured tokens (exit 1 on any mismatch)")
+    # config-override axes: one capture validates any engine-config
+    # change offline
+    ap.add_argument("--slots", type=int, default=None)
+    ap.add_argument("--steps-per-round", type=int, default=None)
+    ap.add_argument("--spec-k", type=int, default=None)
+    ap.add_argument("--draft", default=None,
+                    choices=("off", "ngram", "model"))
+    ap.add_argument("--prefill-chunk", type=int, default=None)
+    ap.add_argument("--prefix-cache-mb", type=float, default=None)
+    ap.add_argument("--attn-impl", default=None,
+                    choices=("dense", "paged"))
+    ap.add_argument("--compute-dtype", default=None,
+                    help="decoder compute dtype (e.g. bfloat16)")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.parallel import Decoder
+
+    cap = load_capture(args.capture)
+    max_len = args.max_len or cap["engine"].get("max_len")
+    if not max_len:
+        ap.error("capture header carries no max_len; pass --max-len")
+    deckw = {"cache_block": None}
+    if args.compute_dtype:
+        deckw["compute_dtype"] = args.compute_dtype
+    dec = Decoder.from_checkpoint(args.checkpoint, args.epoch, max_len,
+                                  **deckw)
+    overrides = {k: v for k, v in (
+        ("slots", args.slots),
+        ("steps_per_round", args.steps_per_round),
+        ("spec_k", args.spec_k),
+        ("draft", args.draft),
+        ("prefill_chunk", args.prefill_chunk),
+        ("prefix_cache_mb", args.prefix_cache_mb),
+        ("attn_impl", args.attn_impl),
+    ) if v is not None}
+    engine = build_engine(cap, dec, **overrides)
+    report = replay(cap, engine, timing=args.timing,
+                    verify=args.verify)
+    report["overrides"] = overrides
+    print(json.dumps(report, sort_keys=True))
+    if args.verify and report["mismatches"]:
+        print("REPLAY VERIFY FAILED: %d mismatch(es)"
+              % len(report["mismatches"]), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
