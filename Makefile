@@ -29,10 +29,23 @@ check:
 bench:
 	python bench.py
 
+# Direction-aware diff of two bench rounds (tools/bench_compare.py):
+# exits nonzero when a judged key (tokens/s, *_ms, bytes_accessed, ...)
+# regressed past the threshold. See doc/performance.md "Comparing
+# bench rounds".
+#   make benchdiff OLD=BENCH_r05.json NEW=BENCH_extra.json
+#   make benchdiff OLD=a.json NEW=b.json THRESHOLD=10 KEYS=serving
+benchdiff:
+	@test -n "$(OLD)" -a -n "$(NEW)" || \
+		{ echo "usage: make benchdiff OLD=<a.json> NEW=<b.json> [THRESHOLD=5] [KEYS=substr]"; exit 2; }
+	python tools/bench_compare.py $(OLD) $(NEW) \
+		$(if $(THRESHOLD),--threshold $(THRESHOLD)) \
+		$(if $(KEYS),--keys $(KEYS))
+
 lint:
 	python -m compileall -q mxnet_tpu tools example
 
 clean:
 	$(MAKE) -C cpp clean
 
-.PHONY: all native examples test manifest check bench lint clean
+.PHONY: all native examples test manifest check bench benchdiff lint clean

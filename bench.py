@@ -499,6 +499,102 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
     }
 
 
+def bench_serving_tp(tp=1, slots=16, layers=12, embed=768, heads=12,
+                     vocab=32000, max_len=1024, n_requests=48, seed=0,
+                     arrival_ms=2.0, steps_per_round=8):
+    """Tensor-parallel serving sweep arm (ISSUE 14): the SAME workload
+    and seeds at every degree — the engine contract makes greedy
+    outputs byte-identical across tp, so each arm returns a digest of
+    its token streams and ``main()`` asserts the sweep agrees before
+    reporting any number. Reported per arm: tokens/s, p99 decode
+    cadence, per-shard decode-program ``bytes_accessed`` (the sharded
+    program's XLA cost analysis carries the shard_map body's LOCAL
+    shapes, so the PR 9 ``program.serving_decode`` gauge IS the
+    per-shard read — the multi-chip win condition: decode is
+    memory-bound and the KV read is what shards), and the
+    ``serving.kv_bytes_per_shard`` residency gauge. ``heads`` must
+    divide every swept degree (12 covers tp in {1, 2, 4})."""
+    import hashlib
+
+    import jax.numpy as jnp
+    from mxnet_tpu.models import get_transformer_lm
+    from mxnet_tpu.parallel import Decoder
+    from mxnet_tpu.serving import InferenceEngine
+
+    sym = get_transformer_lm(vocab, num_layers=layers, embed_dim=embed,
+                             num_heads=heads, impl="dense")
+    rng = np.random.RandomState(seed)
+    shapes = {"data": (8, max_len), "softmax_label": (8, max_len)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    params = {n: jnp.asarray(rng.uniform(-0.05, 0.05, sh)
+                             .astype(np.float32))
+              for n, sh in zip(sym.list_arguments(), arg_shapes)
+              if n not in shapes}
+    buckets = tuple(b for b in (64, 128, 256) if b <= max_len) \
+        or (max_len,)
+    dec = Decoder(sym, params, max_len=max_len,
+                  compute_dtype="bfloat16", cache_block=None)
+    engine = InferenceEngine(dec, slots=slots, prefill_buckets=buckets,
+                             max_queue=4 * slots,
+                             steps_per_round=steps_per_round,
+                             prefix_cache_mb=0, prefill_chunk=0,
+                             tp=tp)
+    wrs = np.random.RandomState(seed + 1)
+    for b in buckets:           # warm every program family up front
+        engine.submit(wrs.randint(0, vocab, (b - 8,)), max_tokens=8)
+    engine.serve_forever()
+
+    reqs = []
+    rs = np.random.RandomState(seed + 2)
+    for _ in range(n_requests):
+        p = min(int(rs.choice([24, 48, 96, 120, 200, 256])),
+                buckets[-1], max_len - 1)
+        t = int(rs.choice([32, 64, 96]))
+        reqs.append((rs.randint(0, vocab, (p,)), t))
+    arrivals = np.cumsum(rs.exponential(arrival_ms * 1e-3,
+                                        size=n_requests))
+    t0 = time.perf_counter()
+    handles, i = [], 0
+    while i < len(reqs) or not engine.idle:
+        now = time.perf_counter() - t0
+        while i < len(reqs) and arrivals[i] <= now \
+                and engine.queued() < engine.max_queue:
+            prompt, mt = reqs[i]
+            handles.append(engine.submit(prompt, max_tokens=mt))
+            i += 1
+        engine.step()
+    dt = time.perf_counter() - t0
+    toks = sum(len(h.tokens) for h in handles)
+    tpot = [(h.t_done - h.t_first) / (len(h.tokens) - 1) * 1e3
+            for h in handles if len(h.tokens) > 1]
+    cc = engine.compile_counts
+    assert cc["decode"] == 1 and all(v == 1
+                                     for v in cc["prefill"].values()) \
+        and not cc["copy"], \
+        "compile-count contract violated at tp=%d: %r" % (tp, cc)
+    digest = hashlib.sha256()
+    for h in handles:
+        digest.update(np.asarray(h.tokens, np.int64).tobytes())
+    from mxnet_tpu import profiler as _prof
+    import mxnet_tpu as _mx
+    _prof.collect_program_stats()
+    snap = _mx.telemetry.snapshot()
+    prog = snap.get("program", {}).get("serving_decode", {})
+    return {
+        "tp": tp,
+        "tokens_per_sec": round(toks / dt, 1),
+        "p50_ms_per_token": round(float(np.percentile(tpot, 50)), 3),
+        "p99_ms_per_token": round(float(np.percentile(tpot, 99)), 3),
+        "tokens": toks,
+        "requests": n_requests,
+        "decode_bytes_accessed_per_shard": prog.get("bytes_accessed"),
+        "decode_flops_per_shard": prog.get("flops"),
+        "kv_bytes_per_shard":
+            snap.get("serving", {}).get("kv_bytes_per_shard"),
+        "digest": digest.hexdigest(),
+    }
+
+
 def bench_serving_prefix(slots=16, layers=12, embed=768, heads=12,
                          vocab=32000, max_len=1024, n_requests=48,
                          seed=0, arrival_ms=6.0, hit_rate=0.9,
@@ -1621,6 +1717,49 @@ def main():
     except Exception:
         traceback.print_exc()
         serving_replay = None
+    # tensor-parallel sweep (ISSUE 14): same workload/seeds at
+    # tp in {1, 2, 4}; outputs byte-identical across degrees
+    # (digest-asserted), per-shard decode bytes_accessed is the cut
+    try:
+        import jax as _jax
+        tp_arms, tp_digests = {}, {}
+        for tpd in (1, 2, 4):
+            if tpd > len(_jax.devices()):
+                break
+            arm = bench_serving_tp(tp=tpd)
+            tp_digests[tpd] = arm.pop("digest")
+            tp_arms["tp%d" % tpd] = arm
+        assert len(set(tp_digests.values())) == 1, \
+            "tp sweep outputs diverged: %r" % (tp_digests,)
+        base_ba = tp_arms.get("tp1", {}) \
+            .get("decode_bytes_accessed_per_shard")
+        for tpd in (2, 4):
+            arm = tp_arms.get("tp%d" % tpd)
+            ba = arm and arm.get("decode_bytes_accessed_per_shard")
+            tp_arms["bytes_per_shard_ratio_tp%d" % tpd] = \
+                None if not ba or not base_ba \
+                else round(ba / base_ba, 3)
+        serving_tp = {
+            **tp_arms,
+            "outputs_byte_identical": True,
+            "note": "InferenceEngine(tp=N): KV cache + every compiled "
+                    "program family sharded over the mesh's model "
+                    "axis on the kv-head dim (one shard_map program "
+                    "per family — doc/serving.md 'Tensor-parallel "
+                    "serving'); same workload/seeds per degree, "
+                    "greedy token streams digest-asserted identical "
+                    "across tp; bytes_per_shard_ratio = per-shard "
+                    "decode-program bytes_accessed vs tp=1 (the "
+                    "sharded program's cost analysis carries local "
+                    "shapes) — the memory-bound win condition; on the "
+                    "CPU box wall-clock pays collective overhead the "
+                    "ICI-attached chip run amortizes, so the bytes "
+                    "cut is the honest CPU metric (PR 11 precedent); "
+                    "tools/bench_serving.py --tps sweeps this axis",
+        }
+    except Exception:
+        traceback.print_exc()
+        serving_tp = None
     def _dec_best_ms():
         if not dec_arms:
             return None
@@ -1690,6 +1829,7 @@ def main():
         "serving_prefix_cache_chunked_prefill": serving_prefix,
         "serving_speculative_decoding": serving_spec,
         "serving_paged_attention": serving_paged,
+        "serving_tensor_parallel": serving_tp,
         "serving_time_machine_replay": None if serving_replay is None
         else {
             **serving_replay,
@@ -1826,6 +1966,12 @@ def main():
             "serving_replay_verified":
                 None if serving_replay is None
                 else serving_replay["verified_total"],
+            "serving_tp2_bytes_ratio":
+                None if serving_tp is None
+                else serving_tp.get("bytes_per_shard_ratio_tp2"),
+            "serving_tp4_tokens_per_sec":
+                None if not (serving_tp or {}).get("tp4")
+                else serving_tp["tp4"]["tokens_per_sec"],
             "serving_replay_p99_ms":
                 None if serving_replay is None
                 else serving_replay["same_config"]["cadence_p99_ms"],

@@ -1107,7 +1107,7 @@ class FeedForward(BASE_ESTIMATOR):
                           prefix_cache_mb=None, prefill_chunk=None,
                           overload=None, round_timeout_ms=None,
                           spec_k=None, draft=None, draft_decoder=None,
-                          attn_impl=None, capture_dir=None,
+                          attn_impl=None, capture_dir=None, tp=None,
                           **decoder_kwargs):
         """Trained estimator → continuous-batching inference engine
         (``mxnet_tpu.serving.InferenceEngine``, doc/serving.md): the
@@ -1123,7 +1123,9 @@ class FeedForward(BASE_ESTIMATOR):
         "Speculative decoding"); ``attn_impl="paged"`` serves
         decode/verify through the Pallas paged-attention kernel that
         reads only each slot's live KV rows (doc/serving.md "Paged
-        attention")."""
+        attention"); ``tp=N`` shards the KV cache and every compiled
+        serving program over an N-device mesh's model axis
+        (doc/serving.md "Tensor-parallel serving")."""
         from .parallel.decode import Decoder
         from .serving import InferenceEngine
 
@@ -1154,7 +1156,7 @@ class FeedForward(BASE_ESTIMATOR):
                                spec_k=spec_k, draft=draft,
                                draft_decoder=draft_decoder,
                                capture_dir=capture_dir,
-                               attn_impl=attn_impl)
+                               attn_impl=attn_impl, tp=tp)
 
     @staticmethod
     def load(prefix, epoch, ctx=None, **kwargs):

@@ -240,6 +240,23 @@ class MultiHeadAttention(OpSpec):
                 "divisor of num_heads=%d" % (kv, p["num_heads"]))
         return kv
 
+    @staticmethod
+    def check_head_shards(p, tp, where="tensor-parallel serving"):
+        """Refuse LOUDLY when the head layout does not partition
+        evenly over ``tp`` shards. Tensor-parallel serving splits the
+        KV cache (and the per-head attention compute) on the KV-HEAD
+        dimension, keeping each grouped-query head with its query
+        group — an uneven split would silently give shards different
+        work shapes (and GQA groups straddling a shard boundary),
+        so the divisibility is a hard contract, not a rounding."""
+        kv = MultiHeadAttention.kv_heads(p)
+        if kv % tp:
+            raise MXNetError(
+                "MultiHeadAttention: %s needs the %d kv head(s) to "
+                "divide evenly over tp=%d shards (GQA query groups "
+                "must stay whole on their kv head's shard) — use a "
+                "tp that divides num_kv_heads" % (where, kv, tp))
+
     def arguments(self, p):
         return ["data", "qkv_weight", "qkv_bias", "out_weight", "out_bias"]
 

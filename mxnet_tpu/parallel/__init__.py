@@ -17,7 +17,8 @@ Axes convention (used across the framework):
   pp — pipeline parallel                sp — sequence/context parallel
   ep — expert parallel
 """
-from .mesh import build_mesh, data_parallel_mesh, local_mesh  # noqa: F401
+from .mesh import (build_mesh, data_parallel_mesh,  # noqa: F401
+                   local_mesh, model_parallel_mesh)
 from .shard import ShardingRules, P  # noqa: F401
 from .graph import make_graph_fn  # noqa: F401
 from .optim import make_functional  # noqa: F401

@@ -305,7 +305,7 @@ class Decoder:
         return min(int(w), self.max_len) if w else 0
 
     # -- cache ----------------------------------------------------------
-    def init_cache(self, batch_size):
+    def init_cache(self, batch_size, kv_sharding=None):
         """Zeroed K/V buffers, [B, max_len, Hkv, D] per attention node
         (plus [B, max_len, Hkv] f32 row scales when
         ``cache_dtype="int8"``). ``Hkv < num_heads`` under grouped-query
@@ -313,7 +313,17 @@ class Decoder:
         window nodes get a RING of only ``window`` slots plus a
         [B, window] int32 buffer of each slot's absolute position
         (-1 = never written) — decode memory O(window) regardless of
-        generation length."""
+        generation length.
+
+        ``kv_sharding`` (optional ``jax.sharding.NamedSharding`` whose
+        spec names the kv-head dimension, e.g.
+        ``NamedSharding(mesh, P(None, None, "model"))``): every K/V
+        and row-scale buffer is laid out sharded over the mesh's model
+        axis on its kv-head dim — each shard holds ``Hkv/tp`` heads of
+        every row — and ring-position buffers (rank 2, headless)
+        replicate. This is the tensor-parallel serving cache layout
+        (doc/serving.md "Tensor-parallel serving"); the matching
+        compute runs through ``_run_slots``'s ``tp=`` axis."""
         from ..ops.attention import MultiHeadAttention as _MHA
 
         caches = []
@@ -334,7 +344,28 @@ class Decoder:
             if win:
                 entry += (jnp.full((batch_size, slots), -1, jnp.int32),)
             caches.append(entry)
+        if kv_sharding is not None:
+            from jax.sharding import NamedSharding
+            mesh = kv_sharding.mesh
+            specs = self.cache_specs(caches, kv_sharding.spec[2])
+            caches = jax.tree_util.tree_map(
+                lambda c, s: jax.device_put(c, NamedSharding(mesh, s)),
+                caches, specs)
         return caches
+
+    @staticmethod
+    def cache_specs(caches, axis="model"):
+        """Per-leaf ``PartitionSpec`` tree for a cache pytree: K/V and
+        scale buffers (rank >= 3) shard their kv-head dim (dim 2) over
+        ``axis``; ring-position buffers (rank 2, no head dim)
+        replicate. Shared by ``init_cache(kv_sharding=...)`` and the
+        serving engine's shard_map program specs, so the two can never
+        drift."""
+        from jax.sharding import PartitionSpec as P
+
+        return jax.tree_util.tree_map(
+            lambda c: P(None, None, axis) if jnp.ndim(c) >= 3 else P(),
+            caches)
 
     @staticmethod
     def _quantize_rows(x):
@@ -420,7 +451,8 @@ class Decoder:
             cv = lax.slice_in_dim(cv, 0, limit, axis=1)
         return ck, cv
 
-    def _cached_mha(self, node, ins, entry, pos, valid_len=None):
+    def _cached_mha(self, node, ins, entry, pos, valid_len=None,
+                    tp=None):
         from ..ops.attention import MultiHeadAttention as _MHA
 
         x, wqkv, bqkv, wo, bo = ins
@@ -446,6 +478,22 @@ class Decoder:
                 posv = pos + jnp.arange(c)
             q = rope_rotate(q, posv, node.params["rope_base"])
             k = rope_rotate(k, posv, node.params["rope_base"])
+        if tp is not None:
+            # tensor-parallel serving (inside the engine's shard_map —
+            # doc/serving.md "Tensor-parallel serving"): everything up
+            # to here ran REPLICATED with tp=1's exact shapes (the
+            # byte-identity lever: per-device numerics never see the
+            # shard count); each shard now slices out its OWN
+            # contiguous kv-head block — query heads are kv-major, so
+            # a kv-head slice keeps every GQA group whole — and the
+            # per-head attention below runs on the local cache shard.
+            ax, ntp = tp
+            i = lax.axis_index(ax)
+            kvl, hl = kv // ntp, h // ntp
+            q = lax.dynamic_slice_in_dim(q, i * hl, hl, axis=2)
+            k = lax.dynamic_slice_in_dim(k, i * kvl, kvl, axis=2)
+            v = lax.dynamic_slice_in_dim(v, i * kvl, kvl, axis=2)
+            h, kv = hl, kvl
         win = self._node_window(node)
         if win:
             if jnp.ndim(pos) == 1:
@@ -456,6 +504,8 @@ class Decoder:
                     "does this automatically)")
             o, entry = self._window_attn(q, k, v, entry, pos, win,
                                          valid_len)
+            if tp is not None:
+                o = lax.all_gather(o, tp[0], axis=2, tiled=True)
             return jnp.einsum("bte,fe->btf", o.reshape(b, c, e),
                               wo) + bo, entry
         entry = self._write_cache(entry, k, v, pos)
@@ -509,6 +559,14 @@ class Decoder:
                               jnp.float32(-1e30).astype(s.dtype))
                 o = jnp.einsum("bKgqk,bkKd->bqKgd",
                                jax.nn.softmax(s, axis=-1), cv)
+        if tp is not None:
+            # ONE collective per attention node: gather the per-shard
+            # head outputs (axis 2 is kv-major in every o layout —
+            # bqhd, bqKgd — so tiled concat reproduces tp=1's head
+            # order exactly) and hand the REPLICATED [b, c, e] tensor
+            # to the output projection: it and every downstream
+            # position-wise op run with tp=1's shapes on every shard
+            o = lax.all_gather(o, tp[0], axis=2, tiled=True)
         return jnp.einsum("bte,fe->btf", o.reshape(b, c, e), wo) + bo, \
             entry
 
@@ -682,12 +740,20 @@ class Decoder:
         o = (acc / s[..., None]).astype(q.dtype)   # [b,h,c,d]
         return o.transpose(0, 2, 1, 3)             # [b,c,h,d]
 
-    def _run(self, params, aux, caches, pos, tokens, valid_len=None):
+    def _run(self, params, aux, caches, pos, tokens, valid_len=None,
+             tp=None):
         """One chunk: tokens [B, C] at positions [pos, pos+C) →
         (logits [B, C, V], updated caches). ``valid_len`` marks a
         right-padded chunk's true length — only windowed ring WRITES
         honor it (see ``_window_attn``); linear-cache pad rows are
-        self-correcting (masked until decode overwrites them)."""
+        self-correcting (masked until decode overwrites them).
+
+        ``tp`` (optional ``(axis_name, degree)``): the walk is running
+        INSIDE a tensor-parallel shard_map and ``caches`` hold only
+        this shard's kv heads — attention slices its shard's heads
+        out of the replicated projections and all-gathers its head
+        outputs (see ``_cached_mha``); every other op runs replicated
+        with tp=1's exact shapes."""
         env = {}
         new_caches = list(caches)
         mha_i = 0
@@ -702,7 +768,7 @@ class Decoder:
             name = n.spec.name
             if name == "MultiHeadAttention":
                 out, new_caches[mha_i] = self._cached_mha(
-                    n, ins, new_caches[mha_i], pos, valid_len)
+                    n, ins, new_caches[mha_i], pos, valid_len, tp)
                 mha_i += 1
                 env[(id(n), 0)] = out
                 continue
@@ -751,7 +817,8 @@ class Decoder:
     # reuse the exact decode math above (quantized, windowed, GQA, rope
     # included) with zero duplication.
 
-    def _run_slots(self, params, aux, caches, pos, tokens, impl=None):
+    def _run_slots(self, params, aux, caches, pos, tokens, impl=None,
+                   tp=None):
         """Per-slot-position ``_run``: ``pos`` [S] int32 positions (one
         per cache slot), ``tokens`` [S, C] → (logits [S, C, V], updated
         caches).
@@ -766,7 +833,13 @@ class Decoder:
         cache writes scatter per slot, and the attention read is the
         Pallas paged kernel (ops/pallas_kernels.py) that touches only
         each slot's live rows — the serving decode/verify hot path's
-        memory-traffic lever (doc/serving.md "Paged attention")."""
+        memory-traffic lever (doc/serving.md "Paged attention").
+
+        ``tp`` (``(axis_name, degree)``, optional): the call is
+        running inside the serving engine's tensor-parallel shard_map
+        and ``caches`` are this shard's kv-head slice — see ``_run``.
+        Dense-impl only (the Pallas kernel is not shard-mapped; the
+        engine warns and serves dense under tp)."""
         if impl is None:
             impl = self._attn_impl
         elif impl == "dense" and self._attn_impl == "paged":
@@ -779,6 +852,12 @@ class Decoder:
                 "with attn_impl='paged' — build the decoder dense "
                 "(the engine threads its own attn_impl per dispatch)")
         if impl == "paged":
+            if tp is not None:
+                raise MXNetError(
+                    "Decoder: the paged kernel does not run inside "
+                    "the tensor-parallel shard_map — serve tp meshes "
+                    "with impl='dense' (the engine does this "
+                    "automatically, with a warning)")
             return self._run(params, aux, caches,
                              jnp.asarray(pos, jnp.int32), tokens)
 
@@ -786,7 +865,8 @@ class Decoder:
             # vmap hands each lane the slot's cache WITHOUT its leading
             # axis; _run wants b=1 buffers — re-add and strip it
             sub = jax.tree_util.tree_map(lambda c: c[None], slot_caches)
-            logits, sub = self._run(params, aux, sub, p, t[None])
+            logits, sub = self._run(params, aux, sub, p, t[None],
+                                    tp=tp)
             return logits[0], jax.tree_util.tree_map(
                 lambda c: c[0], sub)
 
@@ -868,7 +948,7 @@ class Decoder:
         return jax.tree_util.tree_map(write, caches, rows)
 
     def verify_step_slots(self, params, aux, caches, state, drafts,
-                          dlen, impl=None):
+                          dlen, impl=None, tp=None):
         """Speculative draft-and-verify decode step over all S slots
         (the serving engine's verify program — doc/serving.md
         "Speculative decoding").
@@ -910,7 +990,8 @@ class Decoder:
         chunk = jnp.concatenate(
             [tok[:, None], drafts.astype(jnp.int32)], axis=1)
         logits, caches = self._run_slots(params, aux, caches, pos,
-                                         chunk, impl=impl)  # [S,K+1,V]
+                                         chunk, impl=impl,
+                                         tp=tp)             # [S,K+1,V]
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         def with_sampling(_):
@@ -955,7 +1036,7 @@ class Decoder:
         return caches, state2, jnp.stack(outs)              # [K+1, S]
 
     def draft_propose_slots(self, params, aux, caches, pos, catchup,
-                            clen, k, impl=None):
+                            clen, k, impl=None, tp=None):
         """Greedy k-token proposal from a DRAFT model sharing the
         slot-paged layout (the serving engine's draft program —
         ``InferenceEngine(draft="model")``).
@@ -971,7 +1052,8 @@ class Decoder:
         sampled requests the target's verify still gates acceptance
         against ITS sample, the draft just matches less often."""
         logits, caches = self._run_slots(params, aux, caches, pos,
-                                         catchup, impl=impl)  # [S,W,V]
+                                         catchup, impl=impl,
+                                         tp=tp)               # [S,W,V]
         idx = jnp.clip(clen - 1, 0, catchup.shape[1] - 1)
         lastlog = jnp.take_along_axis(
             logits, idx[:, None, None], axis=1)[:, 0]       # [S, V]
@@ -981,7 +1063,7 @@ class Decoder:
         def body(carry, _):
             caches, p, t = carry
             lg, caches = self._run_slots(params, aux, caches, p,
-                                         t[:, None], impl=impl)
+                                         t[:, None], impl=impl, tp=tp)
             nx = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
             return (caches, p + 1, nx), nx
 

@@ -16,7 +16,8 @@ from jax.sharding import Mesh
 
 from ..base import MXNetError
 
-__all__ = ["build_mesh", "data_parallel_mesh", "local_mesh"]
+__all__ = ["build_mesh", "data_parallel_mesh", "local_mesh",
+           "model_parallel_mesh"]
 
 
 def build_mesh(axes=None, devices=None):
@@ -62,3 +63,27 @@ def data_parallel_mesh(n_devices=None, name="dp"):
 def local_mesh():
     """The default 1-axis mesh over every visible device."""
     return data_parallel_mesh()
+
+
+def model_parallel_mesh(tp=None, name="model", devices=None):
+    """Single-axis tensor-parallel mesh over ``tp`` devices (all
+    visible devices by default) — what ``InferenceEngine(tp=...)``
+    builds to shard the serving KV cache over the kv-head dimension
+    (doc/serving.md "Tensor-parallel serving"). The axis is named
+    ``"model"``; a multi-axis mesh (e.g. dp x model for replicated
+    sharded engines) can be built with :func:`build_mesh` and passed
+    via ``InferenceEngine(mesh=...)`` as long as it carries a
+    ``model`` axis."""
+    if devices is None:
+        devices = jax.devices()
+    if tp is None:
+        tp = len(devices)
+    tp = int(tp)
+    if tp < 1:
+        raise MXNetError("model_parallel_mesh: tp must be >= 1, got %d"
+                         % tp)
+    if tp > len(devices):
+        raise MXNetError(
+            "model_parallel_mesh: tp=%d exceeds the %d visible "
+            "devices" % (tp, len(devices)))
+    return build_mesh({name: tp}, list(devices)[:tp])

@@ -198,6 +198,14 @@ _TM_SPEC_ACCEPT_LEN = tele.histogram(
     buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16))
 _TM_SPEC_NGRAM = tele.counter("serving.spec_drafts_ngram")
 _TM_SPEC_MODEL = tele.counter("serving.spec_drafts_model")
+# tensor-parallel serving (doc/serving.md "Tensor-parallel serving"):
+# info gauges set at construction — the sharding degree (1 = unsharded)
+# and each shard's slice of the serving KV cache in bytes (the
+# multi-chip win condition: decode is memory-bound, so bytes/shard is
+# what scales down with chips). Engine-last-built semantics like
+# serving.attn_impl.
+_TM_TP = tele.gauge("serving.tp_degree")
+_TM_TP_KV_BYTES = tele.gauge("serving.kv_bytes_per_shard")
 # compile_counts re-exported as telemetry: the in-engine log stays the
 # tested contract; these make recompiles visible in ONE snapshot next
 # to everything else
@@ -575,6 +583,33 @@ class InferenceEngine:
         (``--verify``). Flushed per record: a killed process leaves a
         readable log. ``snapshot()`` carries the knob, so capture
         continues across a crash cycle (fresh file, same directory).
+    tp : int, optional
+        Tensor-parallel degree (default: the ``MXNET_SERVING_TP`` env
+        var, else 1 = unsharded): the slot-paged KV cache — int8
+        scales and draft-model caches included — is sharded over a
+        ``tp``-device mesh's ``model`` axis on the KV-HEAD dimension,
+        and every compiled program family (decode, bucketed prefill,
+        per-bucket copy, verify, draft, draft_prefill) runs as ONE
+        shard_map program: each device computes its heads' attention
+        against its cache shard and everything else replicated at
+        tp=1's exact shapes, with one all-gather per attention node
+        as the only collective. One engine serves a model whose KV
+        footprint exceeds a chip, and decode's per-shard cache
+        traffic drops ~1/tp (doc/serving.md "Tensor-parallel
+        serving"). Greedy outputs are byte-identical to tp=1 across
+        the whole feature gauntlet (logits land replicated, so
+        host-side sampling identity is untouched); the compile-count
+        contract is unchanged. Every attention node's kv heads must
+        divide ``tp`` evenly (GQA groups stay whole per shard —
+        refused loudly otherwise); ``attn_impl="paged"`` is not
+        shard-mapped yet and warns + serves the dense per-shard read.
+        ``snapshot()``/``restore()`` carry the degree.
+    mesh : jax.sharding.Mesh, optional
+        Serve over an existing mesh instead of building one: must
+        carry a ``model`` axis (its size is the tp degree;
+        ``parallel.model_parallel_mesh`` builds the canonical
+        single-axis one). Mutually consistent with ``tp`` when both
+        are given.
     """
 
     def __init__(self, decoder, slots=8, prefill_buckets=None,
@@ -585,7 +620,7 @@ class InferenceEngine:
                  slo_cadence_ms=None, slo_target=0.99,
                  flight_recorder=None, spec_k=None, draft=None,
                  draft_decoder=None, attn_impl=None, capture_dir=None,
-                 capture_mb=None):
+                 capture_mb=None, tp=None, mesh=None):
         if not isinstance(decoder, Decoder):
             raise MXNetError("InferenceEngine needs a Decoder, got %r"
                              % type(decoder).__name__)
@@ -673,9 +708,64 @@ class InferenceEngine:
         self.flight = FlightRecorder(retain=int(flight_recorder))
         self.stage_depth = int(stage_depth)
 
+        # tensor-parallel serving (doc/serving.md "Tensor-parallel
+        # serving"): resolve the mesh/degree FIRST — the cache layout,
+        # the replicated parameter placement and every compiled
+        # program's shard_map wrapper depend on it
+        if mesh is None and tp is None:
+            tp = int(os.environ.get("MXNET_SERVING_TP", "") or 1)
+        if mesh is not None:
+            if "model" not in mesh.axis_names:
+                raise MXNetError(
+                    "InferenceEngine: mesh=... needs a 'model' axis "
+                    "to shard the KV cache over (axes: %r) — "
+                    "parallel.model_parallel_mesh builds one"
+                    % (mesh.axis_names,))
+            if tp is not None and int(tp) != int(mesh.shape["model"]):
+                raise MXNetError(
+                    "InferenceEngine: tp=%r disagrees with the mesh's "
+                    "model axis size %d — pass one or the other"
+                    % (tp, mesh.shape["model"]))
+            tp = int(mesh.shape["model"])
+        tp = int(tp)
+        if tp < 1:
+            raise MXNetError("InferenceEngine: tp must be >= 1 "
+                             "(1 = unsharded; MXNET_SERVING_TP sets "
+                             "the default), got %d" % tp)
+        if tp > 1 and mesh is None:
+            from ..parallel.mesh import model_parallel_mesh
+            mesh = model_parallel_mesh(tp)
+        self.tp = tp
+        self._mesh = mesh if tp > 1 else None
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..ops.attention import MultiHeadAttention as _MHA
+            # GQA head partitioning must divide evenly or refuse
+            # loudly — an uneven split would give shards different
+            # compute shapes and break the replicated-prefix
+            # byte-identity argument
+            for n in decoder._mha:
+                _MHA.check_head_shards(n.params, tp)
+            self._kv_shard = NamedSharding(
+                self._mesh, PartitionSpec(None, None, "model"))
+            rep = NamedSharding(self._mesh, PartitionSpec())
+            self._rep_shard = rep
+            # the engine's OWN replicated parameter placement: the
+            # decoder object (and its offline oracle programs) stays
+            # untouched, so one set of weights can serve tp=1 and
+            # tp>1 engines side by side (the identity tests do)
+            self._params = {k: jax.device_put(v, rep)
+                            for k, v in decoder._params.items()}
+            self._aux = [jax.device_put(v, rep) for v in decoder._aux]
+        else:
+            self._kv_shard = None
+            self._rep_shard = None
+            self._params, self._aux = decoder._params, decoder._aux
+        _TM_TP.set(tp)
+
         # device-resident: the slot-paged cache + per-slot state vectors
         S = self.slots
-        self._caches = decoder.init_cache(S)
+        self._caches = decoder.init_cache(S, kv_sharding=self._kv_shard)
         self._state = (
             jnp.zeros((S,), jnp.int32),        # pos: next write position
             jnp.zeros((S,), jnp.int32),        # tok: last sampled token
@@ -685,6 +775,9 @@ class InferenceEngine:
             jnp.full((S,), -1, jnp.int32),     # eos id (-1: none)
             jnp.zeros((S,), jnp.int32),        # last allowed position
         )
+        if self._mesh is not None:
+            self._state = tuple(jax.device_put(s, self._rep_shard)
+                                for s in self._state)
 
         # prefix-reuse pool: a SEPARATE cache tree of pool slots (same
         # per-slot layout) holding retained prompt K/V. Separate, not
@@ -729,17 +822,45 @@ class InferenceEngine:
                 "with the exact dense ring walk instead", UserWarning,
                 stacklevel=2)
             attn_impl = "dense"
+        if attn_impl == "paged" and self.tp > 1:
+            if decoder._attn_impl == "paged":
+                raise MXNetError(
+                    "InferenceEngine: tp>1 cannot serve a Decoder "
+                    "built with attn_impl='paged' (its attention "
+                    "always takes the kernel path) — build the "
+                    "decoder dense to serve tensor-parallel")
+            # warn LOUDLY, serve dense (windowed-ring precedent): the
+            # Pallas kernel's grid is not shard-mapped yet — a
+            # per-shard kv-head grid is the natural composition and
+            # stays open work (doc/serving.md)
+            warnings.warn(
+                "InferenceEngine: attn_impl='paged' does not compose "
+                "with tensor-parallel serving (the Pallas paged "
+                "kernel is not shard-mapped) — serving the tp=%d "
+                "mesh with the dense per-shard cache read instead"
+                % self.tp, UserWarning, stacklevel=2)
+            attn_impl = "dense"
         self.attn_impl = attn_impl
         _TM_ATTN_IMPL.set(1 if attn_impl == "paged" else 0)
         slot_bytes = sum(x.nbytes for x in
                          jax.tree_util.tree_leaves(self._caches)) // S
+        # per-shard KV residency (jax Array.nbytes is GLOBAL, so the
+        # byte-budget semantics above are tp-invariant): the gauge the
+        # tp sweep reads — what actually sits on each chip. Only
+        # head-dim buffers (rank >= 3) shard; windowed rings'
+        # position buffers replicate and reside in FULL on every
+        # shard (Decoder.cache_specs is the layout source of truth)
+        _TM_TP_KV_BYTES.set(sum(
+            x.nbytes // self.tp if x.ndim >= 3 else x.nbytes
+            for x in jax.tree_util.tree_leaves(self._caches)))
         pool_slots = 0
         if self.prefix_cache_mb > 0 and not self._windowed:
             pool_slots = min(
                 int(self.prefix_cache_mb * 2**20) // max(1, slot_bytes),
                 _MAX_POOL_SLOTS)
         if pool_slots > 0:
-            self._pool = decoder.init_cache(pool_slots)
+            self._pool = decoder.init_cache(pool_slots,
+                                            kv_sharding=self._kv_shard)
             self._prefix = PrefixCache(pool_slots, slot_bytes)
         else:
             self._pool = None
@@ -808,7 +929,22 @@ class InferenceEngine:
                     "supported (the catch-up chunk would wrap junk "
                     "onto live ring rows)")
             self._draft_dec = draft_decoder
-            self._draft_caches = draft_decoder.init_cache(S)
+            if self._mesh is not None:
+                from ..ops.attention import MultiHeadAttention as _MHA
+                for n in draft_decoder._mha:
+                    _MHA.check_head_shards(
+                        n.params, self.tp,
+                        where="tensor-parallel draft serving")
+                self._draft_params = {
+                    k: jax.device_put(v, self._rep_shard)
+                    for k, v in draft_decoder._params.items()}
+                self._draft_aux = [jax.device_put(v, self._rep_shard)
+                                   for v in draft_decoder._aux]
+            else:
+                self._draft_params = draft_decoder._params
+                self._draft_aux = draft_decoder._aux
+            self._draft_caches = draft_decoder.init_cache(
+                S, kv_sharding=self._kv_shard)
             self._draft_pos = [0] * S     # next draft-cache position
             self._draft_pending = [[] for _ in range(S)]
 
@@ -849,13 +985,21 @@ class InferenceEngine:
 
         # the compiled program families; the log records one tag
         # per TRACE (python side effects run at trace time only), so it
-        # IS the compile count — tests pin the contract against it
+        # IS the compile count — tests pin the contract against it.
+        # Under tp>1 every family body is wrapped in ONE shard_map
+        # (_wrap_tp) before jit — same families, same counts, sharded
+        # execution.
         self._compile_log = []
+        self._tp_ax = ("model", self.tp) if self._mesh is not None \
+            else None
         on_chip = jax.default_backend() != "cpu"
         self._donate = (2, 3) if on_chip else ()
         self._copy_donate = (0, 1) if on_chip else ()
-        self._step_fn = jax.jit(self._make_step(),
-                                donate_argnums=self._donate)
+        cs = self._cache_spec(self._caches)
+        self._step_fn = jax.jit(
+            self._wrap_tp(self._make_step(),
+                          ("r", "r", cs, "r"), (cs, "r", "r")),
+            donate_argnums=self._donate)
         self._prefill_fns = {}
         self._copy_fns = {}
         # speculative-decoding programs: ONE verify program (the whole
@@ -865,11 +1009,17 @@ class InferenceEngine:
         self._draft_fn = None
         self._draft_prefill_fns = {}
         if self._spec:
-            self._verify_fn = jax.jit(self._make_verify(),
-                                      donate_argnums=self._donate)
+            self._verify_fn = jax.jit(
+                self._wrap_tp(self._make_verify(),
+                              ("r", "r", cs, "r", "r", "r"),
+                              (cs, "r", "r")),
+                donate_argnums=self._donate)
             if self.spec_draft == "model":
+                dcs = self._cache_spec(self._draft_caches)
                 self._draft_fn = jax.jit(
-                    self._make_draft(),
+                    self._wrap_tp(self._make_draft(),
+                                  ("r", "r", dcs, "r", "r", "r"),
+                                  (dcs, "r")),
                     donate_argnums=(2,) if on_chip else ())
         # observability plane: watchdog/liveness state read by
         # health() and the exposition server's /healthz, plus the
@@ -906,8 +1056,8 @@ class InferenceEngine:
                         slo_target=0.99, flight_recorder=None,
                         spec_k=None, draft=None, draft_decoder=None,
                         draft_prefix=None, draft_epoch=None,
-                        attn_impl=None, capture_dir=None,
-                        **decoder_kwargs):
+                        attn_impl=None, capture_dir=None, tp=None,
+                        mesh=None, **decoder_kwargs):
         """Checkpoint → serving engine in one call
         (``prefix-symbol.json`` + ``prefix-NNNN.params``, the reference
         format): builds the :class:`Decoder` via
@@ -939,13 +1089,56 @@ class InferenceEngine:
                    slo_cadence_ms=slo_cadence_ms, slo_target=slo_target,
                    flight_recorder=flight_recorder, spec_k=spec_k,
                    draft=draft, draft_decoder=draft_decoder,
-                   attn_impl=attn_impl, capture_dir=capture_dir)
+                   attn_impl=attn_impl, capture_dir=capture_dir,
+                   tp=tp, mesh=mesh)
 
     # -- compiled programs ----------------------------------------------
+    def _cache_spec(self, tree):
+        """Per-leaf PartitionSpec tree for a cache pytree under tp
+        (None at tp=1) — Decoder.cache_specs, so the program specs and
+        the cache layout can never drift."""
+        if self._mesh is None:
+            return None
+        return Decoder.cache_specs(tree)
+
+    def _wrap_tp(self, fn, in_specs, out_specs):
+        """Tensor-parallel program wrapper (no-op at tp=1): shard_map
+        ``fn`` over the mesh's model axis. ``"r"`` entries mean
+        replicated (every device sees the full operand at tp=1's
+        exact shape — the byte-identity lever); cache-spec trees mark
+        the kv-head-sharded cache arguments. Inside, each device runs
+        a plain single-device program on its cache shard; the ONLY
+        collectives are the one-per-attention-node all-gathers
+        ``Decoder._cached_mha`` inserts, so the program count and the
+        trace-time compile log are exactly the tp=1 ones.
+        ``check_rep=False``: replication of the replicated outputs is
+        by construction (identical inputs, identical per-device
+        programs), not something the rep-checker can see through the
+        collectives."""
+        if self._mesh is None:
+            return fn
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec
+
+        rep = PartitionSpec()
+
+        def is_r(s):
+            return isinstance(s, str) and s == "r"
+
+        in_specs = tuple(rep if is_r(s) else s for s in in_specs)
+        if is_r(out_specs):
+            out_specs = rep
+        elif isinstance(out_specs, tuple) \
+                and not isinstance(out_specs, PartitionSpec):
+            out_specs = tuple(rep if is_r(s) else s for s in out_specs)
+        return shard_map(fn, mesh=self._mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
     def _make_step(self):
         dec = self._dec
         k_rounds = self.steps_per_round
         impl = self.attn_impl
+        tp_ax = self._tp_ax
 
         def one_step(caches, state, params, aux):
             pos, tok, live, temp, keys, eos, last = state
@@ -953,7 +1146,8 @@ class InferenceEngine:
             # logits for the next one (frozen slots rewrite their last
             # token in place — idempotent)
             logits, caches = dec._run_slots(params, aux, caches, pos,
-                                            tok[:, None], impl=impl)
+                                            tok[:, None], impl=impl,
+                                            tp=tp_ax)
             logits = logits[:, 0]
             nxt_pos = pos + 1
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -1015,13 +1209,15 @@ class InferenceEngine:
         program instead (the fallback path, counted)."""
         dec = self._dec
         impl = self.attn_impl
+        tp_ax = self._tp_ax
 
         def verify(params, aux, caches, state, drafts, dlen):
             if not profiler.collecting():
                 self._compile_log.append("verify")
                 _TM_COMPILE_VERIFY.inc()
             return dec.verify_step_slots(params, aux, caches, state,
-                                         drafts, dlen, impl=impl)
+                                         drafts, dlen, impl=impl,
+                                         tp=tp_ax)
 
         return verify
 
@@ -1033,6 +1229,7 @@ class InferenceEngine:
         ddec = self._draft_dec
         k = self.spec_k
         impl = self.attn_impl
+        tp_ax = self._tp_ax
 
         def draft(params, aux, caches, pos, catchup, clen):
             if not profiler.collecting():
@@ -1040,7 +1237,7 @@ class InferenceEngine:
                 _TM_COMPILE_DRAFT.inc()
             return ddec.draft_propose_slots(params, aux, caches, pos,
                                             catchup, clen, k,
-                                            impl=impl)
+                                            impl=impl, tp=tp_ax)
 
         return draft
 
@@ -1054,6 +1251,7 @@ class InferenceEngine:
         beats maintaining a second pool)."""
         if bucket not in self._draft_prefill_fns:
             ddec = self._draft_dec
+            tp_ax = self._tp_ax
 
             def dprefill(params, aux, caches, slot, tokens, start,
                          true_len):
@@ -1064,17 +1262,22 @@ class InferenceEngine:
                 sub = ddec.clear_window_positions(
                     sub, only_if=start == jnp.int32(0))
                 _, sub = ddec._run(params, aux, sub, start, tokens,
-                                   valid_len=start + true_len)
+                                   valid_len=start + true_len,
+                                   tp=tp_ax)
                 return ddec.slot_update(caches, slot, sub)
 
+            dcs = self._cache_spec(self._draft_caches)
             self._draft_prefill_fns[bucket] = jax.jit(
-                dprefill,
+                self._wrap_tp(dprefill,
+                              ("r", "r", dcs, "r", "r", "r", "r"),
+                              dcs),
                 donate_argnums=(2,) if self._donate else ())
         return self._draft_prefill_fns[bucket]
 
     def _prefill_fn(self, bucket):
         if bucket not in self._prefill_fns:
             dec = self._dec
+            tp_ax = self._tp_ax
 
             def prefill(params, aux, caches, state, slot, tokens,
                         start, true_len, final, temp, key, eos,
@@ -1099,7 +1302,7 @@ class InferenceEngine:
                 # cache rows are masked-until-overwritten, ring slots
                 # wrap)
                 logits, sub = dec._run(params, aux, sub, start, tokens,
-                                       valid_len=total)
+                                       valid_len=total, tp=tp_ax)
                 caches = dec.slot_update(caches, slot, sub)
                 v = logits.shape[2]
                 zero = jnp.int32(0)
@@ -1132,8 +1335,12 @@ class InferenceEngine:
                           lasts.at[slot].set(lastp))
                 return caches, state2, t0
 
+            cs = self._cache_spec(self._caches)
             self._prefill_fns[bucket] = jax.jit(
-                prefill, donate_argnums=self._donate)
+                self._wrap_tp(prefill,
+                              ("r", "r", cs) + ("r",) * 10,
+                              (cs, "r", "r")),
+                donate_argnums=self._donate)
         return self._prefill_fns[bucket]
 
     def _copy_fn(self, bucket):
@@ -1170,7 +1377,13 @@ class InferenceEngine:
                 return serv, pool
 
             self._copy_fns[bucket] = jax.jit(
-                copy, donate_argnums=self._copy_donate)
+                self._wrap_tp(copy,
+                              (self._cache_spec(self._caches),
+                               self._cache_spec(self._pool),
+                               "r", "r", "r", "r"),
+                              (self._cache_spec(self._caches),
+                               self._cache_spec(self._pool))),
+                donate_argnums=self._copy_donate)
         return self._copy_fns[bucket]
 
     def _dispatch_copy(self, length, src, dst, src_pool, dst_pool):
@@ -1263,7 +1476,11 @@ class InferenceEngine:
             bucket = self._bucket_for(p)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :p] = req.seq
-            dev = jax.device_put(padded)
+            # under tp the staged array must land REPLICATED on the
+            # mesh (a bare device_put commits to device 0, which the
+            # sharded programs would reject)
+            dev = jax.device_put(padded, self._rep_shard) \
+                if self._mesh is not None else jax.device_put(padded)
             self.flight.event(req.id, "staged", bucket=bucket)
             return req, dev
         except Exception as e:               # noqa: BLE001 — isolated
@@ -1739,7 +1956,7 @@ class InferenceEngine:
             chunk = np.zeros((1, bucket), np.int32)
             chunk[0, :piece] = req.seq[start:start + piece]
             self._draft_caches = self._draft_prefill_fn(bucket)(
-                self._draft_dec._params, self._draft_dec._aux,
+                self._draft_params, self._draft_aux,
                 self._draft_caches, np.int32(slot), chunk,
                 np.int32(start), np.int32(piece))
             start += piece
@@ -1778,7 +1995,7 @@ class InferenceEngine:
         flt = _SERVING_FAULTS
         if flt is not None:
             flt.serving_h2d(req)         # injected per-request fault
-        params, aux = self._dec._params, self._dec._aux
+        params, aux = self._params, self._aux
         start = st["next"]
         p = len(req.seq)
         remaining = p - start
@@ -2078,14 +2295,14 @@ class InferenceEngine:
         with tele.span("serving.verify_round", cat="serving",
                        slots_busy=busy, drafted=ndraft):
             self._caches, self._state, out = self._verify_fn(
-                self._dec._params, self._dec._aux, self._caches,
+                self._params, self._aux, self._caches,
                 self._state, drafts, dlen)
         self._phase_add("dispatch", time.perf_counter() - tv0)
         if "verify" not in self._prog_seen:
             self._prog_seen.add("verify")
             profiler.register_program(
                 "serving_verify", self._verify_fn,
-                (self._dec._params, self._dec._aux, self._caches,
+                (self._params, self._aux, self._caches,
                  self._state, np.zeros((S, K), np.int32),
                  np.zeros((S,), np.int32)))
         self._drain.append(("verify", out, dlen))
@@ -2140,15 +2357,15 @@ class InferenceEngine:
                         newly_done.append(s)
             tdf0 = time.perf_counter()
             self._draft_caches, props = self._draft_fn(
-                dd._params, dd._aux, self._draft_caches, pos, catchup,
-                clen)
+                self._draft_params, self._draft_aux,
+                self._draft_caches, pos, catchup, clen)
             self._phase_add("dispatch", time.perf_counter() - tdf0)
             if "draft" not in self._prog_seen:
                 self._prog_seen.add("draft")
                 profiler.register_program(
                     "serving_draft", self._draft_fn,
-                    (dd._params, dd._aux, self._draft_caches, pos,
-                     catchup, clen))
+                    (self._draft_params, self._draft_aux,
+                     self._draft_caches, pos, catchup, clen))
             if newly_done:
                 props = np.asarray(props)                   # [S, K]
                 for s in newly_done:
@@ -2238,7 +2455,7 @@ class InferenceEngine:
                     with tele.span("serving.decode_round",
                                    cat="serving", slots_busy=busy):
                         self._caches, self._state, out = self._step_fn(
-                            self._dec._params, self._dec._aux,
+                            self._params, self._aux,
                             self._caches, self._state)
                     self._phase_add("dispatch",
                                     time.perf_counter() - td0)
@@ -2247,7 +2464,7 @@ class InferenceEngine:
                         self._prog_seen.add("decode")
                         profiler.register_program(
                             "serving_decode", self._step_fn,
-                            (self._dec._params, self._dec._aux,
+                            (self._params, self._aux,
                              self._caches, self._state))
                     self._drain.append(("step", out))
                     self.stats["steps"] += 1
@@ -2569,6 +2786,7 @@ class InferenceEngine:
             "spec_k": self.spec_k,
             "draft": self.spec_draft,
             "attn_impl": self.attn_impl,
+            "tp": self.tp,
             "capture_dir": getattr(self, "capture_dir", None),
         }
 

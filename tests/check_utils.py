@@ -1,10 +1,64 @@
 """Gradient-checking oracle — port of
 /root/reference/tests/python/unittest/check_utils.py (finite-difference
-numeric gradients via a NumpyOp sum loss + random projection)."""
+numeric gradients via a NumpyOp sum loss + random projection) — plus
+shared serving-test assertions (the compile-count contract pin)."""
 import numpy as np
 
 import mxnet_tpu as mx
 from mxnet_tpu.operator import NumpyOp
+
+
+def assert_compile_contract(engine, decode=1, verify="<=1",
+                            prefill="once", copy="once", draft="<=1",
+                            draft_prefill="once"):
+    """Pin the serving engine's compile-count contract
+    ({decode: 1, verify: <=1, prefill: 1/bucket, copy: 1/bucket,
+    + draft families for draft="model" engines} — doc/serving.md):
+    ONE shared assertion instead of a hand-copied pin per test, so the
+    contract can never drift between files.
+
+    Scalar families (``decode``/``verify``/``draft``) take an exact
+    int or ``"<=1"``; bucketed families (``prefill``/``copy``/
+    ``draft_prefill``) take an exact ``{bucket: count}`` dict or
+    ``"once"`` (= every bucket actually used compiled exactly once,
+    whatever the bucket set — the default, since most workloads'
+    bucket sets are draw-dependent). ``copy={}`` pins that NO copy
+    programs exist (prefix cache off). The draft families are only
+    checked on engines that report them (draft="model"). Returns
+    ``engine.compile_counts`` for any extra assertions the caller
+    wants to stack on."""
+    cc = engine.compile_counts
+
+    def scalar(name, want):
+        got = cc[name]
+        if want == "<=1":
+            assert got <= 1, \
+                "compile contract: %s compiled %d times (contract: " \
+                "<= 1) — %r" % (name, got, cc)
+        else:
+            assert got == want, \
+                "compile contract: %s compiled %d times (want %d) " \
+                "— %r" % (name, got, want, cc)
+
+    def family(name, want):
+        got = cc[name]
+        if want == "once":
+            assert all(v == 1 for v in got.values()), \
+                "compile contract: %s family recompiled a bucket " \
+                "(want one program per used bucket) — %r" % (name, cc)
+        else:
+            assert got == dict(want), \
+                "compile contract: %s family is %r (want %r) — %r" \
+                % (name, got, want, cc)
+
+    scalar("decode", decode)
+    scalar("verify", verify)
+    family("prefill", prefill)
+    family("copy", copy)
+    if "draft" in cc:
+        scalar("draft", draft)
+        family("draft_prefill", draft_prefill)
+    return cc
 
 
 def reldiff(a, b):

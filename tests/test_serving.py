@@ -36,6 +36,8 @@ from mxnet_tpu.models import get_transformer_lm
 from mxnet_tpu.parallel import Decoder
 from mxnet_tpu.serving import InferenceEngine
 
+from check_utils import assert_compile_contract
+
 # 1 layer keeps this file's compile bill inside the tier-1 budget; the
 # multi-node cache-list plumbing the engine reuses is pinned offline by
 # test_decode.py (2 layers), and every identity oracle here is
@@ -149,8 +151,8 @@ def test_engine_mixed_lengths_slot_reuse_byte_identical(lm,
     assert eng.stats["prefills"] == len(reqs) > eng.slots  # slot reuse
     for p, n, r in reqs:
         np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
-    assert eng.compile_counts == {"decode": 1, "verify": 1,
-                                  "prefill": {4: 1, 8: 1}, "copy": {}}
+    assert_compile_contract(eng, verify=1, prefill={4: 1, 8: 1},
+                            copy={})
     # the tentpole's point: drafts were proposed AND accepted — tokens
     # landed more-than-one per verify dispatch, byte-identically
     assert eng.stats["spec_rounds"] >= 1
@@ -189,8 +191,8 @@ def test_engine_mixed_lengths_slot_reuse_byte_identical(lm,
     eng.serve_forever()
     for p, n, r in wave2:
         np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
-    assert eng.compile_counts == {"decode": 1, "verify": 1,
-                                  "prefill": {4: 1, 8: 1}, "copy": {}}
+    assert_compile_contract(eng, verify=1, prefill={4: 1, 8: 1},
+                            copy={})
     assert eng.idle
 
 
@@ -215,8 +217,7 @@ def test_engine_multi_step_rounds_byte_identical(lm):
     eng.serve_forever()
     for p, n, r in reqs:
         np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
-    cc = eng.compile_counts
-    assert cc["decode"] == 1 and cc["verify"] <= 1
+    assert_compile_contract(eng)
     assert eng.stats["spec_rounds"] >= 1      # verify rounds ran
     assert eng.stats["spec_fallback_rounds"] >= 1  # and scan rounds
     assert eng.idle
@@ -348,15 +349,13 @@ def test_engine_cache_flavors_match_offline(flavor):
         np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
     if flavor == "int8":
         assert eng.stats["prefix_hit_tokens"] > 0  # scales copied too
-        assert eng.compile_counts["copy"]
+        assert assert_compile_contract(eng)["copy"]
         assert eng.spec_draft == "ngram"       # int8 speculates
-        assert eng.compile_counts["verify"] <= 1
     else:
         assert eng._prefix is None and eng._pool is None  # the bypass
-        assert eng.compile_counts["copy"] == {}
+        assert_compile_contract(eng, verify=0, copy={})
         assert eng.stats["prefill_chunks"] > len(cases)  # chunks ran
         assert eng.spec_draft == "off"         # the loud ring bypass
-        assert eng.compile_counts["verify"] == 0
         assert eng.stats["spec_rounds"] == 0
 
 
@@ -382,9 +381,9 @@ def test_engine_draft_model_speculation(lm):
     eng.serve_forever()
     for p, n, r in reqs:
         np.testing.assert_array_equal(r.result(), _oracle(dec, p, n))
-    assert eng.compile_counts == {
-        "decode": 1, "verify": 1, "prefill": {4: 1, 8: 1}, "copy": {},
-        "draft": 1, "draft_prefill": {4: 1, 8: 1}}
+    assert_compile_contract(eng, verify=1, prefill={4: 1, 8: 1},
+                            copy={}, draft=1,
+                            draft_prefill={4: 1, 8: 1})
     # same weights -> drafts always match until a budget/eos stop:
     # strictly more than one token per verify dispatch on average
     assert eng.stats["spec_accepted"] > eng.stats["spec_rounds"] >= 1
@@ -439,14 +438,10 @@ def test_engine_prefix_cache_chunked_byte_identical(lm):
     assert sum(r.prefill_chunks for r in rs.values()) \
         == eng.stats["prefill_chunks"]
     assert eng._prefix.evictions >= 1             # the 1-slot pool churned
-    cc = eng.compile_counts
-    assert cc["decode"] == 1
-    assert cc["copy"] and all(v == 1 for v in cc["copy"].values())
-    assert all(v == 1 for v in cc["prefill"].values())
     # speculation rode the whole gauntlet (the _engine default is
     # draft="ngram"): verify compiled at most once, and verify rounds
     # actually served prefix-hit/chunked traffic byte-identically
-    assert cc["verify"] <= 1
+    assert assert_compile_contract(eng)["copy"]
     assert eng.stats["spec_rounds"] + eng.stats["spec_fallback_rounds"] \
         > 0
 

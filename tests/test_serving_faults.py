@@ -31,6 +31,8 @@ from mxnet_tpu.serving import (InferenceEngine, EngineOverloaded,
                                EngineClosed, EngineStuck)
 from mxnet_tpu.testing.faults import FaultInjector, InjectedCrash
 
+from check_utils import assert_compile_contract
+
 pytestmark = pytest.mark.faults
 
 VOCAB, T = 17, 16
@@ -416,19 +418,13 @@ def test_crash_mid_round_restore_byte_identical(lm, ceng):
     if eng2._prefix is not None:
         assert eng2._prefix.pinned == 0
     assert len(eng2._free) == eng2.slots
-    cc = eng2.compile_counts
-    assert cc["decode"] == 1 and cc["verify"] <= 1
-    assert all(v == 1 for v in cc["prefill"].values())
-    assert all(v == 1 for v in cc["copy"].values())
+    assert_compile_contract(eng2)
     # the crashed engine still drains clean too (same process: a REAL
     # kill would just drop it) — contract also pinned there
     ceng.serve_forever()
     assert ceng._prefix.pinned == 0
     assert len(ceng._free) == ceng.slots
-    cc = ceng.compile_counts
-    assert cc["decode"] == 1 and cc["verify"] <= 1
-    assert all(v == 1 for v in cc["prefill"].values())
-    assert all(v == 1 for v in cc["copy"].values())
+    assert_compile_contract(ceng)
     eng2.close()
 
 
@@ -568,8 +564,7 @@ def test_close_fails_pending_and_is_idempotent(lm, feng):
     # every robustness path this file drove compiled NOTHING new (all
     # prompts in this file share bucket 4 — one program, ever; feng
     # serves spec-off, so verify never compiles)
-    assert feng.compile_counts == {"decode": 1, "verify": 0,
-                                   "prefill": {4: 1}, "copy": {}}
+    assert_compile_contract(feng, verify=0, prefill={4: 1}, copy={})
     feng.close()
     assert c1.done and c1.retire_reason == "closed"
     assert c2.done and c2.retire_reason == "closed"

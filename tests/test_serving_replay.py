@@ -29,6 +29,8 @@ import pytest
 import jax.numpy as jnp
 
 import mxnet_tpu as mx
+
+from check_utils import assert_compile_contract
 from mxnet_tpu.base import MXNetError
 from mxnet_tpu.models import get_transformer_lm
 from mxnet_tpu.parallel import Decoder
@@ -120,10 +122,7 @@ def captured(lm, tmp_path_factory):
     handles = [eng.submit(p, max_tokens=n) for p, n in cases]
     done = eng.serve_forever()
     assert len(done) == len(cases)
-    cc = eng.compile_counts
-    assert cc["decode"] == 1 and cc["verify"] <= 1 \
-        and all(v == 1 for v in cc["prefill"].values()) \
-        and all(v == 1 for v in cc["copy"].values())
+    assert_compile_contract(eng)
     rounds = eng.round_table()
 
     # crash cycle: two fresh requests, a few rounds in, snapshot,
@@ -213,10 +212,7 @@ def test_replay_verify_spec_off_byte_identical(lm, captured,
     assert report["verified_prefix"] == 1          # the crash-cut one
     assert report["mismatches"] == []
     assert report["verify_skipped"] == 0
-    cc = eng.compile_counts
-    assert cc["decode"] == 1 and cc["verify"] == 0 \
-        and all(v == 1 for v in cc["prefill"].values()) \
-        and all(v == 1 for v in cc["copy"].values())
+    assert_compile_contract(eng, verify=0)
     # the report carries the recorded run's latency block to diff
     # against (the capture's own retire timings)
     assert report["recorded"]["ttft_p50_ms"] > 0
@@ -238,10 +234,26 @@ def test_replay_verify_different_round_geometry(lm, captured):
     assert report["verified"] == len(captured["cases"])
     assert report["verified_prefix"] == 1
     assert report["mismatches"] == []
-    cc = eng.compile_counts
-    assert cc["decode"] == 1 and cc["verify"] <= 1 \
-        and all(v == 1 for v in cc["prefill"].values()) \
-        and cc["copy"] == {}
+    assert_compile_contract(eng, copy={})
+
+
+def test_replay_verify_tp2(lm, captured):
+    """Acceptance flavor 3 (ISSUE 14): the ``--tp`` override axis — a
+    single-chip capture validates a TENSOR-PARALLEL config offline.
+    The spec-on + prefix-cache + chunked capture replays verify-clean
+    on a tp=2 engine (KV cache and every program sharded over a real
+    2-device mesh; greedy byte-identity across tp is part of the
+    serving contract), crash-cut request prefix-verified, compile
+    contract intact."""
+    cap = load_capture(captured["path"])
+    assert cap["engine"].get("tp", 1) == 1    # captured single-chip
+    eng = replay_serving.build_engine(cap, _dec(lm), tp=2)
+    assert eng.tp == 2 and eng._mesh is not None
+    report = replay_serving.replay(cap, eng, timing="max", verify=True)
+    assert report["verified"] == len(captured["cases"])
+    assert report["verified_prefix"] == 1
+    assert report["mismatches"] == []
+    assert_compile_contract(eng)
 
 
 def test_replay_recorded_timing_paces_arrivals(lm, captured,

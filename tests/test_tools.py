@@ -408,6 +408,8 @@ def test_dump_telemetry_serving_filter(tmp_path, capsys):
         "round_wall_ms": hist(4.0),
         "capture_records": 9, "capture_skipped": 1,
         "capture_bytes": 4096.0,
+        # ISSUE 14: tensor-parallel sharding info gauges
+        "tp_degree": 2, "kv_bytes_per_shard": 524288,
     }}
     snap_path = tmp_path / "snap.json"
     snap_path.write_text(json.dumps(snap))
@@ -423,6 +425,9 @@ def test_dump_telemetry_serving_filter(tmp_path, capsys):
     assert "(round wall)" in out
     assert "capture:" in out and "records=9" in out \
         and "skipped=1" in out
+    # sharding line (ISSUE 14): axis, degree, per-shard KV bytes
+    assert "sharding:" in out and "axis=model tp=2" in out \
+        and "kv_bytes_per_shard=524288" in out
     # speculation line (PR 10): accept rate + drafter source mix +
     # fallback rounds, next to the latency histograms they explain
     assert "accept_rate=0.75" in out and "fallback_rounds=2" in out

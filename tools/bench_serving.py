@@ -24,6 +24,16 @@ paged kernel that walks only each slot's live KV rows, same stream per
 seed — each cell reports tokens/s, cadence p50/p99, and the decode
 program's ``bytes_accessed`` per dispatch (the traffic-cut metric).
 
+``--tps 1 2 4`` adds a tensor-parallel sweep over
+``bench.bench_serving_tp`` (ISSUE 14): one cell per degree on the
+SAME stream/seed — greedy outputs are byte-identical across degrees
+by the engine contract (digest-asserted), so the cells differ only in
+tokens/s, cadence p99 and the PER-SHARD decode ``bytes_accessed``
+(the sharded program's cost analysis carries local shapes — the
+memory-traffic cut is the multi-chip win condition; CPU wall clock
+pays collective overhead an ICI-attached chip amortizes). ``--heads``
+must divide every swept degree.
+
 ``--spec-ks`` adds a third sweep over ``bench.bench_serving_spec``
 (repetition-friendly few-shot-style workload): one cell per draft
 length K (0 = speculation off), same stream per seed, reporting
@@ -99,6 +109,15 @@ def main():
     ap.add_argument("--spec-requests", type=int, default=32,
                     help="requests per speculation-sweep cell")
     ap.add_argument("--no-spec-sweep", action="store_true")
+    ap.add_argument("--tps", type=int, nargs="+", default=[],
+                    help="tensor-parallel sweep axis (e.g. 1 2 4): "
+                         "one bench_serving_tp cell per degree — KV "
+                         "cache + programs sharded over the mesh's "
+                         "model axis; outputs digest-asserted "
+                         "byte-identical across cells; reports "
+                         "per-shard decode bytes_accessed. Needs that "
+                         "many devices (CPU smoke: export XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=8)")
     ap.add_argument("--attn-impls", nargs="+", default=[],
                     help="attention-impl sweep axis (e.g. dense "
                          "paged): one bench_serving cell per impl at "
@@ -206,6 +225,25 @@ def main():
                  "compile_programs")}
         out["impl_%s" % impl] = cell
         print("impl_%s: %r" % (impl, cell), file=sys.stderr)
+    # tensor-parallel sweep (ISSUE 14): same stream/seed per degree,
+    # byte-identity digest-asserted across cells before any number is
+    # trusted; bytes_accessed is PER SHARD (the multi-chip cut)
+    digests = {}
+    for tpd in args.tps:
+        r = bench.bench_serving_tp(
+            tp=tpd, slots=args.slots[0], layers=args.layers,
+            embed=args.embed, heads=args.heads, vocab=args.vocab,
+            max_len=args.max_len, n_requests=args.requests, seed=3)
+        digests[tpd] = r.pop("digest")
+        cell = {k: r[k] for k in
+                ("tokens_per_sec", "p50_ms_per_token",
+                 "p99_ms_per_token", "decode_bytes_accessed_per_shard",
+                 "kv_bytes_per_shard")}
+        out["tp%d" % tpd] = cell
+        print("tp%d: %r" % (tpd, cell), file=sys.stderr)
+    if digests:
+        assert len(set(digests.values())) == 1, \
+            "tp sweep outputs diverged: %r" % (digests,)
     print(json.dumps(out, sort_keys=True))
 
 

@@ -131,6 +131,17 @@ def print_serving(snap, out=None):
                      s.get("slo_cadence_burn_1m", 0),
                      s.get("slo_cadence_burn_5m", 0),
                      s.get("slo_cadence_burn_1h", 0)))
+    # tensor-parallel sharding (ISSUE 14): degree + per-shard KV
+    # residency (the multi-chip win condition — decode is
+    # memory-bound, so each chip's cache slice is what scales down);
+    # the axis is always the mesh's "model" axis
+    tpd = s.get("tp_degree")
+    if tpd and int(tpd) > 1:     # tp=1 engines have no mesh/axis
+        out.write("sharding:         axis=model tp=%d "
+                  "kv_bytes_per_shard=%s\n"
+                  % (int(tpd),
+                     "n/a" if s.get("kv_bytes_per_shard") is None
+                     else "%d" % s["kv_bytes_per_shard"]))
     # attention impl + decode memory traffic (ISSUE 11): the
     # serving.attn_impl info gauge names the cache-read strategy; the
     # PR 9 program gauges give the decode program's bytes per

@@ -14,7 +14,13 @@ reproduces the captured tokens exactly; ``--verify`` asserts it. That
 turns any production capture into an offline test case and an A/B
 bench: replay yesterday's p99 blowup against a config change
 (``--spec-k/--draft/--prefill-chunk/--prefix-cache-mb/--slots/...``)
-and read the latency diff against the recorded run.
+and read the latency diff against the recorded run. ``--tp N``
+replays onto a tensor-parallel engine (the KV cache and every
+compiled program sharded over an N-device mesh — doc/serving.md
+"Tensor-parallel serving"), so a single-chip capture validates a
+sharded config offline before it ever sees traffic; greedy
+byte-identity across tp is part of the serving contract, so
+``--verify`` must stay clean.
 
 Usage::
 
@@ -223,6 +229,11 @@ def main(argv=None):
     ap.add_argument("--prefix-cache-mb", type=float, default=None)
     ap.add_argument("--attn-impl", default=None,
                     choices=("dense", "paged"))
+    ap.add_argument("--tp", type=int, default=None,
+                    help="tensor-parallel degree override: replay the "
+                         "capture on a KV-cache-sharded engine "
+                         "(doc/serving.md 'Tensor-parallel serving'; "
+                         "1 = unshard a tp capture)")
     ap.add_argument("--compute-dtype", default=None,
                     help="decoder compute dtype (e.g. bfloat16)")
     args = ap.parse_args(argv)
@@ -246,6 +257,7 @@ def main(argv=None):
         ("prefill_chunk", args.prefill_chunk),
         ("prefix_cache_mb", args.prefix_cache_mb),
         ("attn_impl", args.attn_impl),
+        ("tp", args.tp),
     ) if v is not None}
     engine = build_engine(cap, dec, **overrides)
     report = replay(cap, engine, timing=args.timing,
