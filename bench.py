@@ -361,7 +361,8 @@ def bench_decode(prompt=64, layers=12, embed=768,
 
 def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
                   max_len=1024, n_requests=96, seed=0, arrival_ms=1.0,
-                  attn_impl="dense", cache_dtype=None):
+                  attn_impl="dense", cache_dtype=None,
+                  weight_dtype=None):
     """Continuous-batching serving engine (mxnet_tpu/serving/) under
     SATURATING load: Poisson arrivals far above service capacity (the
     queue never empties), mixed prompt lengths across the bucket set
@@ -410,9 +411,11 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
     # 256 top bucket stay constructible (identical at the default)
     buckets = tuple(b for b in (64, 128, 256) if b <= max_len) \
         or (max_len,)
+    # decoder pinned float: weight_dtype is an ENGINE-level axis here
+    # (an env-int8 decoder would refuse an explicit fp arm)
     dec = Decoder(sym, params, max_len=max_len,
                   compute_dtype="bfloat16", cache_block=None,
-                  cache_dtype=cache_dtype)
+                  cache_dtype=cache_dtype, weight_dtype="float")
 
     def workload(n, rs):
         """(prompt, max_tokens) mix: prompts spread over the bucket
@@ -460,7 +463,8 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
     engine = InferenceEngine(dec, slots=slots, prefill_buckets=buckets,
                              max_queue=4 * slots, steps_per_round=8,
                              prefix_cache_mb=0, prefill_chunk=0,
-                             attn_impl=attn_impl)
+                             attn_impl=attn_impl,
+                             weight_dtype=weight_dtype)
     # warmup compiles BOTH program families for every bucket up front
     # (one prompt per bucket), so the timed run measures execution only
     wrs = np.random.RandomState(seed + 1)
@@ -494,6 +498,8 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
         "compile_programs": programs,
         "attn_impl": attn_impl,
         "cache_dtype": cache_dtype or "bf16",
+        "weight_dtype": engine.weight_dtype,
+        "weight_bytes": engine.weight_bytes,
         "decode_bytes_accessed": prog.get("bytes_accessed"),
         "decode_flops": prog.get("flops"),
     }
@@ -501,7 +507,8 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
 
 def bench_serving_tp(tp=1, slots=16, layers=12, embed=768, heads=12,
                      vocab=32000, max_len=1024, n_requests=48, seed=0,
-                     arrival_ms=2.0, steps_per_round=8):
+                     arrival_ms=2.0, steps_per_round=8,
+                     attn_impl="dense"):
     """Tensor-parallel serving sweep arm (ISSUE 14): the SAME workload
     and seeds at every degree — the engine contract makes greedy
     outputs byte-identical across tp, so each arm returns a digest of
@@ -538,7 +545,7 @@ def bench_serving_tp(tp=1, slots=16, layers=12, embed=768, heads=12,
                              max_queue=4 * slots,
                              steps_per_round=steps_per_round,
                              prefix_cache_mb=0, prefill_chunk=0,
-                             tp=tp)
+                             tp=tp, attn_impl=attn_impl)
     wrs = np.random.RandomState(seed + 1)
     for b in buckets:           # warm every program family up front
         engine.submit(wrs.randint(0, vocab, (b - 8,)), max_tokens=8)
@@ -582,6 +589,7 @@ def bench_serving_tp(tp=1, slots=16, layers=12, embed=768, heads=12,
     prog = snap.get("program", {}).get("serving_decode", {})
     return {
         "tp": tp,
+        "attn_impl": attn_impl,
         "tokens_per_sec": round(toks / dt, 1),
         "p50_ms_per_token": round(float(np.percentile(tpot, 50)), 3),
         "p99_ms_per_token": round(float(np.percentile(tpot, 99)), 3),
@@ -592,6 +600,151 @@ def bench_serving_tp(tp=1, slots=16, layers=12, embed=768, heads=12,
         "kv_bytes_per_shard":
             snap.get("serving", {}).get("kv_bytes_per_shard"),
         "digest": digest.hexdigest(),
+    }
+
+
+def bench_serving_quant_bytes(layers=12, embed=768, heads=12,
+                              vocab=32000, max_len=1024, slots=32,
+                              steps_per_round=8, attn_impl="paged",
+                              cache_dtype=None, hbm_gb=16.0):
+    """Decode-bytes probe at the SERVING-BATCH geometry (ISSUE 15's
+    headline config — the 124M LM, the PR 11 premise that the KV side
+    is already cut by paged reads): lower the fp and int8-weight
+    decode programs and read their XLA cost analysis WITHOUT running
+    traffic — the PR 9 gauge arithmetic at a geometry the CPU box
+    could never serve end-to-end.
+
+    Two ratios per arm pair, both recorded because they answer
+    different questions:
+
+    * ``forward_bytes_*`` / ``forward_ratio``: the slot-walk decode
+      forward (``Decoder._run_slots`` — embedding, every projection,
+      the attention read, the head), i.e. the bytes a GREEDY round
+      actually executes. This is the honest weight-stream read: the
+      weight matmuls dominate it at serving batch.
+    * ``program_bytes_*`` / ``program_ratio``: the full serving_decode
+      program — what the live ``program.serving_decode`` gauge shows.
+      It is DILUTED by the sampling branch: the engine wraps the
+      per-slot categorical in ``lax.cond`` so greedy rounds never
+      execute it, but XLA's static cost model counts both branches —
+      ~S x vocab of threefry/categorical arithmetic that scales with
+      slots, not with the model. The same static-model caveat family
+      as PR 11's "the interpreter executes every grid step".
+
+    Also derives ``slots_at_hbm``: (hbm - weight bytes) / KV bytes
+    per slot — the max-resident-slots read at a fixed HBM budget (the
+    slots-per-chip lever the ROADMAP names; the weight cut frees HBM
+    that converts to resident slots at any model scale)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.models import get_transformer_lm
+    from mxnet_tpu.parallel import Decoder
+    from mxnet_tpu.serving import InferenceEngine
+
+    sym = get_transformer_lm(vocab, num_layers=layers, embed_dim=embed,
+                             num_heads=heads, impl="flash")
+    rng = np.random.RandomState(0)
+    shapes = {"data": (8, max_len), "softmax_label": (8, max_len)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    params = {n: jnp.asarray(rng.uniform(-0.05, 0.05, sh)
+                             .astype(np.float32))
+              for n, sh in zip(sym.list_arguments(), arg_shapes)
+              if n not in shapes}
+
+    def cost(lowered):
+        c = lowered.cost_analysis()
+        if isinstance(c, list):
+            c = c[0]
+        return c.get("bytes accessed")
+
+    out = {"config": {"layers": layers, "embed": embed, "vocab": vocab,
+                      "max_len": max_len, "slots": slots,
+                      "attn_impl": attn_impl,
+                      "cache_dtype": cache_dtype or "bf16"}}
+    # ONE float decoder serves both engine arms (the supported
+    # pattern: the int8 engine quantizes its own parameter copy);
+    # pinned float regardless of the env default — an env-int8
+    # decoder would refuse the fp arm
+    dec = Decoder(sym, params, max_len=max_len,
+                  compute_dtype="bfloat16", cache_block=None,
+                  cache_dtype=cache_dtype, weight_dtype="float")
+    buckets = tuple(b for b in (64, 128, 256) if b <= max_len) \
+        or (max_len,)
+    for wd in ("float", "int8"):
+        eng = InferenceEngine(
+            dec, slots=slots, prefill_buckets=buckets,
+            max_queue=4 * slots, steps_per_round=steps_per_round,
+            prefix_cache_mb=0, prefill_chunk=0, attn_impl=attn_impl,
+            weight_dtype=wd)
+        prog = jax.jit(eng._make_step()).lower(
+            eng._params, eng._aux, eng._caches, eng._state)
+        pos = jnp.zeros((slots,), jnp.int32)
+        toks = jnp.zeros((slots, 1), jnp.int32)
+        fwd = jax.jit(
+            lambda p, a, c, po, t: dec._run_slots(
+                p, a, c, po, t, impl=attn_impl)).lower(
+            eng._params, eng._aux, eng._caches, pos, toks)
+        kv_bytes = sum(x.nbytes for x in
+                       jax.tree_util.tree_leaves(eng._caches))
+        key = "fp" if wd == "float" else "int8"
+        out[key] = {
+            "program_bytes": cost(prog),
+            "forward_bytes": cost(fwd),
+            "weight_bytes": eng.weight_bytes,
+            "kv_bytes_per_slot": kv_bytes // slots,
+            "slots_at_hbm": int((hbm_gb * 1e9 - eng.weight_bytes)
+                                // (kv_bytes / slots)),
+        }
+    for k in ("program", "forward"):
+        f, q = out["fp"][k + "_bytes"], out["int8"][k + "_bytes"]
+        out[k + "_ratio"] = None if not f or not q else round(q / f, 3)
+    out["weight_bytes_ratio"] = round(
+        out["int8"]["weight_bytes"] / out["fp"]["weight_bytes"], 3)
+    return out
+
+
+def bench_serving_quant(slots=32, layers=12, embed=768, heads=12,
+                        vocab=32000, max_len=1024, n_requests=96,
+                        seed=0):
+    """Weight-only int8 quantization A/B (ISSUE 15): the SAME
+    saturating bench_serving workload served with float (bf16
+    compute) weights and with int8 weights + per-output-channel f32
+    scales (doc/serving.md "Quantized weights") — compile contract
+    asserted inside each arm. The headline is the decode program's
+    ``bytes_accessed`` ratio int8/fp (PR 9 cost gauges): at serving
+    batch the weight stream dominates decode bytes, and the chunked
+    scale-fused matmul reads it at 1 byte/elem with no materialized
+    float copy. ``weight_bytes_ratio`` is the stored-footprint cut
+    (more resident slots per HBM byte); tokens/s is the wall-clock
+    read, with the PR 11/14 caveat — on the CPU box the chunked
+    dequant loop serializes work XLA would overlap on chip, so the
+    bytes cut is the honest CPU metric and wall clock is the TPU
+    lever."""
+    # both arms pin their dtype explicitly: with
+    # MXNET_SERVING_WEIGHT_DTYPE=int8 exported (the knob this arm
+    # documents) a None here would silently serve int8 on BOTH sides
+    # and report ~1.0 ratios
+    fp = bench_serving(slots=slots, layers=layers, embed=embed,
+                       heads=heads, vocab=vocab, max_len=max_len,
+                       n_requests=n_requests, seed=seed,
+                       weight_dtype="float")
+    q8 = bench_serving(slots=slots, layers=layers, embed=embed,
+                       heads=heads, vocab=vocab, max_len=max_len,
+                       n_requests=n_requests, seed=seed,
+                       weight_dtype="int8")
+    ba_f, ba_q = fp.get("decode_bytes_accessed"), \
+        q8.get("decode_bytes_accessed")
+    return {
+        "fp": fp,
+        "int8": q8,
+        "bytes_accessed_ratio":
+            None if not ba_f or not ba_q else round(ba_q / ba_f, 3),
+        "weight_bytes_ratio":
+            None if not fp.get("weight_bytes")
+            else round(q8["weight_bytes"] / fp["weight_bytes"], 3),
+        "tokens_per_sec_ratio":
+            None if not fp.get("tokens_per_sec")
+            else round(q8["tokens_per_sec"] / fp["tokens_per_sec"], 2),
     }
 
 
@@ -1710,6 +1863,47 @@ def main():
     except Exception:
         traceback.print_exc()
         serving_paged = None
+    # weight-only int8 quantization A/B (ISSUE 15): fp vs int8
+    # weights on the same saturating workload; the decode-program
+    # bytes_accessed ratio is the serving-batch weight-stream cut
+    try:
+        # the lowering-only probe gets its own guard: a probe failure
+        # (e.g. a Pallas lowering quirk on an exotic backend) must not
+        # discard the minutes-long serving A/B that already completed
+        try:
+            quant_probe = bench_serving_quant_bytes()
+        except Exception:
+            traceback.print_exc()
+            quant_probe = None
+        serving_quant = {
+            **bench_serving_quant(),
+            "serving_batch_probe": quant_probe,
+            "note": "weight_dtype='int8' (per-output-channel scales, "
+                    "chunked scale-fused dequant inside the programs "
+                    "— doc/serving.md 'Quantized weights') vs float "
+                    "weights, identical workload/seeds, compile "
+                    "contract asserted per arm; serving_batch_probe "
+                    "lowers the 124M decode programs at the paged "
+                    "serving-batch geometry and reads their cost "
+                    "analysis: forward_ratio = int8/fp bytes of the "
+                    "decode forward a greedy round actually executes "
+                    "(the weight-stream cut — the headline), "
+                    "program_ratio = the live gauge's full-program "
+                    "number, diluted by the lax.cond sampling branch "
+                    "the static cost model counts but greedy rounds "
+                    "never run (PR 11 static-model caveat family); "
+                    "weight_bytes_ratio = stored-footprint cut, "
+                    "slots_at_hbm = resident-slot budget at fixed "
+                    "HBM; on the CPU box the chunked dequant loop "
+                    "serializes work the chip overlaps, so the bytes "
+                    "cut is the honest CPU metric and wall clock the "
+                    "TPU lever (PR 11/14 precedent); "
+                    "tools/bench_serving.py --weight-dtypes sweeps "
+                    "this axis",
+        }
+    except Exception:
+        traceback.print_exc()
+        serving_quant = None
     # capture/replay day-in-the-life (ISSUE 13): bursty mixed traffic
     # captured once, replayed per config with byte-identity verified
     try:
@@ -1829,6 +2023,7 @@ def main():
         "serving_prefix_cache_chunked_prefill": serving_prefix,
         "serving_speculative_decoding": serving_spec,
         "serving_paged_attention": serving_paged,
+        "serving_weight_quant": serving_quant,
         "serving_tensor_parallel": serving_tp,
         "serving_time_machine_replay": None if serving_replay is None
         else {
@@ -1966,6 +2161,13 @@ def main():
             "serving_replay_verified":
                 None if serving_replay is None
                 else serving_replay["verified_total"],
+            "serving_quant_bytes_ratio":
+                None if serving_quant is None
+                else (serving_quant.get("serving_batch_probe")
+                      or {}).get("forward_ratio"),
+            "serving_quant_tokens_per_sec":
+                None if serving_quant is None
+                else serving_quant["int8"]["tokens_per_sec"],
             "serving_tp2_bytes_ratio":
                 None if serving_tp is None
                 else serving_tp.get("bytes_per_shard_ratio_tp2"),

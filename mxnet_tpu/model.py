@@ -1108,7 +1108,7 @@ class FeedForward(BASE_ESTIMATOR):
                           overload=None, round_timeout_ms=None,
                           spec_k=None, draft=None, draft_decoder=None,
                           attn_impl=None, capture_dir=None, tp=None,
-                          **decoder_kwargs):
+                          weight_dtype=None, **decoder_kwargs):
         """Trained estimator → continuous-batching inference engine
         (``mxnet_tpu.serving.InferenceEngine``, doc/serving.md): the
         online-serving analogue of :meth:`predict`. Works on a fitted
@@ -1125,7 +1125,11 @@ class FeedForward(BASE_ESTIMATOR):
         reads only each slot's live KV rows (doc/serving.md "Paged
         attention"); ``tp=N`` shards the KV cache and every compiled
         serving program over an N-device mesh's model axis
-        (doc/serving.md "Tensor-parallel serving")."""
+        (doc/serving.md "Tensor-parallel serving");
+        ``weight_dtype="int8"`` quantizes the engine's copy of the
+        matmul weights to int8 with per-output-channel scales —
+        1 byte/elem weight reads, on-the-fly dequant (doc/serving.md
+        "Quantized weights")."""
         from .parallel.decode import Decoder
         from .serving import InferenceEngine
 
@@ -1138,6 +1142,11 @@ class FeedForward(BASE_ESTIMATOR):
             return v.asnumpy() if hasattr(v, "asnumpy") else v
 
         decoder_kwargs.setdefault("cache_block", None)
+        # weight_dtype goes to the DECODER (the env-default owner) and
+        # the engine inherits: an explicit "float" must override
+        # MXNET_SERVING_WEIGHT_DTYPE=int8 (an env-quantized decoder
+        # cannot serve a float engine)
+        decoder_kwargs.setdefault("weight_dtype", weight_dtype)
         dec = Decoder(
             self.symbol,
             {k: to_np(v) for k, v in self.arg_params.items()},

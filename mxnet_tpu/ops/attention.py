@@ -126,33 +126,45 @@ class MoEFFN(OpSpec):
         return ins, [d], []
 
     def forward(self, p, ins, aux, is_train, rng):
-        x, gate_w, w1, b1, w2, b2 = ins
-        logits = jnp.einsum("bte,xe->btx", x, gate_w)
-        k = int(p["top_k"])
-        nx = int(p["num_experts"])
-        if k > 0:
-            if k >= nx:
-                raise MXNetError(
-                    "MoEFFN: top_k=%d must be < num_experts=%d (use "
-                    "top_k=0 for dense routing)" % (k, nx))
-            # static-shaped hard routing: mask logits outside the top-k
-            # BEFORE the softmax, so kept gates renormalize among
-            # themselves and dropped gates get exactly zero weight.
-            # Build the mask from top_k's INDICES (not a >= threshold,
-            # which would keep every expert tied with the k-th — e.g.
-            # all of them at zero-init): exactly k experts, ties broken
-            # by index like lax.top_k itself
-            _, idx = jax.lax.top_k(logits, k)
-            mask = jnp.sum(jax.nn.one_hot(idx, nx, dtype=logits.dtype),
-                           axis=-2) > 0
-            logits = jnp.where(mask, logits,
-                               jnp.float32(-1e30).astype(logits.dtype))
-        gates = jax.nn.softmax(logits, axis=-1)
-        h = jax.nn.relu(jnp.einsum("bte,xhe->btxh", x, w1)
-                        + b1[None, None])
-        y = jnp.einsum("btxh,xeh->btxe", h, w2) + b2[None, None]
-        out = jnp.einsum("btxe,btx->bte", y, gates)
-        return [out], []
+        return [moe_ffn_math(p, ins)], []
+
+
+def moe_ffn_math(p, ins, gate_mm=None, up_mm=None, down_mm=None):
+    """The ONE MoE routing + combine implementation, parameterized
+    over its three matmuls (``None`` = the plain einsums). The
+    serving engine's weight-quantized path (``serving/quant.py``)
+    passes scale-fused forms for whichever weights are quantized —
+    sharing this function is what keeps quantized MoE routing from
+    silently diverging from the fp op it is tested against."""
+    x, gate_w, w1, b1, w2, b2 = ins
+    logits = gate_mm(x, gate_w) if gate_mm is not None \
+        else jnp.einsum("bte,xe->btx", x, gate_w)
+    k = int(p["top_k"])
+    nx = int(p["num_experts"])
+    if k > 0:
+        if k >= nx:
+            raise MXNetError(
+                "MoEFFN: top_k=%d must be < num_experts=%d (use "
+                "top_k=0 for dense routing)" % (k, nx))
+        # static-shaped hard routing: mask logits outside the top-k
+        # BEFORE the softmax, so kept gates renormalize among
+        # themselves and dropped gates get exactly zero weight.
+        # Build the mask from top_k's INDICES (not a >= threshold,
+        # which would keep every expert tied with the k-th — e.g.
+        # all of them at zero-init): exactly k experts, ties broken
+        # by index like lax.top_k itself
+        _, idx = jax.lax.top_k(logits, k)
+        mask = jnp.sum(jax.nn.one_hot(idx, nx, dtype=logits.dtype),
+                       axis=-2) > 0
+        logits = jnp.where(mask, logits,
+                           jnp.float32(-1e30).astype(logits.dtype))
+    gates = jax.nn.softmax(logits, axis=-1)
+    up = up_mm(x, w1) if up_mm is not None \
+        else jnp.einsum("bte,xhe->btxh", x, w1)
+    h = jax.nn.relu(up + b1[None, None])
+    y = (down_mm(h, w2) if down_mm is not None
+         else jnp.einsum("btxh,xeh->btxe", h, w2)) + b2[None, None]
+    return jnp.einsum("btxe,btx->bte", y, gates)
 
 
 def rope_rotate(x, positions, base=10000.0):

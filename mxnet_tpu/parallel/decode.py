@@ -145,11 +145,28 @@ class Decoder:
         wrapped positions, outside the kernel's [0, pos) contract).
         The serving engine threads its own ``attn_impl`` through
         ``_run_slots`` — doc/serving.md "Paged attention".
+    weight_dtype : {"float", "int8"}, optional
+        Weight storage (default: the ``MXNET_SERVING_WEIGHT_DTYPE``
+        env var, else ``"float"``). ``"int8"`` quantizes every matmul
+        weight — attention QKV/out projections, FullyConnected (MLP
+        and the unembedding head), Embedding tables, MoE gate/expert
+        stacks — to int8 with per-output-channel f32 scales
+        (``serving/quant.py``; LayerNorm gains, biases and positional
+        tables stay float), and every derived program dequantizes ON
+        THE FLY inside the traced matmul (scale-fused, chunked — no
+        float copy of a weight is ever materialized), so decode reads
+        the weight stream at 1 byte/elem. NOT exact: greedy outputs
+        are argmax-stable on the tested configs, tolerance-bounded in
+        general (the int8-KV contract). The serving engine can
+        instead quantize its OWN parameter copy
+        (``InferenceEngine(weight_dtype="int8")``) so one float
+        decoder serves both a quantized engine and its fp oracle.
+        doc/serving.md "Quantized weights".
     """
 
     def __init__(self, symbol, params, max_len, aux_params=None,
                  compute_dtype=None, cache_block="auto",
-                 cache_dtype=None, attn_impl=None):
+                 cache_dtype=None, attn_impl=None, weight_dtype=None):
         symbol = _logits_symbol(symbol)
         self._topo = symbol._topo()
         self._heads = symbol._heads
@@ -271,6 +288,25 @@ class Decoder:
                         "Decoder: max_len=%d exceeds the %d trained "
                         "positions of %r" % (self.max_len, rows,
                                              pos_param))
+
+        # weight-only quantization (doc/serving.md "Quantized
+        # weights"): replace the matmul weights with QuantizedTensor
+        # pytree leaves — the derived walk dequantizes them on the fly
+        # at every consumer (_cached_mha, the _run interceptors)
+        if weight_dtype is None:
+            weight_dtype = os.environ.get(
+                "MXNET_SERVING_WEIGHT_DTYPE") or "float"
+        if weight_dtype not in ("float", "int8"):
+            raise MXNetError(
+                "Decoder: weight_dtype must be 'float' or 'int8', got "
+                "%r (MXNET_SERVING_WEIGHT_DTYPE sets the default)"
+                % (weight_dtype,))
+        self.weight_dtype = weight_dtype
+        if weight_dtype == "int8":
+            from ..serving.quant import (quantize_params,
+                                         quantized_weight_names)
+            self._params = quantize_params(
+                self._params, quantized_weight_names(self._topo))
 
         # params/aux pass as explicit jit arguments: closed-over
         # arrays would be baked into the HLO as literal constants
@@ -454,13 +490,20 @@ class Decoder:
     def _cached_mha(self, node, ins, entry, pos, valid_len=None,
                     tp=None):
         from ..ops.attention import MultiHeadAttention as _MHA
+        from ..serving.quant import QuantizedTensor, scale_fused_matmul
 
         x, wqkv, bqkv, wo, bo = ins
         b, c, e = x.shape
         h = node.params["num_heads"]
         d = e // h
         kv = _MHA.kv_heads(node.params)
-        qkv = jnp.einsum("bte,fe->btf", x, wqkv) + bqkv
+        if isinstance(wqkv, QuantizedTensor):
+            # weight-only int8: per-output-channel scales fold into
+            # the product (serving/quant.py) — the projection reads
+            # the stored int8 stream, no float weight copy
+            qkv = scale_fused_matmul(x, wqkv) + bqkv
+        else:
+            qkv = jnp.einsum("bte,fe->btf", x, wqkv) + bqkv
         q = qkv[..., :e].reshape(b, c, h, d)
         k = qkv[..., e:e + kv * d].reshape(b, c, kv, d)
         v = qkv[..., e + kv * d:].reshape(b, c, kv, d)
@@ -478,6 +521,13 @@ class Decoder:
                 posv = pos + jnp.arange(c)
             q = rope_rotate(q, posv, node.params["rope_base"])
             k = rope_rotate(k, posv, node.params["rope_base"])
+
+        def out_proj(o):
+            o = o.reshape(b, c, e)
+            if isinstance(wo, QuantizedTensor):
+                return scale_fused_matmul(o, wo) + bo
+            return jnp.einsum("bte,fe->btf", o, wo) + bo
+
         if tp is not None:
             # tensor-parallel serving (inside the engine's shard_map —
             # doc/serving.md "Tensor-parallel serving"): everything up
@@ -506,8 +556,7 @@ class Decoder:
                                          valid_len)
             if tp is not None:
                 o = lax.all_gather(o, tp[0], axis=2, tiled=True)
-            return jnp.einsum("bte,fe->btf", o.reshape(b, c, e),
-                              wo) + bo, entry
+            return out_proj(o), entry
         entry = self._write_cache(entry, k, v, pos)
         if self._attn_impl == "paged" or jnp.ndim(pos) == 1:
             # Pallas paged attention (ops/pallas_kernels.py): walk only
@@ -567,8 +616,7 @@ class Decoder:
             # to the output projection: it and every downstream
             # position-wise op run with tp=1's shapes on every shard
             o = lax.all_gather(o, tp[0], axis=2, tiled=True)
-        return jnp.einsum("bte,fe->btf", o.reshape(b, c, e), wo) + bo, \
-            entry
+        return out_proj(o), entry
 
     def _window_attn(self, q, k, v, entry, pos, win, valid_len=None):
         """Sliding-window attention against a ring-buffer cache.
@@ -753,7 +801,19 @@ class Decoder:
         this shard's kv heads — attention slices its shard's heads
         out of the replicated projections and all-gathers its head
         outputs (see ``_cached_mha``); every other op runs replicated
-        with tp=1's exact shapes."""
+        with tp=1's exact shapes.
+
+        Quantized weights (``weight_dtype="int8"`` — or an engine that
+        quantized its own parameter copy) ride the env as
+        ``QuantizedTensor`` pytree leaves; the consumers that can see
+        one (attention projections, FullyConnected, Embedding, MoEFFN
+        — ``quant.quantized_weight_names`` guarantees no other op
+        does) dequantize on the fly via the scale-fused forms
+        below."""
+        from ..serving.quant import (QuantizedTensor, embedding_rows,
+                                     moe_ffn_forward,
+                                     scale_fused_matmul)
+
         env = {}
         new_caches = list(caches)
         mha_i = 0
@@ -787,6 +847,25 @@ class Decoder:
                     posp, (jnp.asarray(pos, jnp.int32), jnp.int32(0)),
                     (x.shape[1], posp.shape[1]))
                 env[(id(n), 0)] = x + rows[None]
+                continue
+            if name == "FullyConnected" \
+                    and isinstance(ins[1], QuantizedTensor):
+                xin = ins[0]
+                if n.params["flatten"]:
+                    xin = xin.reshape(xin.shape[0], -1)
+                out = scale_fused_matmul(xin, ins[1])
+                if not n.params["no_bias"]:
+                    out = out + ins[2]
+                env[(id(n), 0)] = out
+                continue
+            if name == "Embedding" \
+                    and isinstance(ins[1], QuantizedTensor):
+                idx = lax.stop_gradient(ins[0]).astype(jnp.int32)
+                env[(id(n), 0)] = embedding_rows(ins[1], idx)
+                continue
+            if name == "MoEFFN" and any(
+                    isinstance(z, QuantizedTensor) for z in ins[1:]):
+                env[(id(n), 0)] = moe_ffn_forward(n.params, ins)
                 continue
             if name == "BatchNorm" and ins[0].ndim >= 3:
                 # BatchNorm normalizes axis 1, which for rank>=3 LM data
@@ -838,8 +917,13 @@ class Decoder:
         ``tp`` (``(axis_name, degree)``, optional): the call is
         running inside the serving engine's tensor-parallel shard_map
         and ``caches`` are this shard's kv-head slice — see ``_run``.
-        Dense-impl only (the Pallas kernel is not shard-mapped; the
-        engine warns and serves dense under tp)."""
+        Composes with both impls: under ``"paged"`` each shard runs
+        the Pallas kernel against its LOCAL cache shard — the kernel's
+        (slot, kv-head, kv-block) grid takes its kv-head extent from
+        the cache operand, so inside the shard_map it is a per-shard
+        kv-head grid automatically — and the per-attention-node
+        all-gather rebuilds the head output exactly as in the dense
+        branch (doc/serving.md "Paged attention")."""
         if impl is None:
             impl = self._attn_impl
         elif impl == "dense" and self._attn_impl == "paged":
@@ -852,14 +936,9 @@ class Decoder:
                 "with attn_impl='paged' — build the decoder dense "
                 "(the engine threads its own attn_impl per dispatch)")
         if impl == "paged":
-            if tp is not None:
-                raise MXNetError(
-                    "Decoder: the paged kernel does not run inside "
-                    "the tensor-parallel shard_map — serve tp meshes "
-                    "with impl='dense' (the engine does this "
-                    "automatically, with a warning)")
             return self._run(params, aux, caches,
-                             jnp.asarray(pos, jnp.int32), tokens)
+                             jnp.asarray(pos, jnp.int32), tokens,
+                             tp=tp)
 
         def one(slot_caches, p, t):
             # vmap hands each lane the slot's cache WITHOUT its leading

@@ -31,9 +31,12 @@ from .engine import (InferenceEngine, Request, EngineOverloaded,
                      EngineClosed, EngineStuck)
 from .flight import FlightRecorder
 from .prefix import PrefixCache
+from .quant import (QuantizedTensor, quantize_tensor, quantize_params,
+                    quantized_weight_names, dequantize)
 from .spec import NgramDrafter
 
 __all__ = ["InferenceEngine", "Request", "PrefixCache",
            "FlightRecorder", "NgramDrafter", "CaptureStream",
-           "load_capture",
+           "load_capture", "QuantizedTensor", "quantize_tensor",
+           "quantize_params", "quantized_weight_names", "dequantize",
            "EngineOverloaded", "EngineClosed", "EngineStuck"]

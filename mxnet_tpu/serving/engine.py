@@ -206,6 +206,14 @@ _TM_SPEC_MODEL = tele.counter("serving.spec_drafts_model")
 # serving.attn_impl.
 _TM_TP = tele.gauge("serving.tp_degree")
 _TM_TP_KV_BYTES = tele.gauge("serving.kv_bytes_per_shard")
+# weight-only quantization (doc/serving.md "Quantized weights"): info
+# gauges set at construction — the weight storage dtype (0 = float,
+# 1 = int8) and the engine's total stored weight bytes (quantized
+# entries count int8 values + scales; the draft model's weights, when
+# present, are included — they ride the same programs). Engine-last-
+# built semantics like serving.attn_impl.
+_TM_WEIGHT_DTYPE = tele.gauge("serving.weight_dtype")
+_TM_WEIGHT_BYTES = tele.gauge("serving.weight_bytes")
 # compile_counts re-exported as telemetry: the in-engine log stays the
 # tested contract; these make recompiles visible in ONE snapshot next
 # to everything else
@@ -601,15 +609,42 @@ class InferenceEngine:
         host-side sampling identity is untouched); the compile-count
         contract is unchanged. Every attention node's kv heads must
         divide ``tp`` evenly (GQA groups stay whole per shard —
-        refused loudly otherwise); ``attn_impl="paged"`` is not
-        shard-mapped yet and warns + serves the dense per-shard read.
-        ``snapshot()``/``restore()`` carry the degree.
+        refused loudly otherwise). ``attn_impl="paged"`` composes:
+        each shard runs the Pallas kernel against its local cache
+        shard (a per-shard kv-head grid), so the live-rows cut and
+        the per-shard cut multiply. ``snapshot()``/``restore()``
+        carry the degree.
     mesh : jax.sharding.Mesh, optional
         Serve over an existing mesh instead of building one: must
         carry a ``model`` axis (its size is the tp degree;
         ``parallel.model_parallel_mesh`` builds the canonical
         single-axis one). Mutually consistent with ``tp`` when both
         are given.
+    weight_dtype : {"float", "int8"}, optional
+        Weight storage for the engine's programs (default: the
+        decoder's own ``weight_dtype``, itself defaulted from
+        ``MXNET_SERVING_WEIGHT_DTYPE``, else ``"float"``). ``"int8"``
+        quantizes the engine's OWN copy of every matmul weight —
+        attention QKV/out projections, the MLP and unembedding
+        FullyConnecteds, Embedding tables, MoE gate/expert stacks,
+        and the draft model's weights when ``draft="model"`` — to
+        int8 with per-output-channel f32 scales (LayerNorm and biases
+        stay float), and every compiled program family dequantizes ON
+        THE FLY inside a chunked scale-fused matmul (no float weight
+        copy is ever materialized), so decode reads the weight stream
+        at 1 byte/elem — the serving-batch bytes/token lever, and
+        more resident slots per HBM byte. The decoder object stays
+        float, so one weight set serves a quantized engine next to
+        its fp oracle. Greedy outputs are argmax-stable vs. the fp
+        engine on the tested configs (tolerance-bounded in general —
+        the int8-KV contract); quantized engines stay byte-identical
+        ACROSS their own gauntlet (tp degrees, admission orders,
+        speculation, snapshot/restore). Composes with everything:
+        tp>1 (scales replicate with their weights), int8 KV, paged
+        attention, prefix cache, chunked prefill, both speculation
+        modes, capture/replay. ``snapshot()``/``restore()`` and the
+        capture header carry the knob. doc/serving.md "Quantized
+        weights".
     """
 
     def __init__(self, decoder, slots=8, prefill_buckets=None,
@@ -620,7 +655,8 @@ class InferenceEngine:
                  slo_cadence_ms=None, slo_target=0.99,
                  flight_recorder=None, spec_k=None, draft=None,
                  draft_decoder=None, attn_impl=None, capture_dir=None,
-                 capture_mb=None, tp=None, mesh=None):
+                 capture_mb=None, tp=None, mesh=None,
+                 weight_dtype=None):
         if not isinstance(decoder, Decoder):
             raise MXNetError("InferenceEngine needs a Decoder, got %r"
                              % type(decoder).__name__)
@@ -737,6 +773,31 @@ class InferenceEngine:
             mesh = model_parallel_mesh(tp)
         self.tp = tp
         self._mesh = mesh if tp > 1 else None
+        # weight-only quantization (doc/serving.md "Quantized
+        # weights"): resolve BEFORE parameter placement — an int8
+        # engine over a float decoder quantizes its OWN parameter
+        # copy, so the decoder (and its offline oracle programs)
+        # stays float and one weight set serves a quantized engine
+        # next to its fp oracle (the identity tests do)
+        if weight_dtype is None:
+            weight_dtype = decoder.weight_dtype
+        if weight_dtype not in ("float", "int8"):
+            raise MXNetError(
+                "InferenceEngine: weight_dtype must be 'float' or "
+                "'int8', got %r (MXNET_SERVING_WEIGHT_DTYPE sets the "
+                "default)" % (weight_dtype,))
+        if weight_dtype == "float" and decoder.weight_dtype == "int8":
+            raise MXNetError(
+                "InferenceEngine: weight_dtype='float' over a Decoder "
+                "built with weight_dtype='int8' — the float weights "
+                "are gone; build the decoder float (the engine "
+                "quantizes its own copy)")
+        self.weight_dtype = weight_dtype
+        params, auxs = decoder._params, decoder._aux
+        if weight_dtype == "int8" and decoder.weight_dtype != "int8":
+            from .quant import quantize_params, quantized_weight_names
+            params = quantize_params(
+                params, quantized_weight_names(decoder._topo))
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             from ..ops.attention import MultiHeadAttention as _MHA
@@ -750,17 +811,17 @@ class InferenceEngine:
                 self._mesh, PartitionSpec(None, None, "model"))
             rep = NamedSharding(self._mesh, PartitionSpec())
             self._rep_shard = rep
-            # the engine's OWN replicated parameter placement: the
-            # decoder object (and its offline oracle programs) stays
-            # untouched, so one set of weights can serve tp=1 and
-            # tp>1 engines side by side (the identity tests do)
+            # the engine's OWN replicated parameter placement (see the
+            # weight_dtype note above for why the decoder object is
+            # never touched); QuantizedTensor entries are pytrees, so
+            # device_put replicates their int8 values and scales alike
             self._params = {k: jax.device_put(v, rep)
-                            for k, v in decoder._params.items()}
-            self._aux = [jax.device_put(v, rep) for v in decoder._aux]
+                            for k, v in params.items()}
+            self._aux = [jax.device_put(v, rep) for v in auxs]
         else:
             self._kv_shard = None
             self._rep_shard = None
-            self._params, self._aux = decoder._params, decoder._aux
+            self._params, self._aux = params, auxs
         _TM_TP.set(tp)
 
         # device-resident: the slot-paged cache + per-slot state vectors
@@ -822,24 +883,13 @@ class InferenceEngine:
                 "with the exact dense ring walk instead", UserWarning,
                 stacklevel=2)
             attn_impl = "dense"
-        if attn_impl == "paged" and self.tp > 1:
-            if decoder._attn_impl == "paged":
-                raise MXNetError(
-                    "InferenceEngine: tp>1 cannot serve a Decoder "
-                    "built with attn_impl='paged' (its attention "
-                    "always takes the kernel path) — build the "
-                    "decoder dense to serve tensor-parallel")
-            # warn LOUDLY, serve dense (windowed-ring precedent): the
-            # Pallas kernel's grid is not shard-mapped yet — a
-            # per-shard kv-head grid is the natural composition and
-            # stays open work (doc/serving.md)
-            warnings.warn(
-                "InferenceEngine: attn_impl='paged' does not compose "
-                "with tensor-parallel serving (the Pallas paged "
-                "kernel is not shard-mapped) — serving the tp=%d "
-                "mesh with the dense per-shard cache read instead"
-                % self.tp, UserWarning, stacklevel=2)
-            attn_impl = "dense"
+        # attn_impl="paged" composes with tp>1 since ISSUE 15: inside
+        # the shard_map each device runs the Pallas kernel against its
+        # LOCAL cache shard (the kernel's kv-head grid extent comes
+        # from the cache operand, so it is per-shard automatically)
+        # and the usual per-attention-node all-gather rebuilds the
+        # head output — the PR 11 live-rows cut and the PR 14
+        # per-shard cut multiply (doc/serving.md "Paged attention").
         self.attn_impl = attn_impl
         _TM_ATTN_IMPL.set(1 if attn_impl == "paged" else 0)
         slot_bytes = sum(x.nbytes for x in
@@ -929,6 +979,24 @@ class InferenceEngine:
                     "supported (the catch-up chunk would wrap junk "
                     "onto live ring rows)")
             self._draft_dec = draft_decoder
+            if self.weight_dtype == "float" \
+                    and draft_decoder.weight_dtype == "int8":
+                raise MXNetError(
+                    "InferenceEngine: weight_dtype='float' over a "
+                    "draft_decoder built with weight_dtype='int8' — "
+                    "build the draft decoder float (the engine "
+                    "quantizes its own copy)")
+            dparams = draft_decoder._params
+            if self.weight_dtype == "int8" \
+                    and draft_decoder.weight_dtype != "int8":
+                # the draft model reads its weights every proposal
+                # round — quantize it with the target (engine copy,
+                # same reasoning as above)
+                from .quant import (quantize_params,
+                                    quantized_weight_names)
+                dparams = quantize_params(
+                    dparams,
+                    quantized_weight_names(draft_decoder._topo))
             if self._mesh is not None:
                 from ..ops.attention import MultiHeadAttention as _MHA
                 for n in draft_decoder._mha:
@@ -937,16 +1005,28 @@ class InferenceEngine:
                         where="tensor-parallel draft serving")
                 self._draft_params = {
                     k: jax.device_put(v, self._rep_shard)
-                    for k, v in draft_decoder._params.items()}
+                    for k, v in dparams.items()}
                 self._draft_aux = [jax.device_put(v, self._rep_shard)
                                    for v in draft_decoder._aux]
             else:
-                self._draft_params = draft_decoder._params
+                self._draft_params = dparams
                 self._draft_aux = draft_decoder._aux
             self._draft_caches = draft_decoder.init_cache(
                 S, kv_sharding=self._kv_shard)
             self._draft_pos = [0] * S     # next draft-cache position
             self._draft_pending = [[] for _ in range(S)]
+
+        # weight-storage info gauges (doc/observability.md): dtype +
+        # the engine's total stored weight bytes — what int8 weights
+        # buy is exactly this number shrinking while the programs
+        # read it once per step (replicated per shard under tp)
+        _TM_WEIGHT_DTYPE.set(1 if self.weight_dtype == "int8" else 0)
+        from .quant import weight_nbytes
+        wbytes = weight_nbytes(self._params)
+        if self._draft_dec is not None:
+            wbytes += weight_nbytes(self._draft_params)
+        self.weight_bytes = wbytes
+        _TM_WEIGHT_BYTES.set(wbytes)
 
         # host-side scheduler state
         self._pending = collections.deque()
@@ -1057,7 +1137,8 @@ class InferenceEngine:
                         spec_k=None, draft=None, draft_decoder=None,
                         draft_prefix=None, draft_epoch=None,
                         attn_impl=None, capture_dir=None, tp=None,
-                        mesh=None, **decoder_kwargs):
+                        mesh=None, weight_dtype=None,
+                        **decoder_kwargs):
         """Checkpoint → serving engine in one call
         (``prefix-symbol.json`` + ``prefix-NNNN.params``, the reference
         format): builds the :class:`Decoder` via
@@ -1069,13 +1150,20 @@ class InferenceEngine:
         inherits ``compute_dtype`` but none of the cache-flavor
         kwargs."""
         decoder_kwargs.setdefault("cache_block", None)
+        # weight_dtype goes to the DECODER (which owns the env-default
+        # resolution) and the engine inherits it: an explicit "float"
+        # must be able to override MXNET_SERVING_WEIGHT_DTYPE=int8 —
+        # an env-quantized decoder cannot serve a float engine (the
+        # float weights are gone)
+        decoder_kwargs.setdefault("weight_dtype", weight_dtype)
         dec = Decoder.from_checkpoint(prefix, epoch, max_len,
                                       **decoder_kwargs)
         if draft_prefix is not None and draft_decoder is None:
             draft_decoder = Decoder.from_checkpoint(
                 draft_prefix, 0 if draft_epoch is None else draft_epoch,
                 max_len, cache_block=None,
-                compute_dtype=decoder_kwargs.get("compute_dtype"))
+                compute_dtype=decoder_kwargs.get("compute_dtype"),
+                weight_dtype=decoder_kwargs["weight_dtype"])
             if draft is None:
                 draft = "model"
         return cls(dec, slots=slots, prefill_buckets=prefill_buckets,
@@ -2787,6 +2875,7 @@ class InferenceEngine:
             "draft": self.spec_draft,
             "attn_impl": self.attn_impl,
             "tp": self.tp,
+            "weight_dtype": self.weight_dtype,
             "capture_dir": getattr(self, "capture_dir", None),
         }
 

@@ -1,0 +1,286 @@
+"""Weight-only int8 quantization for the serving engine
+(doc/serving.md "Quantized weights").
+
+Decode is memory-bound, and at serving batch sizes the WEIGHT stream —
+not the KV stream — dominates bytes per token: every matmul reads its
+full weight matrix once per fused step however many slots share it.
+Storing those weights int8 with per-output-channel f32 scales cuts the
+stream to 1 byte/elem (the int8-KV lesson of doc/serving.md "Paged
+attention", applied to the other half of the traffic).
+
+Scheme — the same symmetric amax/127 discipline the int8 KV cache uses
+(``parallel/decode.py`` ``_quantize_rows``), one scale per OUTPUT
+channel:
+
+* every quantizable weight in the LM contracts over its LAST axis
+  (``qkv_weight``/``out_weight`` ``[F, E]``, FullyConnected
+  ``[out, in]``, Embedding ``[vocab, E]`` rows, MoE expert stacks
+  ``[X, H, E]`` / ``[X, E, H]``), so "per output channel" is uniformly
+  "per all-but-last-axis row": ``scale = amax(|w|, axis=-1) / 127``,
+  ``q = round(w / scale)``. One outlier row cannot poison its
+  neighbours, and the scale tensor is D-fold smaller than the weight.
+* LayerNorm gains, biases, and positional-embedding tables stay float
+  — they are tiny, and their consumers run the generic op forwards.
+
+Dequantization happens ON THE FLY inside the traced programs, never as
+a materialized float copy of the weight (the PR 11 int8-KV lesson: the
+dense int8 cache path used to dequantize the whole buffer every step).
+:func:`scale_fused_matmul` applies the per-output-channel scale AFTER
+the dot — ``(x @ q^T) * scale`` equals ``x @ (q * scale)^T`` exactly —
+and walks the weight in output-channel CHUNKS inside one
+``lax.fori_loop``, so the float staging is one chunk, not one weight:
+the compiled program reads the stored int8 stream plus a bounded
+scratch, which is also what keeps the XLA cost model's
+``bytes_accessed`` for the decode program at the quantized width
+(doc/serving.md "Measuring it"). Chunking over output channels is a
+partition of independent dot products — NOT a reassociation — so the
+chunked product is bitwise identical to the unchunked one, which is
+what makes tp>1 quantized engines byte-identical to tp=1 quantized.
+
+Wiring: ``Decoder(weight_dtype="int8")`` quantizes at construction
+(offline generate/beam run quantized too);
+``InferenceEngine(weight_dtype="int8")`` quantizes the ENGINE's own
+parameter copy, leaving the decoder float so one set of weights can
+serve a quantized engine next to its fp oracle (the identity tests
+do). ``MXNET_SERVING_WEIGHT_DTYPE`` sets the default for both.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+
+__all__ = ["QuantizedTensor", "quantize_tensor", "dequantize",
+           "quantized_weight_names", "quantize_params",
+           "scale_fused_matmul"]
+
+# op name -> input indices that are quantizable matmul weights (the
+# consumers Decoder._run / _cached_mha intercept); every OTHER consumer
+# position vetoes quantization of its variable, so a name is quantized
+# only when every consumer dequantizes it on the fly
+_QUANT_ARGS = {
+    "FullyConnected": (1,),
+    "Embedding": (1,),
+    "MultiHeadAttention": (1, 3),          # qkv_weight, out_weight
+    "MoEFFN": (1, 2, 4),                   # gate, expert_w1, expert_w2
+}
+
+
+class QuantizedTensor:
+    """An int8 weight with per-output-channel f32 scales.
+
+    ``q``: int8, the original weight's shape. ``scale``: f32,
+    ``q.shape[:-1]`` (one per all-but-last-axis row — the output
+    channel under the LM's uniform ``[out..., contract]`` weight
+    layouts). ``dtype``: the dequantization target (the dtype the
+    float weight had — ``compute_dtype`` under a casting decoder).
+
+    Registered as a jax pytree, so parameter dicts containing
+    quantized entries flow through ``jit`` / ``device_put`` /
+    ``shard_map`` untouched; the consuming ops dispatch on
+    ``isinstance`` at trace time.
+    """
+
+    __slots__ = ("q", "scale", "dtype")
+
+    def __init__(self, q, scale, dtype):
+        self.q = q
+        self.scale = scale
+        self.dtype = dtype
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def nbytes(self):
+        return self.q.nbytes + self.scale.nbytes
+
+    def __repr__(self):
+        return ("QuantizedTensor(shape=%r, dtype=%r)"
+                % (tuple(self.q.shape), self.dtype))
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedTensor,
+    lambda t: ((t.q, t.scale), t.dtype),
+    lambda dtype, ch: QuantizedTensor(ch[0], ch[1], dtype))
+
+
+def quantize_tensor(w, dtype=None):
+    """Quantize one float weight to :class:`QuantizedTensor`:
+    symmetric per-output-channel ``amax/127`` (all-zero rows get scale
+    1 so dequantization is exact zero). ``dtype`` is the dequant
+    target (default: ``w``'s own dtype)."""
+    w = jnp.asarray(w)
+    if w.ndim < 2:
+        raise MXNetError(
+            "quantize_tensor: per-output-channel quantization needs a "
+            "rank >= 2 weight, got shape %r" % (tuple(w.shape),))
+    if dtype is None:
+        dtype = str(w.dtype)
+    wf = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(wf), axis=-1) / 127.0
+    s = jnp.where(s > 0, s, 1.0).astype(jnp.float32)
+    q = jnp.round(wf / s[..., None]).astype(jnp.int8)
+    return QuantizedTensor(q, s, str(jnp.dtype(dtype)))
+
+
+def dequantize(qt):
+    """The float weight a :class:`QuantizedTensor` stands for —
+    testing/debugging only: the serving programs never materialize
+    this (see :func:`scale_fused_matmul`)."""
+    return (qt.q.astype(jnp.float32)
+            * qt.scale[..., None]).astype(qt.dtype)
+
+
+def quantized_weight_names(topo):
+    """Which parameter names of a Decoder's topological node walk are
+    safely quantizable: variables consumed ONLY at the matmul-weight
+    positions of the intercepted ops (attention QKV/out projections,
+    FullyConnected weights — the MLP and the unembedding head —
+    Embedding tables, MoE gate/expert stacks). A name any other
+    consumer touches (data, biases, LayerNorm gains, positional
+    tables, or an op the quantized forwards do not cover) is left
+    float."""
+    want, veto = set(), set()
+    for n in topo:
+        if n.is_var:
+            continue
+        idxs = _QUANT_ARGS.get(n.spec.name, ())
+        for j, (inp, _) in enumerate(n.inputs):
+            if not inp.is_var:
+                continue
+            (want if j in idxs else veto).add(inp.name)
+    return want - veto
+
+
+def quantize_params(params, names):
+    """Quantize ``names`` of a parameter dict (each entry keeps its
+    own dtype as the dequant target); everything else passes through
+    by reference."""
+    return {k: quantize_tensor(v, dtype=str(jnp.asarray(v).dtype))
+            if k in names else v
+            for k, v in params.items()}
+
+
+def _block_rows(f):
+    """Output-channel chunk height for the fused-dequant loop: the
+    largest of (256 .. 8) dividing ``f`` into at least 8 chunks —
+    the float staging (convert + dot read of ONE chunk) must be a
+    small fraction of the int8 stream for the loop to pay, in the
+    cost model and in scratch bytes alike — falling back to >= 2
+    chunks for small weights, else None (tiny weights dequantize
+    whole: same math, the loop would buy nothing)."""
+    for least in (8, 2):
+        for r in (256, 128, 64, 32, 16, 8):
+            if f % r == 0 and f // r >= least:
+                return r
+    return None
+
+
+def scale_fused_matmul(x, qt):
+    """``x [..., E] @ qt [F, E]^T`` with the per-output-channel scale
+    applied to the product: returns ``[..., F]`` in ``x``'s dtype.
+
+    The scale multiplies the OUTPUT (``(x @ q^T) * s == x @ (q*s)^T``
+    exactly), so the int8 weight feeds the dot directly and no float
+    copy of the weight ever exists. The weight is walked in
+    output-channel chunks inside one ``lax.fori_loop``: each chunk is
+    dequantization-staged at chunk size (a bounded scratch, the
+    kernel-VMEM analogue) and its product written into the output
+    slice. Chunking partitions independent output channels — bitwise
+    identical to the unchunked product, at any chunk count."""
+    q, s = qt.q, qt.scale
+    f = q.shape[0]
+
+    def piece(wc, sc):
+        oc = jnp.einsum("...e,fe->...f", x, wc.astype(x.dtype))
+        return oc * sc.astype(x.dtype)
+
+    r = _block_rows(f)
+    if r is None:
+        return piece(q, s)
+    out0 = jnp.zeros(x.shape[:-1] + (f,), x.dtype)
+    ax = out0.ndim - 1
+
+    def body(i, out):
+        wc = lax.dynamic_slice_in_dim(q, i * r, r, axis=0)
+        sc = lax.dynamic_slice_in_dim(s, i * r, r, axis=0)
+        return lax.dynamic_update_slice_in_dim(out, piece(wc, sc),
+                                               i * r, axis=ax)
+
+    return lax.fori_loop(0, f // r, body, out0)
+
+
+def embedding_rows(qt, idx):
+    """Quantized Embedding lookup: gather int8 rows and their scales,
+    dequantize only the GATHERED rows — the table itself is read at
+    1 byte/elem (per-row scales are per-output-channel here: the
+    vocab row IS the output channel)."""
+    rows = jnp.take(qt.q, idx, axis=0).astype(jnp.float32)
+    sc = jnp.take(qt.scale, idx, axis=0)
+    return (rows * sc[..., None]).astype(qt.dtype)
+
+
+def _expert_matmul(h, qt):
+    """``h [B, T, X, H] x w2 [X, E, H] -> [B, T, X, E]`` (the MoE
+    down-projection, contraction per expert) with on-the-fly dequant:
+    a ``fori_loop`` over experts, each expert's slice staged at expert
+    size. Bitwise identical to the unchunked einsum on the
+    dequantized stack (experts are independent output blocks)."""
+    q, s = qt.q, qt.scale
+    nx = q.shape[0]
+    out0 = jnp.zeros(h.shape[:2] + (nx, q.shape[1]), h.dtype)
+
+    def body(i, out):
+        qc = lax.dynamic_slice_in_dim(q, i, 1, axis=0)
+        sc = lax.dynamic_slice_in_dim(s, i, 1, axis=0)
+        hc = lax.dynamic_slice_in_dim(h, i, 1, axis=2)
+        oc = jnp.einsum("btxh,xeh->btxe", hc, qc.astype(h.dtype)) \
+            * sc.astype(h.dtype)[None, None]
+        return lax.dynamic_update_slice_in_dim(out, oc, i, axis=2)
+
+    return lax.fori_loop(0, nx, body, out0)
+
+
+def moe_ffn_forward(p, ins):
+    """MoEFFN forward with any mix of quantized/float weights: the
+    routing + combine math is ``ops.attention.moe_ffn_math`` — the
+    SAME implementation the fp op runs — with the matmul of each
+    quantized weight swapped for its scale-fused form."""
+    from ..ops.attention import moe_ffn_math
+
+    def gate_mm(x, w):
+        if isinstance(w, QuantizedTensor):
+            return scale_fused_matmul(x, w)
+        return jnp.einsum("bte,xe->btx", x, w)
+
+    def up_mm(x, w):
+        if not isinstance(w, QuantizedTensor):
+            return jnp.einsum("bte,xhe->btxh", x, w)
+        # [X, H, E] contracts E with output channels (x, h): the 2-D
+        # chunked helper over the flattened [X*H, E] view is the same
+        # einsum, bitwise
+        xq, hq, e = w.q.shape
+        flat = QuantizedTensor(w.q.reshape(xq * hq, e),
+                               w.scale.reshape(xq * hq), w.dtype)
+        return scale_fused_matmul(x, flat).reshape(
+            x.shape[:-1] + (xq, hq))
+
+    def down_mm(h, w):
+        if isinstance(w, QuantizedTensor):
+            return _expert_matmul(h, w)
+        return jnp.einsum("btxh,xeh->btxe", h, w)
+
+    return moe_ffn_math(p, ins, gate_mm=gate_mm, up_mm=up_mm,
+                        down_mm=down_mm)
+
+
+def weight_nbytes(params):
+    """Total stored bytes of a parameter dict (quantized entries count
+    int8 values + scales) — the ``serving.weight_bytes`` gauge."""
+    return int(sum(leaf.nbytes
+                   for leaf in jax.tree_util.tree_leaves(params)))

@@ -117,7 +117,12 @@ def captured(lm, tmp_path_factory):
     sym, params, dec = lm
     cap_dir = str(tmp_path_factory.mktemp("serving_capture"))
     eng = InferenceEngine(_dec(lm), capture_dir=cap_dir, **_CAP_CFG)
-    rng = np.random.RandomState(13)
+    # seed 11: a workload draw that is also argmax-STABLE under int8
+    # weight quantization (seed 13's prefix case sits on a near-tie),
+    # so the ISSUE 15 quantized-replay acceptance test can ride THIS
+    # capture; every other test derives its expectations from the
+    # capture + oracle dynamically and is seed-agnostic
+    rng = np.random.RandomState(11)
     cases = _workload(rng)
     handles = [eng.submit(p, max_tokens=n) for p, n in cases]
     done = eng.serve_forever()
@@ -253,6 +258,30 @@ def test_replay_verify_tp2(lm, captured):
     assert report["verified"] == len(captured["cases"])
     assert report["verified_prefix"] == 1
     assert report["mismatches"] == []
+    assert_compile_contract(eng)
+
+
+def test_replay_verify_weight_dtype_int8(lm, captured):
+    """Acceptance flavor 4 (ISSUE 15): the ``--weight-dtype`` override
+    axis — the spec-on + prefix-cache + chunked capture replays on a
+    QUANTIZED-weight engine. The capture header records the float
+    dtype, so ``--verify`` switches to the prefix-equality/tolerance
+    mode automatically (quantized numerics void the byte-identity
+    contract); this workload is argmax-stable under the ~0.5% weight
+    rounding, so every request — crash-cut one included — agrees in
+    full. An exact-mode fp replay of the same capture is flavor 1."""
+    cap = load_capture(captured["path"])
+    assert cap["engine"].get("weight_dtype") == "float"
+    eng = replay_serving.build_engine(cap, _dec(lm),
+                                      weight_dtype="int8")
+    assert eng.weight_dtype == "int8"
+    report = replay_serving.replay(cap, eng, timing="max",
+                                   verify=True)
+    assert report["verify_mode"] == "prefix"
+    assert report["mismatches"] == []
+    # prefix mode verifies EVERY retired request by common prefix
+    assert report["verified_prefix"] == len(captured["cases"]) + 1
+    assert report["verified"] == 0
     assert_compile_contract(eng)
 
 

@@ -274,13 +274,58 @@ def test_tp2_windowed_ring_byte_identical():
                for x in leaves)
 
 
+def test_tp2_paged_byte_identical_to_tp1_paged(lm):
+    """Paged attention under tensor parallelism (ISSUE 15, closing
+    the PR 14 follow-up): a tp=2 engine with ``attn_impl="paged"``
+    serves the Pallas kernel against its LOCAL cache shard — the
+    kernel's (slot, kv-head, kv-block) grid takes its kv-head extent
+    from the cache operand, so inside the shard_map it is a per-shard
+    kv-head grid — with NO dense-fallback warning, byte-identical to
+    the tp=1 paged engine AND to the dense offline oracle (fp paged
+    == dense is the PR 11 contract). Cache sharding asserted; compile
+    contract unchanged at both degrees."""
+    import warnings
+
+    from test_paged_attention import _probe_paged
+    reason = _probe_paged()
+    if reason:
+        pytest.skip(reason)
+    sym, params, dec = lm
+    e1 = _engine(sym, params, attn_impl="paged")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")     # no dense-fallback warning
+        e2 = _engine(sym, params, tp=2, attn_impl="paged")
+    assert e2.attn_impl == "paged" and e2.tp == 2
+    rng = np.random.RandomState(23)
+    cases = [(rng.randint(0, VOCAB, (pl,)), n)
+             for pl, n in [(3, 5), (6, 4), (4, 6)]]
+    rs1 = [e1.submit(p, max_tokens=n) for p, n in cases]
+    rs2 = [e2.submit(p, max_tokens=n) for p, n in cases]
+    e1.serve_forever()
+    e2.serve_forever()
+    for (p, n), a, b in zip(cases, rs1, rs2):
+        want = _oracle(dec, p, n)
+        np.testing.assert_array_equal(a.result(), want)
+        np.testing.assert_array_equal(b.result(), want)
+    for leaf in jax.tree_util.tree_leaves(e2._caches):
+        assert tuple(leaf.sharding.spec) == (None, None, "model")
+        assert leaf.addressable_shards[0].data.shape[2] \
+            == leaf.shape[2] // 2
+    assert_compile_contract(e1, verify=0, copy={})
+    assert_compile_contract(e2, verify=0, copy={})
+    assert mx.telemetry.snapshot()["serving"]["attn_impl"] == 1
+
+
 def test_tp_validation_and_refusals(lm):
     """Construction-time contracts, all compile-free: uneven kv-head
     splits refuse loudly (GQA groups must stay whole per shard), bad
-    tp/mesh combinations refuse with pointers, paged attention warns
-    and serves dense (windowed-ring precedent) — or refuses outright
-    when the DECODER was built paged (it cannot serve dense), and
-    MXNET_SERVING_TP is the env default for the knob."""
+    tp/mesh combinations refuse with pointers, paged attention
+    COMPOSES with tp since ISSUE 15 (no warning, no dense fallback —
+    construction compiles nothing, the serving identity is
+    test_tp2_paged_byte_identical's), and MXNET_SERVING_TP is the env
+    default for the knob."""
+    import warnings
+
     sym, params, _ = lm
     with pytest.raises(MXNetError, match="divide evenly"):
         _engine(sym, params, tp=3)       # 4 kv heads, 3 shards
@@ -296,16 +341,17 @@ def test_tp_validation_and_refusals(lm):
     # an explicit mesh works and wins the degree
     eng = _engine(sym, params, mesh=model_parallel_mesh(2))
     assert eng.tp == 2
-    # paged decoder: tp cannot serve it dense -> hard refusal
-    with pytest.raises(MXNetError, match="tensor-parallel"):
-        InferenceEngine(Decoder(sym, params, max_len=T,
-                                cache_block=None, attn_impl="paged"),
-                        tp=2, prefix_cache_mb=0)
-    # engine-level paged over a dense decoder: warn LOUDLY, serve the
-    # dense per-shard read
-    with pytest.warns(UserWarning, match="paged"):
+    # paged x tp composes — no dense-fallback warning, either for an
+    # engine-level paged over a dense decoder or a paged-built decoder
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
         ep = _engine(sym, params, tp=2, attn_impl="paged")
-    assert ep.attn_impl == "dense" and ep.tp == 2
+        ep2 = InferenceEngine(
+            Decoder(sym, params, max_len=T, cache_block=None,
+                    attn_impl="paged"),
+            slots=2, prefill_buckets=(4, 8), prefix_cache_mb=0, tp=2)
+    assert ep.attn_impl == "paged" and ep.tp == 2
+    assert ep2.attn_impl == "paged" and ep2.tp == 2
     # env default (ctor only — nothing dispatches)
     import os
     old = os.environ.get("MXNET_SERVING_TP")

@@ -410,6 +410,8 @@ def test_dump_telemetry_serving_filter(tmp_path, capsys):
         "capture_bytes": 4096.0,
         # ISSUE 14: tensor-parallel sharding info gauges
         "tp_degree": 2, "kv_bytes_per_shard": 524288,
+        # ISSUE 15: weight-quantization info gauges
+        "weight_dtype": 1, "weight_bytes": 131072,
     }}
     snap_path = tmp_path / "snap.json"
     snap_path.write_text(json.dumps(snap))
@@ -428,6 +430,9 @@ def test_dump_telemetry_serving_filter(tmp_path, capsys):
     # sharding line (ISSUE 14): axis, degree, per-shard KV bytes
     assert "sharding:" in out and "axis=model tp=2" in out \
         and "kv_bytes_per_shard=524288" in out
+    # quantization line (ISSUE 15): weight dtype + stored bytes
+    assert "quantization:" in out and "weights=int8" in out \
+        and "weight_bytes=131072" in out
     # speculation line (PR 10): accept rate + drafter source mix +
     # fallback rounds, next to the latency histograms they explain
     assert "accept_rate=0.75" in out and "fallback_rounds=2" in out

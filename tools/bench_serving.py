@@ -24,6 +24,14 @@ paged kernel that walks only each slot's live KV rows, same stream per
 seed — each cell reports tokens/s, cadence p50/p99, and the decode
 program's ``bytes_accessed`` per dispatch (the traffic-cut metric).
 
+``--weight-dtypes float int8`` adds one cell per weight storage dtype
+(ISSUE 15): float weights vs int8 + per-output-channel scales with
+chunked scale-fused dequant inside the programs, same stream per seed
+— each cell reports tokens/s, cadence p50/p99, stored ``weight_bytes``
+and the decode program's ``bytes_accessed`` per dispatch (the
+weight-stream cut — at serving batch the weights, not the KV, dominate
+decode bytes; doc/serving.md "Quantized weights").
+
 ``--tps 1 2 4`` adds a tensor-parallel sweep over
 ``bench.bench_serving_tp`` (ISSUE 14): one cell per degree on the
 SAME stream/seed — greedy outputs are byte-identical across degrees
@@ -118,6 +126,17 @@ def main():
                          "per-shard decode bytes_accessed. Needs that "
                          "many devices (CPU smoke: export XLA_FLAGS="
                          "--xla_force_host_platform_device_count=8)")
+    ap.add_argument("--weight-dtypes", nargs="+", default=[],
+                    choices=("float", "int8"),
+                    help="weight-storage sweep axis (e.g. float "
+                         "int8): one bench_serving cell per dtype at "
+                         "the first slots/arrival setting — int8 = "
+                         "per-output-channel quantized weights with "
+                         "chunked scale-fused dequant in-program; "
+                         "cells report tokens/s, cadence p50/p99, "
+                         "stored weight bytes, and the decode "
+                         "program's bytes_accessed per dispatch (the "
+                         "weight-stream cut)")
     ap.add_argument("--attn-impls", nargs="+", default=[],
                     help="attention-impl sweep axis (e.g. dense "
                          "paged): one bench_serving cell per impl at "
@@ -225,6 +244,22 @@ def main():
                  "compile_programs")}
         out["impl_%s" % impl] = cell
         print("impl_%s: %r" % (impl, cell), file=sys.stderr)
+    # weight-dtype sweep (ISSUE 15): float vs int8 weights on the
+    # same stream/seed — bytes_accessed and weight_bytes are the
+    # traffic/footprint cuts (the honest CPU metrics; the chunked
+    # dequant loop serializes work the chip overlaps)
+    for wd in args.weight_dtypes:
+        r = bench.bench_serving(
+            slots=args.slots[0], layers=args.layers, embed=args.embed,
+            heads=args.heads, vocab=args.vocab, max_len=args.max_len,
+            n_requests=args.requests, seed=3,
+            arrival_ms=args.arrival_ms[0], weight_dtype=wd)
+        cell = {k: r[k] for k in
+                ("tokens_per_sec", "p50_ms_per_token",
+                 "p99_ms_per_token", "decode_bytes_accessed",
+                 "weight_bytes", "compile_programs")}
+        out["weights_%s" % wd] = cell
+        print("weights_%s: %r" % (wd, cell), file=sys.stderr)
     # tensor-parallel sweep (ISSUE 14): same stream/seed per degree,
     # byte-identity digest-asserted across cells before any number is
     # trusted; bytes_accessed is PER SHARD (the multi-chip cut)

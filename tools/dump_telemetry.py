@@ -142,6 +142,15 @@ def print_serving(snap, out=None):
                   % (int(tpd),
                      "n/a" if s.get("kv_bytes_per_shard") is None
                      else "%d" % s["kv_bytes_per_shard"]))
+    # weight quantization (ISSUE 15): storage dtype + the engine's
+    # total stored weight bytes — the serving-batch bytes/token lever
+    # (doc/serving.md "Quantized weights")
+    wd = s.get("weight_dtype")
+    if wd is not None:
+        out.write("quantization:     weights=%s weight_bytes=%s\n"
+                  % ("int8" if wd else "float",
+                     "n/a" if s.get("weight_bytes") is None
+                     else "%d" % s["weight_bytes"]))
     # attention impl + decode memory traffic (ISSUE 11): the
     # serving.attn_impl info gauge names the cache-read strategy; the
     # PR 9 program gauges give the decode program's bytes per

@@ -20,7 +20,12 @@ compiled program sharded over an N-device mesh — doc/serving.md
 "Tensor-parallel serving"), so a single-chip capture validates a
 sharded config offline before it ever sees traffic; greedy
 byte-identity across tp is part of the serving contract, so
-``--verify`` must stay clean.
+``--verify`` must stay clean. ``--weight-dtype int8`` replays onto a
+QUANTIZED-weight engine (doc/serving.md "Quantized weights"): the
+numerics change, so ``--verify`` automatically switches to the
+prefix-equality/tolerance mode (the replayed stream must agree with
+the captured one on their common prefix; argmax-stable configs agree
+in full) — replays at the CAPTURED dtype stay byte-exact.
 
 Usage::
 
@@ -107,7 +112,8 @@ def recorded_latency(cap):
     return _latency_summary(ttft, cadence)
 
 
-def replay(cap, engine, timing="recorded", verify=False):
+def replay(cap, engine, timing="recorded", verify=False,
+           verify_mode="auto"):
     """Replay a loaded capture on ``engine``; returns the report dict.
 
     ``timing="recorded"`` paces submissions at the captured arrival
@@ -117,10 +123,31 @@ def replay(cap, engine, timing="recorded", verify=False):
     the capture retired normally (``eos``/``length``), prefix
     equality where it was cut short host-side (deadline/cancel/shed —
     the replay generates the full continuation the cut run only
-    started)."""
+    started).
+
+    ``verify_mode``: ``"exact"`` is the byte-identity contract above.
+    ``"prefix"`` is the tolerance mode for QUANTIZED replays of a
+    float capture (or vice versa — ``--weight-dtype`` changes the
+    numerics, so byte-identity is no longer the contract): every
+    request verifies by the host-cut rule — the CAPTURED stream must
+    be a prefix of the replayed one (argmax-stable configs agree in
+    full; the first genuine argmax flip differs at the divergence
+    point and reports as a mismatch, and a replayed stream cut short
+    host-side fails rather than passing vacuously on the shorter
+    common prefix). ``"auto"`` (default) picks ``"prefix"`` exactly
+    when the engine's ``weight_dtype`` differs from the capture
+    header's, else ``"exact"``."""
     if timing not in ("recorded", "max"):
         raise ValueError("timing must be 'recorded' or 'max', got %r"
                          % (timing,))
+    if verify_mode not in ("auto", "exact", "prefix"):
+        raise ValueError("verify_mode must be 'auto', 'exact' or "
+                         "'prefix', got %r" % (verify_mode,))
+    if verify_mode == "auto":
+        cap_wd = cap["engine"].get("weight_dtype", "float")
+        verify_mode = "prefix" \
+            if getattr(engine, "weight_dtype", "float") != cap_wd \
+            else "exact"
     submits = sorted(cap["submits"], key=lambda r: r["t"])
     handles = []                      # (record, Request) pairs
     t0 = time.perf_counter()
@@ -179,7 +206,21 @@ def replay(cap, engine, timing="recorded", verify=False):
                 continue
             got = np.asarray(h.tokens, np.int64)
             ref = np.asarray(want["tokens"], np.int64)
-            if want["reason"] in ("eos", "length"):
+            if verify_mode == "prefix":
+                # tolerance mode (quantized vs float numerics): the
+                # CAPTURED stream must be a prefix of the replayed
+                # one — the host-cut rule applied to every request.
+                # Argmax-stable configs agree in full (same eos and
+                # budget force equal lengths for normal retires); a
+                # genuine argmax flip differs at the divergence point
+                # and reports as a mismatch; a replayed stream that
+                # stops SHORT of the capture was cut host-side, not
+                # quantization-diverged — also a mismatch (a bare
+                # common-prefix check would pass it vacuously)
+                ok = len(ref) <= len(got) \
+                    and bool((got[:len(ref)] == ref).all())
+                prefix_ok += ok
+            elif want["reason"] in ("eos", "length"):
                 ok = got.shape == ref.shape and bool((got == ref).all())
                 verified += ok
             else:
@@ -195,6 +236,7 @@ def replay(cap, engine, timing="recorded", verify=False):
         report["verified"] = verified
         report["verified_prefix"] = prefix_ok
         report["verify_skipped"] = skipped
+        report["verify_mode"] = verify_mode
         report["mismatches"] = mismatches
     return report
 
@@ -234,6 +276,18 @@ def main(argv=None):
                          "capture on a KV-cache-sharded engine "
                          "(doc/serving.md 'Tensor-parallel serving'; "
                          "1 = unshard a tp capture)")
+    ap.add_argument("--weight-dtype", default=None,
+                    choices=("float", "int8"),
+                    help="weight-storage override: replay the capture "
+                         "on an int8-weight engine (doc/serving.md "
+                         "'Quantized weights'). --verify switches to "
+                         "prefix-equality/tolerance mode when this "
+                         "differs from the captured dtype (exact for "
+                         "matching dtypes); --verify-mode overrides")
+    ap.add_argument("--verify-mode", default="auto",
+                    choices=("auto", "exact", "prefix"),
+                    help="--verify comparison mode (default auto: "
+                         "exact unless the weight dtype changed)")
     ap.add_argument("--compute-dtype", default=None,
                     help="decoder compute dtype (e.g. bfloat16)")
     args = ap.parse_args(argv)
@@ -244,7 +298,11 @@ def main(argv=None):
     max_len = args.max_len or cap["engine"].get("max_len")
     if not max_len:
         ap.error("capture header carries no max_len; pass --max-len")
-    deckw = {"cache_block": None}
+    # decoder pinned float regardless of MXNET_SERVING_WEIGHT_DTYPE:
+    # the capture header (or --weight-dtype) decides the ENGINE's
+    # dtype, and an env-quantized decoder could not serve a
+    # float-header capture (the float weights are gone)
+    deckw = {"cache_block": None, "weight_dtype": "float"}
     if args.compute_dtype:
         deckw["compute_dtype"] = args.compute_dtype
     dec = Decoder.from_checkpoint(args.checkpoint, args.epoch, max_len,
@@ -258,10 +316,11 @@ def main(argv=None):
         ("prefix_cache_mb", args.prefix_cache_mb),
         ("attn_impl", args.attn_impl),
         ("tp", args.tp),
+        ("weight_dtype", args.weight_dtype),
     ) if v is not None}
     engine = build_engine(cap, dec, **overrides)
     report = replay(cap, engine, timing=args.timing,
-                    verify=args.verify)
+                    verify=args.verify, verify_mode=args.verify_mode)
     report["overrides"] = overrides
     print(json.dumps(report, sort_keys=True))
     if args.verify and report["mismatches"]:
