@@ -42,10 +42,19 @@ benchdiff:
 		$(if $(THRESHOLD),--threshold $(THRESHOLD)) \
 		$(if $(KEYS),--keys $(KEYS))
 
+# Fleet fault-injection sweep (doc/fault_tolerance.md "Fleet
+# resilience"): the slow-marked randomized chaos schedules on top of
+# the deterministic tier-1 fleet tests — kill/blackhole/slow/lost-
+# submit storms against a live fleet, byte-identity and zero failed
+# requests as the bar. Off the tier-1 path; run before serving-layer
+# releases.
+chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_faults.py tests/test_fleet.py -q -m "slow or not slow"
+
 lint:
 	python -m compileall -q mxnet_tpu tools example
 
 clean:
 	$(MAKE) -C cpp clean
 
-.PHONY: all native examples test manifest check bench benchdiff lint clean
+.PHONY: all native examples test manifest check bench benchdiff chaos lint clean

@@ -1367,6 +1367,138 @@ def bench_serving_replay(slots=8, layers=12, embed=768, heads=12,
         shutil.rmtree(cap_dir, ignore_errors=True)
 
 
+def bench_serving_fleet(replicas=2, slots=4, layers=2, embed=128,
+                        heads=4, vocab=4000, max_len=128,
+                        n_requests=32, seed=11, shared_len=24,
+                        tail_len=8, out_tokens=(8, 12, 16)):
+    """Fleet-resilience arm (ISSUE 16): capture a mixed-traffic run on
+    ONE engine, then replay it twice — (a) through a single fresh
+    replica (the control), and (b) through a ``replicas``-wide
+    :class:`FleetRouter` while every replica is drained and replaced
+    in turn mid-replay (the rolling-restart drill), byte-identity
+    verified both times. The headline pair: ``zero_failed_restart``
+    (1 = every request completed and verified byte-identical through
+    the restart — the ISSUE 16 acceptance bar) and
+    ``failover_p99_ms`` (p99 wall cost of one drain: snapshot +
+    live migration + successor join — the pause an operator's
+    rolling deploy injects per replica). Deliberately small model:
+    the metrics are host-side scheduling costs, not device math."""
+    import jax.numpy as jnp
+    from mxnet_tpu.models import get_transformer_lm
+    from mxnet_tpu.parallel import Decoder
+    from mxnet_tpu.serving import (InferenceEngine, FleetRouter,
+                                   load_capture)
+    from tools import replay_serving
+    import shutil
+    import tempfile
+
+    sym = get_transformer_lm(vocab, num_layers=layers, embed_dim=embed,
+                             num_heads=heads, impl="dense")
+    rng = np.random.RandomState(seed)
+    shapes = {"data": (4, max_len), "softmax_label": (4, max_len)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    params = {n: jnp.asarray(rng.uniform(-0.05, 0.05, sh)
+                             .astype(np.float32))
+              for n, sh in zip(sym.list_arguments(), arg_shapes)
+              if n not in shapes}
+    buckets = (32, 64)
+
+    def decoder():
+        return Decoder(sym, params, max_len=max_len, cache_block=None)
+
+    base_cfg = dict(slots=slots, prefill_buckets=buckets,
+                    max_queue=4 * slots, prefix_cache_mb=1,
+                    prefill_chunk=16)
+    shared = rng.randint(0, vocab, (shared_len,))
+    cap_dir = tempfile.mkdtemp(prefix="mx_bench_fleet_")
+    try:
+        engine = InferenceEngine(decoder(), capture_dir=cap_dir,
+                                 **base_cfg)
+        for i in range(n_requests):
+            p = np.concatenate(
+                [shared, rng.randint(0, vocab, (tail_len,))]) \
+                if rng.uniform() < 0.5 \
+                else rng.randint(0, vocab, (tail_len * 2,))
+            while engine.queued() >= engine.max_queue:
+                engine.step()        # backpressure: drain, then admit
+            engine.submit(p, max_tokens=int(rng.choice(out_tokens)))
+        engine.serve_forever()
+        cap_path = engine.capture.path
+        engine.close()
+        cap = load_capture(cap_path)
+
+        # control: one fresh replica, no restarts
+        ctrl = replay_serving.build_engine(cap, decoder())
+        single = replay_serving.replay(cap, ctrl, timing="max",
+                                       verify=True)
+        ctrl.close()
+
+        # the drill: a fleet, every replica drained+replaced mid-replay
+        fleet = FleetRouter(
+            [replay_serving.build_engine(cap, decoder())
+             for _ in range(replicas)],
+            heartbeat_ms=50)
+        drain_ms = []
+        base_hook = replay_serving.rolling_restart(
+            fleet, cap,
+            lambda: replay_serving.build_engine(cap, decoder()))
+
+        def on_round(submitted, eng):
+            live_before = len(fleet.replica_ids(live_only=True))
+            t0 = time.perf_counter()
+            base_hook(submitted, eng)
+            if len(fleet.replica_ids(live_only=True)) != live_before \
+                    or fleet.stats["drains"] > len(drain_ms):
+                drain_ms.append((time.perf_counter() - t0) * 1e3)
+
+        rep = replay_serving.replay(cap, fleet, timing="max",
+                                    verify=True, on_round=on_round)
+        # per-replica compile contract on the survivors (each replica
+        # compiles its own families; the fleet adds no programs) — a
+        # spare that joined after the last milestone and never served
+        # a round has compiled nothing at all
+        for rid in fleet.replica_ids(live_only=True):
+            rep_eng = fleet.replica(rid)
+            cc = rep_eng.compile_counts
+            if not rep_eng.stats["steps"]:
+                assert cc["decode"] == 0, \
+                    "idle fleet spare compiled: %r" % (cc,)
+                continue
+            assert cc["decode"] == 1 and cc["verify"] <= 1 \
+                and all(v == 1 for v in cc["prefill"].values()) \
+                and all(v == 1 for v in cc["copy"].values()), \
+                "fleet replica compile contract violated: %r" % (cc,)
+        stats = dict(fleet.stats)
+        fleet.close()
+        zero_failed = int(not rep["mismatches"]
+                          and rep["replayed"] == rep["requests"]
+                          and stats.get("drains", 0) >= replicas
+                          and stats.get("migrated_requests", 0) > 0)
+        return {
+            "replicas": replicas,
+            "requests": n_requests,
+            "single": {k: single[k] for k in
+                       ("tokens_per_sec", "ttft_p50_ms",
+                        "cadence_p99_ms", "verified",
+                        "verified_prefix")},
+            "fleet_restart": {
+                **{k: rep[k] for k in
+                   ("tokens_per_sec", "ttft_p50_ms", "cadence_p99_ms",
+                    "verified", "verified_prefix")},
+                "mismatches": len(rep["mismatches"]),
+                "drains": stats.get("drains", 0),
+                "migrated_requests": stats.get("migrated_requests", 0),
+                "affinity_hits": stats.get("affinity_hits", 0),
+            },
+            "failover_p99_ms":
+                None if not drain_ms
+                else round(float(np.percentile(drain_ms, 99)), 3),
+            "zero_failed_restart": zero_failed,
+        }
+    finally:
+        shutil.rmtree(cap_dir, ignore_errors=True)
+
+
 def bench_recordio_io():
     """C++ ImageRecordIOIter: run tools/bench_io.py in a CLEAN
     subprocess (no jax): on this 1-core container the jax/axon runtime
@@ -1911,6 +2043,14 @@ def main():
     except Exception:
         traceback.print_exc()
         serving_replay = None
+    # fleet resilience (ISSUE 16): the same capture replayed through a
+    # 2-replica fleet under a rolling restart — zero failed requests,
+    # byte-identical, with the per-drain migration pause as the cost
+    try:
+        serving_fleet = bench_serving_fleet()
+    except Exception:
+        traceback.print_exc()
+        serving_fleet = None
     # tensor-parallel sweep (ISSUE 14): same workload/seeds at
     # tp in {1, 2, 4}; outputs byte-identical across degrees
     # (digest-asserted), per-shard decode bytes_accessed is the cut
@@ -2039,6 +2179,23 @@ def main():
                     "the rolling tape vs the capture-off same-config "
                     "replay; tools/replay_serving.py replays any "
                     "production capture the same way",
+        },
+        "serving_fleet_resilience": None if serving_fleet is None
+        else {
+            **serving_fleet,
+            "note": "FleetRouter over 2 InferenceEngine replicas "
+                    "(doc/fault_tolerance.md 'Fleet resilience'): one "
+                    "captured trace replayed through the fleet while "
+                    "every replica is drained and replaced in turn "
+                    "(rolling restart); zero_failed_restart = 1 iff "
+                    "every request completed byte-identical to the "
+                    "capture with drains and live migrations actually "
+                    "exercised; failover_p99_ms = p99 wall cost of "
+                    "one drain (snapshot + migrate + successor join) "
+                    "— the pause a rolling deploy injects per "
+                    "replica; tools/replay_serving.py --replicas N "
+                    "--rolling-restart runs the same drill on any "
+                    "production capture",
         },
         "serving_overload_shed_vs_block": None if serving_overload is None
         else {
@@ -2177,6 +2334,12 @@ def main():
             "serving_replay_p99_ms":
                 None if serving_replay is None
                 else serving_replay["same_config"]["cadence_p99_ms"],
+            "fleet_failover_p99_ms":
+                None if serving_fleet is None
+                else serving_fleet["failover_p99_ms"],
+            "fleet_zero_failed_restart":
+                None if serving_fleet is None
+                else serving_fleet["zero_failed_restart"],
             "cifar10_img_per_sec":
                 None if cifar is None else round(cifar, 1),
             "cifar10_vs_gtx980":

@@ -25,10 +25,17 @@ shedding (:class:`EngineOverloaded`), a round watchdog
 and a :meth:`InferenceEngine.close` shutdown path
 (:class:`EngineClosed`) — all host-side, the compiled program
 families above are frozen.
+
+Fleet layer (doc/fault_tolerance.md "Fleet resilience"):
+:class:`FleetRouter` fronts N replicas with health-driven +
+prefix-affinity admission, heartbeat failover, live request migration
+(``drain``), and fleet-wide overload composition — a rolling restart
+fails zero requests, byte-identically.
 """
 from .capture import CaptureStream, load_capture
 from .engine import (InferenceEngine, Request, EngineOverloaded,
                      EngineClosed, EngineStuck)
+from .fleet import FleetRouter, FleetRequest
 from .flight import FlightRecorder
 from .prefix import PrefixCache
 from .quant import (QuantizedTensor, quantize_tensor, quantize_params,
@@ -39,4 +46,5 @@ __all__ = ["InferenceEngine", "Request", "PrefixCache",
            "FlightRecorder", "NgramDrafter", "CaptureStream",
            "load_capture", "QuantizedTensor", "quantize_tensor",
            "quantize_params", "quantized_weight_names", "dequantize",
-           "EngineOverloaded", "EngineClosed", "EngineStuck"]
+           "EngineOverloaded", "EngineClosed", "EngineStuck",
+           "FleetRouter", "FleetRequest"]

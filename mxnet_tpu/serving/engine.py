@@ -84,6 +84,7 @@ with :class:`EngineClosed` and is idempotent.
 from __future__ import annotations
 
 import collections
+import itertools
 import math
 import os
 import time
@@ -112,6 +113,13 @@ __all__ = ["InferenceEngine", "Request", "EngineOverloaded",
 # exposition server's /requests, /flight/<id> and /healthz walk this
 # set (weak — an engine the caller dropped disappears with it)
 _ENGINES = weakref.WeakSet()
+
+# monotonic suffix for auto-assigned engine ids ("e<pid>.<n>"): the
+# FleetRouter keys replicas by engine_id, and capture headers carry it
+# as provenance, so ids must be unique within a process across
+# engine rebuilds (a restore() successor gets a FRESH id; the donor's
+# travels in ``migrated_from``)
+_ENGINE_SEQ = itertools.count()
 
 # serving-side fault injection (mxnet_tpu.testing.faults): an installed
 # injector's hooks run at the engine's host-side seams — h2d/prefill
@@ -656,7 +664,7 @@ class InferenceEngine:
                  flight_recorder=None, spec_k=None, draft=None,
                  draft_decoder=None, attn_impl=None, capture_dir=None,
                  capture_mb=None, tp=None, mesh=None,
-                 weight_dtype=None):
+                 weight_dtype=None, engine_id=None, migrated_from=None):
         if not isinstance(decoder, Decoder):
             raise MXNetError("InferenceEngine needs a Decoder, got %r"
                              % type(decoder).__name__)
@@ -667,6 +675,19 @@ class InferenceEngine:
                 "build the Decoder with cache_block=None")
         self._dec = decoder
         self._t0 = time.perf_counter()   # ledger/capture time origin
+        # fleet identity: engine_id names this replica (FleetRouter
+        # rotation key, capture-header provenance); migrated_from is
+        # the donor's id when this engine was built by restore() from
+        # another engine's snapshot — requests it finishes attribute
+        # to the replica lineage that served them
+        self.engine_id = str(engine_id) if engine_id is not None \
+            else "e%d.%d" % (os.getpid(), next(_ENGINE_SEQ))
+        self.migrated_from = None if migrated_from is None \
+            else str(migrated_from)
+        # drain state: set by FleetRouter.drain (or an operator)
+        # before migration — admission stops routing here and
+        # /healthz reports it, distinct from stuck/closed
+        self.draining = False
         self.max_len = decoder.max_len
         self.slots = int(slots)
         if self.slots < 1:
@@ -1119,7 +1140,9 @@ class InferenceEngine:
         # stream (knob unset) is a no-op on every path
         self.capture = CaptureStream.open(
             capture_dir, capture_mb,
-            dict(self._geometry(), max_len=self.max_len), self._t0)
+            dict(self._geometry(), max_len=self.max_len,
+                 engine_id=self.engine_id,
+                 migrated_from=self.migrated_from), self._t0)
         # resolved (env default included) so snapshot() carries it
         self.capture_dir = os.path.dirname(self.capture.path) \
             if self.capture.enabled else None
@@ -1616,6 +1639,14 @@ class InferenceEngine:
         queued request in favor of this one.
         """
         self._check_open()
+        if self.draining and not _resume_tokens:
+            # a draining replica takes no NEW work; resumed
+            # (migrated/restored) submits still land so an operator
+            # can fold work INTO an engine that is about to stop —
+            # never the reverse
+            raise MXNetError(
+                "InferenceEngine: engine %s is draining — submit to "
+                "another replica" % self.engine_id)
         # validate shape/dtype HERE, where the caller can see the
         # problem — a bad prompt forwarded to the compiled programs
         # surfaces as an opaque shape/dtype error rounds later;
@@ -2668,6 +2699,7 @@ class InferenceEngine:
         return {
             "closed": self._closed,
             "stuck": self._watchdog_stuck_t is not None,
+            "draining": self.draining,
             "watchdog_trips": self.stats["watchdog_trips"],
             "slots": self.slots,
             "slots_busy": self.slots - len(self._free),
@@ -2843,6 +2875,9 @@ class InferenceEngine:
         return {
             "version": 1,
             "auto_seed": self._auto_seed,
+            # provenance, NOT geometry: restore() gives the successor
+            # a fresh identity and records this id as migrated_from
+            "engine_id": self.engine_id,
             "engine": self._geometry(),
             "requests": reqs,
         }
@@ -2906,6 +2941,10 @@ class InferenceEngine:
         cfg = dict(snap["engine"])
         cfg["prefill_buckets"] = tuple(cfg["prefill_buckets"])
         cfg.update(overrides)
+        # migration provenance: the successor's capture header names
+        # the donor engine, so a replayed crash/drain cycle attributes
+        # each request to the replica lineage that finished it
+        cfg.setdefault("migrated_from", snap.get("engine_id"))
         eng = cls(decoder, **cfg)
         handles = {}
         real_max_queue = eng.max_queue

@@ -1,0 +1,825 @@
+"""Fleet-level serving: N engines behind an admission router.
+
+One :class:`~mxnet_tpu.serving.InferenceEngine` is production-shaped
+but still a single point of failure: a dead replica loses every
+in-flight request, and there is no way to take one out of rotation
+for a deploy. :class:`FleetRouter` fronts N replicas (each itself
+optionally tp-sharded) with the serving contract intact:
+
+* **Health-driven routing** — submits are placed by each replica's
+  live ``health()`` signals (the ``/healthz`` dict: closed / stuck /
+  draining / queue depth / busy slots), with **prefix affinity**: the
+  replica whose PR 5 prefix trie retains the longest prefix of the
+  prompt wins placement (ties broken least-loaded), so shared-prefix
+  traffic keeps landing where its K/V rows already live.
+* **Transport discipline** (the PR 1 kvstore client's, repurposed for
+  request traffic): channel ops carry a per-request timeout, bounded
+  exponential backoff with jitter on retry, a ping heartbeat that
+  tells a dead replica from a slow one, and ``(client_id, seq)``
+  dedup so a caller's retried submit admits **exactly once** — at the
+  router by the dedup table, at the replica by adopting an already-
+  admitted request id instead of resubmitting it.
+* **Failover** — a replica that dies mid-round (its ``step()``
+  raises a non-engine error), trips its watchdog, or misses
+  ``heartbeat_misses`` consecutive pings is declared dead: the router
+  takes the PR 7 ``snapshot()`` of its host scheduler (valid after a
+  crash — no device state), closes it, and resubmits every unfinished
+  request on healthy peers with ``_resume_tokens``, so greedy
+  continuations stay **byte-identical** to an uninterrupted run (the
+  prefix cache absorbs the re-prefill where it hits).
+* **Drain** (:meth:`FleetRouter.drain`) — the rolling-restart half:
+  mark the replica ``draining`` (admission stops, ``/healthz``
+  reports the state), migrate its in-flight requests to peers the
+  same snapshot/resubmit way, close it. ``add_replica`` brings the
+  restarted successor back into rotation. A capture replayed through
+  a fleet under a rolling restart verifies byte-identical with zero
+  failed requests (tools/replay_serving.py ``--replicas``).
+* **Fleet-wide overload** — the PR 7 typed policies compose across
+  replicas: a submit is tried against every healthy replica in
+  placement order and only when ALL of them refuse does the router
+  raise (typed :class:`EngineOverloaded` when the fleet is shedding,
+  the generic backpressure ``MXNetError`` under ``block`` policies).
+  Requests orphaned mid-migration (the restore target died too) wait
+  in a router-side hold queue and re-place as replicas return.
+
+Everything is host-side bookkeeping over the engines' public seams
+(``submit``/``step``/``snapshot``/``health``/``close``); the compiled
+program families and the per-replica compile-count contract are
+untouched. The router mirrors the engine's driving surface
+(``submit``/``step``/``serve_forever``/``queued``/``max_queue``/
+``idle``/``health``), so ``tools/replay_serving.py`` replays a
+capture through a fleet unchanged.
+
+Knobs (constructor args override the ``MXNET_FLEET_*`` environment
+defaults — doc/env_var.md): ``timeout_ms``, ``max_retries``,
+``backoff_ms``, ``heartbeat_ms``, ``heartbeat_misses``.
+
+Observability: ``fleet.failovers``, ``fleet.drains``,
+``fleet.migrated_requests``, ``fleet.retries``, ``fleet.dedup_hits``,
+``fleet.heartbeat_misses``, ``fleet.affinity_hits`` counters and the
+``fleet.replicas_live`` gauge (doc/observability.md);
+``tools/dump_telemetry.py --fleet`` prints the one-line summary.
+
+Fault injection: ``mxnet_tpu.testing.faults`` installs itself as
+:data:`_FLEET_FAULTS` and drives the router's seams deterministically
+(kill-replica-mid-round, heartbeat blackhole, slow replica, submit
+failures) — tests/test_fleet.py and ``make chaos``.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import random
+import time
+
+import numpy as np
+
+from .. import telemetry as tele
+from ..base import MXNetError
+from .engine import EngineClosed, EngineOverloaded, EngineStuck
+
+__all__ = ["FleetRouter", "FleetRequest"]
+
+# FaultInjector hook point (mxnet_tpu.testing.faults installs itself
+# here while a fleet fault plan is active)
+_FLEET_FAULTS = None
+
+
+def _timeout_s():
+    """Per-channel-op timeout in seconds (MXNET_FLEET_TIMEOUT_MS): an
+    op slower than this counts as a timeout and triggers the
+    dead-vs-slow heartbeat probe before any resend."""
+    return float(os.environ.get("MXNET_FLEET_TIMEOUT_MS", "1000")) / 1e3
+
+
+def _max_retries():
+    """Resend budget AFTER the first attempt (MXNET_FLEET_MAX_RETRIES)."""
+    return int(os.environ.get("MXNET_FLEET_MAX_RETRIES", "3"))
+
+
+def _backoff_base_s():
+    """Base retry backoff in seconds (MXNET_FLEET_BACKOFF_MS)."""
+    return float(os.environ.get("MXNET_FLEET_BACKOFF_MS", "5")) / 1e3
+
+
+def _heartbeat_s():
+    """Ping cadence per replica in seconds (MXNET_FLEET_HEARTBEAT_MS)."""
+    return float(os.environ.get("MXNET_FLEET_HEARTBEAT_MS", "100")) / 1e3
+
+
+def _heartbeat_misses():
+    """Consecutive missed pings before a replica is declared dead
+    (MXNET_FLEET_HEARTBEAT_MISSES)."""
+    return int(os.environ.get("MXNET_FLEET_HEARTBEAT_MISSES", "3"))
+
+
+_TM_FAILOVERS = tele.counter("fleet.failovers")
+_TM_DRAINS = tele.counter("fleet.drains")
+_TM_MIGRATED = tele.counter("fleet.migrated_requests")
+_TM_RETRIES = tele.counter("fleet.retries")
+_TM_DEDUP = tele.counter("fleet.dedup_hits")
+_TM_HB_MISSES = tele.counter("fleet.heartbeat_misses")
+_TM_AFFINITY = tele.counter("fleet.affinity_hits")
+_TM_LIVE = tele.gauge("fleet.replicas_live")
+
+
+class FleetRequest:
+    """Router-level request handle: delegates to the CURRENT underlying
+    engine :class:`~mxnet_tpu.serving.Request` and is re-pointed when
+    the request migrates (failover or drain), so the caller's handle
+    survives any replica. While the request sits in the router's hold
+    queue (every placement target refused — mid-migration limbo) the
+    tokens drained before the migration stay readable.
+
+    The surface mirrors what callers and ``tools/replay_serving.py``
+    read off an engine handle: ``tokens``, ``done``, ``retire_reason``,
+    ``result()``, ``resumed``, ``t_submit``/``t_first``/``t_done``,
+    plus ``replica_id`` (where it lives now) and ``migrations``."""
+
+    __slots__ = ("id", "client_key", "migrations", "resumed",
+                 "_rec", "_cur", "_replica_id", "_t_submit", "_t_first",
+                 "_deadline_abs", "_ttft_deadline_abs", "_error",
+                 "_cancelled")
+
+    def __init__(self, rid, rec, client_key=None):
+        self.id = rid
+        self.client_key = client_key
+        self.migrations = 0
+        # what replay() subtracts from the token count: the resume
+        # prefix of the ORIGINAL fleet submit, never inflated by
+        # migrations (migrated tokens were generated in this run)
+        self.resumed = len(rec["tokens"])
+        self._rec = rec            # resubmission record (snapshot shape)
+        self._cur = None           # underlying Request, None while held
+        self._replica_id = None
+        now = time.perf_counter()
+        self._t_submit = now
+        self._t_first = None
+        self._deadline_abs = None if rec.get("deadline_ms") is None \
+            else now + rec["deadline_ms"] / 1e3
+        self._ttft_deadline_abs = None \
+            if rec.get("ttft_deadline_ms") is None \
+            else now + rec["ttft_deadline_ms"] / 1e3
+        self._error = None
+        self._cancelled = False
+
+    # -- delegation ---------------------------------------------------
+    @property
+    def tokens(self):
+        if self._cur is not None:
+            return self._cur.tokens
+        return list(self._rec["tokens"])
+
+    @property
+    def done(self):
+        if self._error is not None or self._cancelled:
+            return True
+        return self._cur is not None and self._cur.done
+
+    @property
+    def retire_reason(self):
+        if self._error is not None:
+            return "shed" if isinstance(self._error, EngineOverloaded) \
+                else "error"
+        if self._cancelled:
+            return "cancelled"
+        return None if self._cur is None else self._cur.retire_reason
+
+    @property
+    def replica_id(self):
+        return self._replica_id
+
+    @property
+    def t_submit(self):
+        return self._t_submit
+
+    @property
+    def t_first(self):
+        if self._t_first is not None:
+            return self._t_first
+        return None if self._cur is None else self._cur.t_first
+
+    @property
+    def t_done(self):
+        return None if self._cur is None else self._cur.t_done
+
+    @property
+    def prefix_hit_tokens(self):
+        return 0 if self._cur is None \
+            else getattr(self._cur, "prefix_hit_tokens", 0)
+
+    def result(self):
+        """The emitted tokens (resume prefix included), or the typed
+        error this request was retired with — same contract as
+        ``Request.result()``, across however many replicas served it."""
+        if self._error is not None:
+            raise self._error
+        if self._cur is None:
+            if self._cancelled:
+                return np.asarray(self._rec["tokens"], np.int64)
+            raise MXNetError(
+                "FleetRequest %r is awaiting re-placement (every "
+                "replica refused; step() the router)" % (self.id,))
+        return self._cur.result()
+
+    # -- router internals ---------------------------------------------
+    def _submit_kwargs(self, now):
+        """Engine-submit kwargs for (re)placement: deadlines are kept
+        ABSOLUTE at the router so time spent held or migrating never
+        refreshes a request's budget."""
+        kw = dict(
+            max_tokens=self._rec["max_tokens"],
+            eos_id=self._rec["eos_id"],
+            temperature=self._rec["temperature"],
+            seed=self._rec["seed"],
+            request_id=self.id,
+            _resume_tokens=tuple(self._rec["tokens"]),
+        )
+        if self._deadline_abs is not None:
+            kw["deadline_ms"] = (self._deadline_abs - now) * 1e3
+        if self._ttft_deadline_abs is not None and self._t_first is None:
+            kw["ttft_deadline_ms"] = \
+                (self._ttft_deadline_abs - now) * 1e3
+        return kw
+
+    def _point_at(self, req, replica_id):
+        self._cur = req
+        self._replica_id = replica_id
+        if self._rec["seed"] is None:      # engine drew it: pin for
+            self._rec["seed"] = int(req.seed)   # any later migration
+
+    def _unhook(self, snap_rec):
+        """Detach from a dying replica: absorb the snapshot record
+        (authoritative token prefix + remaining budgets) and remember
+        the first-token time — the old underlying handle is about to
+        be retired by ``close()`` and must not speak for us."""
+        if self._cur is not None and self._cur.t_first is not None \
+                and self._t_first is None:
+            self._t_first = self._cur.t_first
+        self._rec = dict(self._rec, tokens=list(snap_rec["tokens"]))
+        self._cur = None
+        self._replica_id = None
+
+    def __repr__(self):
+        return ("FleetRequest(id=%r, replica=%r, tokens=%d, "
+                "migrations=%d, done=%r)"
+                % (self.id, self._replica_id, len(self.tokens),
+                   self.migrations, self.done))
+
+
+class _Replica:
+    """Router-side bookkeeping for one managed engine."""
+
+    __slots__ = ("engine", "id", "alive", "misses", "last_hb", "order")
+
+    def __init__(self, engine, order):
+        self.engine = engine
+        self.id = engine.engine_id
+        self.alive = True
+        self.misses = 0
+        self.last_hb = -float("inf")
+        self.order = order
+
+
+class FleetRouter:
+    """Admission router over N :class:`InferenceEngine` replicas —
+    module docstring has the full contract. Drive it exactly like one
+    engine: ``submit()`` + ``step()`` (or ``serve_forever()``);
+    ``close()`` shuts the whole fleet down."""
+
+    def __init__(self, engines, timeout_ms=None, max_retries=None,
+                 backoff_ms=None, heartbeat_ms=None,
+                 heartbeat_misses=None, seed=0):
+        engines = list(engines)
+        if not engines:
+            raise MXNetError("FleetRouter: need at least one replica")
+        self.timeout_ms = float(timeout_ms) if timeout_ms is not None \
+            else _timeout_s() * 1e3
+        self.max_retries = int(max_retries) if max_retries is not None \
+            else _max_retries()
+        self.backoff_s = (float(backoff_ms) / 1e3) \
+            if backoff_ms is not None else _backoff_base_s()
+        self.heartbeat_s = (float(heartbeat_ms) / 1e3) \
+            if heartbeat_ms is not None else _heartbeat_s()
+        self.heartbeat_misses = int(heartbeat_misses) \
+            if heartbeat_misses is not None else _heartbeat_misses()
+        if self.max_retries < 0 or self.heartbeat_misses < 1:
+            raise MXNetError("FleetRouter: max_retries must be >= 0 "
+                             "and heartbeat_misses >= 1")
+        self._rng = random.Random(seed)    # backoff jitter (seeded:
+        self._replicas = {}                # deterministic tests)
+        self._order = 0
+        self._requests = {}                # id -> FleetRequest (live)
+        self._held = collections.deque()   # awaiting re-placement
+        self._dedup = {}                   # (client_id, seq) -> handle
+        self._next_id = 0
+        self._closed = False
+        self.stats = collections.defaultdict(int)
+        for e in engines:
+            self.add_replica(e)
+
+    # -- replica set ----------------------------------------------------
+    def add_replica(self, engine):
+        """Bring a (fresh or restarted) engine into rotation. Held
+        requests re-place onto it on the next :meth:`step`."""
+        self._check_open()
+        if getattr(engine, "_closed", False):
+            raise MXNetError("FleetRouter: replica %r is closed"
+                             % (getattr(engine, "engine_id", engine),))
+        rid = engine.engine_id
+        old = self._replicas.get(rid)
+        if old is not None and old.alive:
+            raise MXNetError("FleetRouter: replica id %r is already "
+                             "in rotation" % (rid,))
+        self._replicas[rid] = _Replica(engine, self._order)
+        self._order += 1
+        _TM_LIVE.set(len(self._live()))
+        return rid
+
+    def replica(self, rid):
+        rep = self._replicas.get(rid)
+        return None if rep is None else rep.engine
+
+    def replica_ids(self, live_only=False):
+        if live_only:
+            return [r.id for r in self._live()]
+        return list(self._replicas)
+
+    def _live(self):
+        return [r for r in self._replicas.values()
+                if r.alive and not r.engine._closed]
+
+    def _candidates(self):
+        """Replicas admission may target: alive, not draining, not
+        stuck, not closed — the health() signals a real fleet would
+        scrape off each replica's /healthz."""
+        out = []
+        for r in self._live():
+            h = r.engine.health()
+            if h.get("draining") or h.get("stuck"):
+                continue
+            out.append(r)
+        return out
+
+    # -- engine-mirroring surface ---------------------------------------
+    @property
+    def max_queue(self):
+        """Aggregate admission capacity (live replicas' max_queue sum;
+        at least 1 so a replica-less interregnum doesn't zero the
+        backpressure check into a busy loop)."""
+        return max(1, sum(r.engine.max_queue for r in self._live()))
+
+    def queued(self):
+        return sum(r.engine.queued() for r in self._live()) \
+            + len(self._held)
+
+    @property
+    def weight_dtype(self):
+        """The fleet's weight-storage dtype (replicas are uniform;
+        replay's auto verify-mode keys off it)."""
+        live = self._live()
+        return live[0].engine.weight_dtype if live else "float"
+
+    @property
+    def idle(self):
+        return not self._held and all(r.engine.idle
+                                      for r in self._live())
+
+    def health(self):
+        """Fleet liveness: per-replica ``health()`` dicts (dead ones
+        abbreviated) plus router-level queue state."""
+        reps = {}
+        for r in self._replicas.values():
+            if r.alive and not r.engine._closed:
+                reps[r.id] = r.engine.health()
+            else:
+                reps[r.id] = {"closed": True, "dead": True}
+        return {
+            "closed": self._closed,
+            "replicas": reps,
+            "replicas_live": len(self._live()),
+            "held": len(self._held),
+        }
+
+    def _check_open(self):
+        if self._closed:
+            raise EngineClosed("FleetRouter is closed")
+
+    # -- admission ------------------------------------------------------
+    def submit(self, prompt, max_tokens, eos_id=None, temperature=0.0,
+               seed=None, request_id=None, deadline_ms=None,
+               ttft_deadline_ms=None, client_id=None, seq=None,
+               _resume_tokens=()):
+        """Route one request to a healthy replica; returns its
+        :class:`FleetRequest` handle.
+
+        ``(client_id, seq)`` is the exactly-once identity for callers
+        that RETRY a submit after an ambiguous failure (their channel
+        to the router timed out): a resubmission with the same pair
+        returns the original handle instead of admitting twice — the
+        PR 1 kvstore dedup discipline applied to request traffic.
+        Both-or-neither; ids are per-client monotonic sequence
+        numbers.
+
+        Placement prefers the replica whose prefix cache retains the
+        longest prefix of ``prompt`` (affinity), then the least
+        loaded. A replica that refuses (typed shed or block
+        backpressure) is skipped; only when EVERY healthy replica
+        refuses does the router raise — typed
+        :class:`EngineOverloaded` if the fleet is shedding, else the
+        generic backpressure error."""
+        self._check_open()
+        if (client_id is None) != (seq is None):
+            raise MXNetError("FleetRouter: client_id and seq must be "
+                             "passed together")
+        key = None
+        if client_id is not None:
+            key = (client_id, int(seq))
+            prev = self._dedup.get(key)
+            if prev is not None:
+                self.stats["dedup_hits"] += 1
+                _TM_DEDUP.inc()
+                return prev
+        rid = request_id
+        if rid is None:
+            rid = "f%d" % self._next_id
+            self._next_id += 1
+        rec = {
+            "prompt": np.asarray(prompt),
+            "tokens": list(_resume_tokens),
+            "max_tokens": max_tokens,
+            "eos_id": eos_id,
+            "temperature": temperature,
+            "seed": seed,
+            "deadline_ms": deadline_ms,
+            "ttft_deadline_ms": ttft_deadline_ms,
+        }
+        fr = FleetRequest(rid, rec, client_key=key)
+        self._place_new(fr)
+        self._requests[rid] = fr
+        if key is not None:
+            self._dedup[key] = fr
+        self.stats["submitted"] += 1
+        return fr
+
+    def _place_new(self, fr):
+        """First placement of a fresh submit: raise on fleet-wide
+        refusal (migrations use :meth:`_try_place` and hold instead)."""
+        shed_err, block_err = None, None
+        for rep in self._ranked(fr):
+            try:
+                req = self._channel_submit(rep, fr)
+            except EngineOverloaded as e:
+                shed_err = e
+                continue
+            except EngineClosed:
+                self._fail_over(rep, "closed underneath the router")
+                continue
+            except ConnectionError:
+                self._fail_over(rep, "channel dead")
+                continue
+            except MXNetError as e:
+                if "queue is full" in str(e):
+                    block_err = e          # block-policy backpressure
+                    continue
+                raise                      # validation error: caller bug
+            fr._point_at(req, rep.id)
+            return
+        if shed_err is not None:
+            raise EngineOverloaded(
+                "FleetRouter: fleet-wide overload — every healthy "
+                "replica shed (last: %s)" % (shed_err,))
+        if block_err is not None:
+            raise MXNetError(
+                "FleetRouter: every healthy replica's queue is full "
+                "(block policy) — step() the router to drain")
+        raise MXNetError("FleetRouter: no healthy replica to admit "
+                         "request %r (live=%d)"
+                         % (fr.id, len(self._live())))
+
+    def _ranked(self, fr):
+        """Placement order: deepest prefix-affinity first, then least
+        loaded, then rotation order. Counts an affinity hit when a
+        retained prefix actually decided placement."""
+        cands = self._candidates()
+        if not cands:
+            return []
+        prompt = fr._rec["prompt"]
+        scored = []
+        for rep in cands:
+            h = rep.engine.health()
+            load = h.get("queued", 0) + h.get("slots_busy", 0)
+            scored.append((-self._affinity(rep.engine, prompt),
+                           load, rep.order, rep))
+        scored.sort(key=lambda t: t[:3])
+        if scored and scored[0][0] < 0:
+            self.stats["affinity_hits"] += 1
+            _TM_AFFINITY.inc()
+        return [t[3] for t in scored]
+
+    @staticmethod
+    def _affinity(engine, prompt):
+        """Longest retained prefix of ``prompt`` in the replica's
+        trie — a PLACEMENT HINT only: no LRU touch, no pin (the
+        engine re-walks at admission and takes the hit itself)."""
+        pc = getattr(engine, "_prefix", None)
+        if pc is None or not len(prompt):
+            return 0
+        node, depth = pc._root, 0
+        for t in prompt:
+            child = node.children.get(int(t))
+            if child is None:
+                break
+            node, depth = child, depth + 1
+        return depth
+
+    def _channel_submit(self, rep, fr, migration=False):
+        """One admission over the replica channel, with the PR 1
+        transport discipline: per-op timeout, bounded exponential
+        backoff + jitter on retry, ping-probe after a timeout to tell
+        dead from slow, and exactly-once adoption — a retried submit
+        whose first attempt DID land (the reply was what got lost)
+        finds the admitted request by id instead of double-admitting.
+        Raises ``ConnectionError`` when the budget is exhausted;
+        ``migration=True`` lifts ``max_queue`` for the one submit
+        (migrated work was already admitted fleet-wide and must never
+        shed — the PR 7 ``restore()`` discipline)."""
+        eng = rep.engine
+        backoff = self.backoff_s
+        last_err = None
+        for attempt in range(self.max_retries + 1):
+            flt = _FLEET_FAULTS
+            try:
+                if flt is not None:
+                    delay = flt.fleet_submit(rep.id)
+                    if delay and delay * 1e3 > self.timeout_ms:
+                        raise TimeoutError(
+                            "fleet channel: submit to %r exceeded "
+                            "timeout_ms=%g" % (rep.id, self.timeout_ms))
+                kw = fr._submit_kwargs(time.perf_counter())
+                if migration:
+                    real_mq = eng.max_queue
+                    eng.max_queue = max(real_mq, eng.queued() + 1)
+                    try:
+                        return eng.submit(fr._rec["prompt"], **kw)
+                    finally:
+                        eng.max_queue = real_mq
+                return eng.submit(fr._rec["prompt"], **kw)
+            except (ConnectionError, TimeoutError) as e:
+                last_err = e
+                # the first attempt may have landed before the fault
+                # (lost-reply case): adopt it — exactly-once admission
+                existing = eng._active.get(fr.id)
+                if existing is not None:
+                    return existing
+                alive = isinstance(e, TimeoutError) \
+                    and self._ping(rep)
+                if attempt >= self.max_retries:
+                    raise ConnectionError(
+                        "fleet channel: replica %r %s after %d "
+                        "attempt(s) (%s)"
+                        % (rep.id,
+                           "is alive but slow" if alive
+                           else "is unreachable or died",
+                           attempt + 1, e))
+                self.stats["retries"] += 1
+                _TM_RETRIES.inc()
+                if not alive:
+                    delay = backoff * (2 ** attempt)
+                    time.sleep(min(
+                        delay * (0.5 + self._rng.random()), 0.5))
+        raise ConnectionError("fleet channel: replica %r failed (%s)"
+                              % (rep.id, last_err))  # pragma: no cover
+
+    # -- heartbeats / liveness ------------------------------------------
+    def _ping(self, rep):
+        """One heartbeat probe: False = no answer (a blackholed or
+        dead peer), True = alive (possibly slow/stuck — health() says
+        which). In-process the 'network' is the fault injector."""
+        flt = _FLEET_FAULTS
+        if flt is not None and flt.fleet_ping_blackholed(rep.id):
+            return False
+        return rep.alive and not rep.engine._closed
+
+    def _heartbeat(self, rep):
+        if self._ping(rep):
+            rep.misses = 0
+            return
+        rep.misses += 1
+        self.stats["heartbeat_misses"] += 1
+        _TM_HB_MISSES.inc()
+        if rep.misses >= self.heartbeat_misses:
+            self._fail_over(rep, "%d consecutive heartbeat misses"
+                            % rep.misses)
+
+    # -- failover / drain -----------------------------------------------
+    def _fail_over(self, rep, reason):
+        """Declare ``rep`` dead and migrate its unfinished requests to
+        peers: snapshot the host scheduler (valid after a crash or
+        watchdog trip — PR 7), close the corpse, resubmit every
+        request with its token prefix so continuations stay
+        byte-identical. Requests no peer can take right now wait in
+        the hold queue."""
+        if not rep.alive:
+            return
+        rep.alive = False
+        _TM_LIVE.set(len(self._live()))
+        self.stats["failovers"] += 1
+        _TM_FAILOVERS.inc()
+        try:
+            snap = rep.engine.snapshot()
+        except Exception:
+            snap = {"requests": []}
+        self._detach(snap)
+        with contextlib.suppress(Exception):
+            rep.engine.close()
+        self._drain_held()
+
+    def drain(self, replica):
+        """Take one replica out of rotation for a deploy, migrating
+        its in-flight work live (doc/fault_tolerance.md "Fleet
+        resilience" has the runbook): admission stops first (the
+        engine reports ``draining`` on ``/healthz``), then the
+        snapshot/resubmit migration runs and the replica is closed.
+        Pass the engine or its ``engine_id``; returns the snapshot
+        that was migrated (what an operator would archive). Restart
+        with :meth:`add_replica`."""
+        self._check_open()
+        rid = getattr(replica, "engine_id", replica)
+        rep = self._replicas.get(rid)
+        if rep is None or not rep.alive or rep.engine._closed:
+            raise MXNetError("FleetRouter.drain: %r is not a live "
+                             "replica" % (rid,))
+        rep.engine.draining = True       # stop admission; /healthz
+        snap = rep.engine.snapshot()     # reports "draining"
+        rep.alive = False
+        _TM_LIVE.set(len(self._live()))
+        self.stats["drains"] += 1
+        _TM_DRAINS.inc()
+        self._detach(snap)
+        with contextlib.suppress(Exception):
+            rep.engine.close()
+        self._drain_held()
+        return snap
+
+    def _detach(self, snap):
+        """Re-point every fleet handle off a dying replica onto the
+        hold queue, snapshot record absorbed (token prefix + remaining
+        deadline budgets)."""
+        for r in snap.get("requests", ()):
+            fr = self._requests.get(r["id"])
+            if fr is None or fr.done:
+                continue
+            fr._unhook(r)
+            self._held.append(fr)
+
+    def _drain_held(self):
+        """One re-placement pass over the hold queue (each held
+        request tried once; failures keep waiting — a later step or
+        add_replica retries)."""
+        for _ in range(len(self._held)):
+            if not self._held:
+                break
+            fr = self._held.popleft()
+            if fr.done:
+                continue
+            if self._try_place(fr):
+                fr.migrations += 1
+                self.stats["migrated_requests"] += 1
+                _TM_MIGRATED.inc()
+            else:
+                self._held.append(fr)
+
+    def _try_place(self, fr):
+        """Best-effort migration placement: refusals hold instead of
+        raising (the work was already admitted fleet-wide)."""
+        for rep in self._ranked(fr):
+            try:
+                req = self._channel_submit(rep, fr, migration=True)
+            except (EngineOverloaded, EngineClosed):
+                continue
+            except ConnectionError:
+                self._fail_over(rep, "channel dead mid-migration")
+                continue
+            except MXNetError:
+                continue
+            fr._point_at(req, rep.id)
+            return True
+        return False
+
+    # -- the drive loop -------------------------------------------------
+    def step(self):
+        """One fleet scheduling round: heartbeat sweep, hold-queue
+        re-placement, then one ``step()`` on every non-idle live
+        replica. A replica whose step raises a non-engine error
+        (process death — ``InjectedCrash`` in tests, deliberately not
+        an ``MXNetError``) or a typed ``EngineStuck`` fails over; its
+        requests continue on peers."""
+        self._check_open()
+        now = time.perf_counter()
+        for rep in list(self._replicas.values()):
+            if not rep.alive or rep.engine._closed:
+                continue
+            if now - rep.last_hb >= self.heartbeat_s:
+                rep.last_hb = now
+                self._heartbeat(rep)
+        self._drain_held()
+        for rep in list(self._replicas.values()):
+            if not rep.alive or rep.engine._closed \
+                    or rep.engine.idle:
+                continue
+            flt = _FLEET_FAULTS
+            ctx = flt.fleet_step_context(rep.id) \
+                if flt is not None else None
+            try:
+                with (ctx if ctx is not None
+                      else contextlib.nullcontext()):
+                    rep.engine.step()
+            except EngineClosed:
+                self._fail_over(rep, "closed underneath the router")
+            except EngineStuck:
+                self._fail_over(rep, "watchdog trip")
+            except MXNetError:
+                raise                      # a bug, not a death
+            except Exception:              # InjectedCrash / SIGKILL
+                self._fail_over(rep, "died mid-round")
+        if self._requests and not self.stats["steps"] % 16:
+            self._requests = {k: v for k, v in self._requests.items()
+                              if not v.done}
+        self.stats["steps"] += 1
+
+    def serve_forever(self, requests=None):
+        """Drive the fleet until idle, optionally ingesting submits
+        from ``requests`` (same item protocol as the engine's
+        ``serve_forever``: dict kwargs, ``(prompt, kwargs)``, a bare
+        prompt, or ``None`` = nothing arrived yet). Returns every
+        request retired during this call, submission order."""
+        self._check_open()
+        before = {rid for rid, fr in self._requests.items() if fr.done}
+        it = iter(requests) if requests is not None else None
+        while True:
+            if it is not None:
+                try:
+                    item = next(it)
+                except StopIteration:
+                    it = None
+                else:
+                    if item is not None:
+                        if isinstance(item, dict):
+                            self.submit(**item)
+                        elif isinstance(item, tuple) and len(item) == 2\
+                                and isinstance(item[1], dict):
+                            self.submit(item[0], **item[1])
+                        else:
+                            self.submit(item, max_tokens=16)
+            if it is None and self.idle:
+                break
+            self.step()
+        return [fr for rid, fr in self._requests.items()
+                if fr.done and rid not in before]
+
+    def cancel(self, request_id):
+        """Retire one request wherever it lives (queued, in-flight on
+        any replica, or held mid-migration); tokens so far stay
+        readable. True if it was live."""
+        fr = self._requests.get(request_id)
+        if fr is None or fr.done:
+            return False
+        if fr._cur is not None:
+            rep = self._replicas.get(fr._replica_id)
+            if rep is not None and rep.alive \
+                    and not rep.engine._closed:
+                return rep.engine.cancel(request_id)
+        try:
+            self._held.remove(fr)
+        except ValueError:
+            pass
+        fr._cancelled = True
+        return True
+
+    def close(self):
+        """Shut the whole fleet down: every replica closes (its
+        pending requests retire with ``EngineClosed``) and held
+        requests fail the same way. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        for rep in self._replicas.values():
+            with contextlib.suppress(Exception):
+                rep.engine.close()
+            rep.alive = False
+        err = EngineClosed("FleetRouter was closed before this "
+                           "request was re-placed")
+        while self._held:
+            fr = self._held.popleft()
+            if not fr.done:
+                fr._error = err
+        _TM_LIVE.set(0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -43,6 +43,22 @@ Two injection surfaces:
   ``InferenceEngine.restore()`` kill-and-recover scenarios
   (tests/test_serving_faults.py).
 
+* **Fleet faults** — the injector installs itself as
+  ``serving.fleet._FLEET_FAULTS`` and drives the
+  :class:`~mxnet_tpu.serving.FleetRouter`'s seams:
+  :meth:`FaultInjector.fleet_kill_replica` (the named replica's next
+  stepped round dies with :class:`InjectedCrash` via the engine's own
+  crash seam — genuine mid-round death, dispatched-but-undrained),
+  :meth:`FaultInjector.fleet_heartbeat_blackhole` (the replica's next
+  pings go unanswered — dead-vs-slow discrimination and
+  miss-threshold failover), :meth:`FaultInjector.fleet_slow_replica`
+  (the channel to the replica stalls; the router's per-op timeout and
+  ping probe decide slow-not-dead), and
+  :meth:`FaultInjector.fleet_submit_failures` (the channel drops the
+  submit — retry/backoff and the exactly-once adoption path). A
+  directive naming replica ``None`` matches whichever replica reaches
+  that seam first.
+
 Every injected fault is appended to ``FaultInjector.log`` as
 ``(kind, op)`` so tests can assert the schedule actually fired.
 """
@@ -91,9 +107,11 @@ class FaultInjector:
         self.rng = random.Random(seed)
         self.plan = collections.deque()
         self.serving_plan = collections.deque()
+        self.fleet_plan = collections.deque()
         self.log = []          # (kind, op) per injected fault
         self._depth = 0
         self._serving_depth = 0
+        self._fleet_depth = 0
         self._hang_until = None
         self._lock = threading.Lock()
 
@@ -217,6 +235,109 @@ class FaultInjector:
         self.log.append(("crash", None))
         raise InjectedCrash("fault injection: process died mid-round "
                             "(dispatched, undrained)")
+
+    # -- fleet plans ---------------------------------------------------
+    def fleet_kill_replica(self, replica_id=None, n=1):
+        """Kill the named replica (or whichever steps first when
+        ``None``) mid-round, ``n`` times: its next stepped round dies
+        with :class:`InjectedCrash` AFTER dispatch via the engine's
+        own crash seam — tokens dispatched but undrained, exactly the
+        snapshot-after-crash state the router must fail over from."""
+        return self._fleet_scheduled([("kill_replica", replica_id)] * n)
+
+    def fleet_heartbeat_blackhole(self, replica_id=None, n=1):
+        """The replica's next ``n`` heartbeat pings go unanswered (a
+        partitioned or hung peer): ``heartbeat_misses`` consecutive
+        misses and the router declares it dead and fails over."""
+        return self._fleet_scheduled([("blackhole", replica_id)] * n)
+
+    def fleet_slow_replica(self, replica_id=None, seconds=1.0, n=1):
+        """The channel to the replica stalls ``seconds`` on the next
+        ``n`` submits. Past the router's ``timeout_ms`` the op times
+        out and the ping probe decides slow-not-dead (retry, no
+        failover) — under it, the submit just lands."""
+        return self._fleet_scheduled(
+            [("slow", replica_id, seconds)] * n)
+
+    def fleet_submit_failures(self, replica_id=None, n=1):
+        """Drop the next ``n`` submits to the replica on the floor
+        (``ConnectionError`` from the channel): the router's bounded
+        retry/backoff — and, when the submit actually LANDED before
+        the fault, the exactly-once adoption path — must absorb it."""
+        return self._fleet_scheduled([("submit_fail", replica_id)] * n)
+
+    @contextlib.contextmanager
+    def _fleet_scheduled(self, directives):
+        from ..serving import fleet as _sf
+
+        with self._lock:
+            self.fleet_plan.extend(directives)
+            if self._fleet_depth == 0:
+                self._fleet_prev = _sf._FLEET_FAULTS
+                _sf._FLEET_FAULTS = self
+            self._fleet_depth += 1
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._fleet_depth -= 1
+                if self._fleet_depth == 0:
+                    _sf._FLEET_FAULTS = self._fleet_prev
+                    self.fleet_plan.clear()
+
+    # -- hooks called by serving.fleet.FleetRouter --------------------
+    def _fleet_head(self, kind, replica_id):
+        """Pop-and-return the head directive iff it is ``kind`` aimed
+        at ``replica_id`` (or at anyone). FIFO: a head aimed at a
+        DIFFERENT replica blocks this one from matching, so a test's
+        schedule fires in the order it was written."""
+        head = self.fleet_plan[0] if self.fleet_plan else None
+        if head is None or head[0] != kind:
+            return None
+        if head[1] is not None and head[1] != replica_id:
+            return None
+        return self.fleet_plan.popleft()
+
+    def fleet_step_context(self, replica_id):
+        """Context manager for one replica round, or None. A matched
+        kill directive arms the ENGINE crash seam for the round's
+        scope, so death lands after dispatch exactly like
+        :meth:`serving_crash_mid_round`."""
+        with self._lock:
+            head = self._fleet_head("kill_replica", replica_id)
+        if head is None:
+            return None
+        self.log.append(("kill_replica", replica_id))
+        return self._serving_scheduled([("crash",)])
+
+    def fleet_ping_blackholed(self, replica_id):
+        """True when the replica's ping should go unanswered."""
+        with self._lock:
+            head = self._fleet_head("blackhole", replica_id)
+        if head is None:
+            return False
+        self.log.append(("blackhole", replica_id))
+        return True
+
+    def fleet_submit(self, replica_id):
+        """Channel fault for one submit attempt: raises
+        ``ConnectionError`` (dropped), or returns a stall in seconds
+        (the router judges it against its timeout), or 0 (clean)."""
+        with self._lock:
+            head = self._fleet_head("submit_fail", replica_id)
+            if head is None:
+                slow = self._fleet_head("slow", replica_id)
+            else:
+                slow = None
+        if head is not None:
+            self.log.append(("submit_fail", replica_id))
+            raise ConnectionError(
+                "fault injection: submit to replica %r lost"
+                % (replica_id,))
+        if slow is not None:
+            self.log.append(("slow", replica_id))
+            return slow[2]
+        return 0
 
     @contextlib.contextmanager
     def _scheduled(self, directives):

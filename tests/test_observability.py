@@ -452,6 +452,40 @@ def test_healthz_ignores_closed_stuck_engines():
         tele.stop_server()
 
 
+def test_healthz_reports_draining_without_503():
+    """Fleet satellite (ISSUE 16): a draining replica is deliberately
+    refusing NEW admissions while it migrates its in-flight work — it
+    is healthy, not stuck.  /healthz must stay 200 and surface the
+    ``draining`` field verbatim so fleet dashboards can tell "rolling
+    restart in progress" from "replica wedged" (the real engine's
+    health()['draining'] flip is pinned in test_fleet.py)."""
+    from mxnet_tpu.serving import engine as engine_mod
+
+    class _Draining:
+        flight = FlightRecorder(retain=0)
+
+        def request_table(self):
+            return []
+
+        def health(self):
+            return {"closed": False, "stuck": False, "watchdog_trips": 0,
+                    "draining": True}
+
+    stub = _Draining()
+    engine_mod._ENGINES.add(stub)
+    srv = tele.serve(port=0)
+    try:
+        status, _, body = _get(srv.url + "/healthz")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["status"] == "ok"
+        ours = [e for e in doc["engines"] if e.get("draining")]
+        assert ours and ours[0]["draining"] is True
+    finally:
+        engine_mod._ENGINES.discard(stub)
+        tele.stop_server()
+
+
 def test_collect_lowering_miss_does_not_replay_side_effects():
     """If collection's lower() ever MISSES the lowering cache (e.g.
     committed-array avals on a real chip), the re-trace replays

@@ -467,6 +467,43 @@ def test_restore_beyond_bucket_prefix_hit_chunking_off(lm, ceng):
     assert len(ceng._free) == ceng.slots
 
 
+def test_snapshot_mid_speculative_verify_round(lm, ceng):
+    """Fleet satellite (ISSUE 16): a crash that lands DURING a
+    speculative verify round — draft tokens dispatched to the verify
+    program but never drained — snapshots to the drained prefix only
+    and restores byte-identically: speculation never makes a crash
+    lossy beyond the round, and the restored engine keeps drafting."""
+    p = np.array([0, 3, 3])            # ngram-friendly repetition
+    r = ceng.submit(p, max_tokens=13)
+    while len(r.tokens) < 5:           # drafting is established
+        ceng.step()
+    fi = FaultInjector()
+    with fi.serving_crash_mid_round(1):
+        with pytest.raises(InjectedCrash):
+            for _ in range(10):
+                ceng.step()
+    # the cut round WAS a verify round: its dispatched-but-undrained
+    # entry is still queued at the drain tail
+    assert ceng._drain and ceng._drain[-1][0] == "verify"
+    snap = ceng.snapshot()
+    rec = {x["id"]: x for x in snap["requests"]}[r.id]
+    assert 5 <= len(rec["tokens"]) < 13   # undrained tail NOT counted
+    eng2, handles = InferenceEngine.restore(snap, _mkdec(lm))
+    eng2.serve_forever()
+    np.testing.assert_array_equal(handles[r.id].result(),
+                                  _oracle(lm, p, 13))
+    assert eng2.stats["spec_rounds"] > 0     # the successor drafts too
+    assert len(eng2._free) == eng2.slots
+    if eng2._prefix is not None:
+        assert eng2._prefix.pinned == 0
+    assert_compile_contract(eng2)
+    eng2.close()
+    ceng.serve_forever()               # the crashed engine drains clean
+    assert ceng._prefix.pinned == 0
+    assert len(ceng._free) == ceng.slots
+    assert_compile_contract(ceng)
+
+
 def test_flight_recorder_reconstructs_failed_request_over_http(lm,
                                                                feng):
     """ISSUE 9 acceptance: a fault-injected serving run leaves a

@@ -204,6 +204,22 @@ def test_capture_file_complete_and_replayable_header(lm, captured):
         == captured["emitted_at_cut"]
 
 
+def test_capture_header_records_migration_provenance(captured):
+    """Fleet satellite (ISSUE 16): restore() under an armed
+    capture_dir stamps the SOURCE engine's id into the successor's
+    capture header (``migrated_from``) — the tape of the
+    post-migration generation says where its work came from, and the
+    original generation says it came from nowhere."""
+    cap1 = load_capture(captured["path"])
+    cap2 = load_capture(captured["path2"])
+    assert cap1["engine"]["engine_id"]
+    assert cap1["engine"]["migrated_from"] is None
+    assert cap2["engine"]["migrated_from"] \
+        == cap1["engine"]["engine_id"]
+    # the successor is a NEW replica identity, not a clone
+    assert cap2["engine"]["engine_id"] != cap1["engine"]["engine_id"]
+
+
 def test_replay_verify_spec_off_byte_identical(lm, captured,
                                                replay_spec_off):
     """Acceptance flavor 1: the spec-on capture replays on a spec-OFF

@@ -10,7 +10,9 @@ each against a direction inferred from the key name:
 
 * **higher-is-better** (``*tokens_per_sec*``, ``*img_per_sec*``,
   ``*speedup*``, ``*tflops*``, ``*accept*``, ``*mfu*``,
-  ``*goodput*``): a drop beyond the threshold is a regression;
+  ``*goodput*``, ``*zero_failed*`` — the fleet rolling-restart
+  verdict ``fleet_zero_failed_restart``): a drop beyond the
+  threshold is a regression;
 * **lower-is-better** (``*_ms``, ``*_ms_per_*``, ``*overhead*``,
   ``*_pct``, ``*bytes_accessed*``): a rise beyond the threshold is a
   regression;
@@ -36,7 +38,8 @@ import json
 import sys
 
 _HIGHER = ("tokens_per_sec", "img_per_sec", "speedup", "tflops",
-           "accept", "mfu", "goodput", "samples_per_sec", "hit_tokens")
+           "accept", "mfu", "goodput", "samples_per_sec", "hit_tokens",
+           "zero_failed")
 _LOWER = ("_ms", "overhead", "_pct", "bytes_accessed", "_bytes",
           "spread")
 

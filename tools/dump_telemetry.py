@@ -10,6 +10,7 @@ Usage::
     python tools/dump_telemetry.py /tmp/tr/mx_trace_1.json  # trace table
     python tools/dump_telemetry.py trace.json --names io. train.
     python tools/dump_telemetry.py BENCH_extra.json --serving
+    python tools/dump_telemetry.py BENCH_extra.json --fleet
     python tools/dump_telemetry.py --url http://host:9100   # live server
     python tools/dump_telemetry.py --url http://host:9100 --watch 2
 
@@ -35,6 +36,13 @@ trace file it filters to ``serving.`` spans. Since ISSUE 13 it also
 prints the round-phase breakdown (``serving.round_phase_ms.*`` —
 drain / prefill / dispatch / host-sched shares of the round wall
 time) and the traffic-capture counters.
+
+``--fleet`` narrows to the FleetRouter's counters (``fleet.*`` —
+doc/fault_tolerance.md "Fleet resilience"): live replicas, failovers,
+drains, migrated requests, channel retries, dedup hits, heartbeat
+misses, and affinity placements — the one-look answer to "did the
+fleet actually fail anything over, and did placement keep prefixes
+warm".
 """
 from __future__ import annotations
 
@@ -219,6 +227,28 @@ def print_serving(snap, out=None):
                       % (key, _fmt_hist(v) if v["count"] else "(empty)"))
 
 
+def print_fleet(snap, out=None):
+    """Fleet-router view: the resilience counters on one line each —
+    what a post-incident (or post-drill) read needs first."""
+    out = out or sys.stdout
+    s = snap.get("fleet")
+    if not isinstance(s, dict) or not s:
+        out.write("(no fleet metrics in this snapshot)\n")
+        return
+    out.write("fleet replicas:   live=%s\n"
+              % int(s.get("replicas_live", 0)))
+    out.write("resilience:       failovers=%s drains=%s "
+              "migrated_requests=%s\n"
+              % (s.get("failovers", 0), s.get("drains", 0),
+                 s.get("migrated_requests", 0)))
+    out.write("channel:          retries=%s dedup_hits=%s "
+              "heartbeat_misses=%s\n"
+              % (s.get("retries", 0), s.get("dedup_hits", 0),
+                 s.get("heartbeat_misses", 0)))
+    out.write("placement:        affinity_hits=%s\n"
+              % s.get("affinity_hits", 0))
+
+
 def print_trace(doc, name_filters=(), out=None):
     out = out or sys.stdout
     evs = doc.get("traceEvents", [])
@@ -278,6 +308,8 @@ def _print(doc, args, out=None):
         names = tuple(args.names)
         if args.serving:
             names += ("serving.",)
+        if args.fleet:
+            names += ("fleet.",)
         print_trace(doc, names, out)
         return
     # snapshot, possibly wrapped (BENCH_extra.json carries it under
@@ -285,8 +317,11 @@ def _print(doc, args, out=None):
     if isinstance(doc, dict) and "telemetry" in doc \
             and isinstance(doc["telemetry"], dict):
         doc = doc["telemetry"]
-    if args.serving:
-        print_serving(doc, out)
+    if args.serving or args.fleet:
+        if args.serving:
+            print_serving(doc, out)
+        if args.fleet:
+            print_fleet(doc, out)
         return
     print_snapshot(doc, 0, out)
 
@@ -309,6 +344,11 @@ def main(argv=None):
                          "histograms tabulated next to the prefix-"
                          "cache/chunked-prefill stats (snapshots), or "
                          "serving.* spans only (traces)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet-router view: failover/drain/migration "
+                         "and channel counters (fleet.* — "
+                         "doc/fault_tolerance.md 'Fleet resilience'); "
+                         "composes with --serving")
     ap.add_argument("--watch", type=float, default=None, metavar="SEC",
                     help="re-read and re-print the source every SEC "
                          "seconds until interrupted")

@@ -37,6 +37,12 @@ Usage::
     python tools/replay_serving.py CAPTURE.jsonl \
         --checkpoint ckpt/lm --epoch 3 --timing max
 
+    # the rolling-restart drill: replay through a 2-replica fleet,
+    # drain-and-replace each replica mid-replay, byte-verify
+    python tools/replay_serving.py CAPTURE.jsonl \
+        --checkpoint ckpt/lm --epoch 3 --verify \
+        --replicas 2 --rolling-restart
+
 ``--timing recorded`` (default) re-paces submissions at the captured
 inter-arrival gaps — the day-in-the-life read: same burstiness, so
 TTFT/cadence compare directly against the ``recorded`` block in the
@@ -71,8 +77,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from mxnet_tpu.serving.capture import load_capture  # noqa: E402
 
-# capture-header keys that are NOT InferenceEngine constructor kwargs
-_NON_CTOR_KEYS = ("max_len", "capture_dir")
+# capture-header keys that must NOT feed the replay engine's
+# constructor: max_len belongs to the Decoder, capture_dir would
+# re-capture, and engine_id/migrated_from are the CAPTURED run's
+# identity/provenance — replay engines get fresh ids (a fleet replay
+# builds N engines from one header; cloned ids would collide)
+_NON_CTOR_KEYS = ("max_len", "capture_dir", "engine_id",
+                  "migrated_from")
 
 
 def build_engine(cap, decoder, **overrides):
@@ -112,8 +123,32 @@ def recorded_latency(cap):
     return _latency_summary(ttft, cadence)
 
 
+def rolling_restart(router, cap, mkreplica):
+    """An ``on_round`` hook that drains-and-replaces every replica of
+    ``router`` in turn while the capture replays: replica ``k`` is
+    drained (in-flight requests migrate live to its peers) once
+    ``(k+1)/(N+1)`` of the captured submits are in, and a fresh
+    ``mkreplica()`` successor joins the rotation — the
+    zero-failed-request rolling-restart drill. Byte-identity under
+    ``--verify`` is the acceptance bar: migration must not change a
+    single token."""
+    total = max(1, len(cap["submits"]))
+    rids = router.replica_ids(live_only=True)
+    milestones = [(k + 1) * total // (len(rids) + 1)
+                  for k in range(len(rids))]
+    state = {"next": 0}
+
+    def on_round(submitted, _engine):
+        k = state["next"]
+        if k < len(milestones) and submitted >= max(1, milestones[k]):
+            state["next"] += 1
+            router.drain(rids[k])
+            router.add_replica(mkreplica())
+    return on_round
+
+
 def replay(cap, engine, timing="recorded", verify=False,
-           verify_mode="auto"):
+           verify_mode="auto", on_round=None):
     """Replay a loaded capture on ``engine``; returns the report dict.
 
     ``timing="recorded"`` paces submissions at the captured arrival
@@ -136,7 +171,14 @@ def replay(cap, engine, timing="recorded", verify=False,
     host-side fails rather than passing vacuously on the shorter
     common prefix). ``"auto"`` (default) picks ``"prefix"`` exactly
     when the engine's ``weight_dtype`` differs from the capture
-    header's, else ``"exact"``."""
+    header's, else ``"exact"``.
+
+    ``engine`` may be a :class:`~mxnet_tpu.serving.FleetRouter` (it
+    mirrors the driving surface) — a capture replays through a whole
+    fleet unchanged. ``on_round(submitted, engine)`` is called once
+    per drive-loop iteration with the number of submits admitted so
+    far: the hook point for mid-replay operations like
+    :func:`rolling_restart`."""
     if timing not in ("recorded", "max"):
         raise ValueError("timing must be 'recorded' or 'max', got %r"
                          % (timing,))
@@ -177,6 +219,8 @@ def replay(cap, engine, timing="recorded", verify=False,
             handles.append((rec, req))
             i += 1
         engine.step()
+        if on_round is not None:
+            on_round(i, engine)
     dt = time.perf_counter() - t0
 
     toks = sum(len(h.tokens) - h.resumed for _, h in handles)
@@ -288,6 +332,19 @@ def main(argv=None):
                     choices=("auto", "exact", "prefix"),
                     help="--verify comparison mode (default auto: "
                          "exact unless the weight dtype changed)")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="replay through a FleetRouter over N replica "
+                         "engines of the captured geometry instead of "
+                         "one engine (doc/fault_tolerance.md 'Fleet "
+                         "resilience'); health-driven + prefix-"
+                         "affinity placement decides where each "
+                         "captured request lands")
+    ap.add_argument("--rolling-restart", action="store_true",
+                    help="with --replicas: drain and replace every "
+                         "replica in turn mid-replay (in-flight "
+                         "requests migrate live to peers) — the "
+                         "zero-failed-request restart drill; combine "
+                         "with --verify for the byte-identity bar")
     ap.add_argument("--compute-dtype", default=None,
                     help="decoder compute dtype (e.g. bfloat16)")
     args = ap.parse_args(argv)
@@ -305,8 +362,10 @@ def main(argv=None):
     deckw = {"cache_block": None, "weight_dtype": "float"}
     if args.compute_dtype:
         deckw["compute_dtype"] = args.compute_dtype
-    dec = Decoder.from_checkpoint(args.checkpoint, args.epoch, max_len,
-                                  **deckw)
+
+    def mkdec():
+        return Decoder.from_checkpoint(args.checkpoint, args.epoch,
+                                       max_len, **deckw)
     overrides = {k: v for k, v in (
         ("slots", args.slots),
         ("steps_per_round", args.steps_per_round),
@@ -318,10 +377,26 @@ def main(argv=None):
         ("tp", args.tp),
         ("weight_dtype", args.weight_dtype),
     ) if v is not None}
-    engine = build_engine(cap, dec, **overrides)
+    on_round = None
+    if args.replicas:
+        from mxnet_tpu.serving import FleetRouter
+
+        engine = FleetRouter([build_engine(cap, mkdec(), **overrides)
+                              for _ in range(args.replicas)])
+        if args.rolling_restart:
+            on_round = rolling_restart(
+                engine, cap,
+                lambda: build_engine(cap, mkdec(), **overrides))
+    elif args.rolling_restart:
+        ap.error("--rolling-restart needs --replicas")
+    else:
+        engine = build_engine(cap, mkdec(), **overrides)
     report = replay(cap, engine, timing=args.timing,
-                    verify=args.verify, verify_mode=args.verify_mode)
+                    verify=args.verify, verify_mode=args.verify_mode,
+                    on_round=on_round)
     report["overrides"] = overrides
+    if args.replicas:
+        report["fleet"] = dict(engine.stats)
     print(json.dumps(report, sort_keys=True))
     if args.verify and report["mismatches"]:
         print("REPLAY VERIFY FAILED: %d mismatch(es)"
