@@ -51,10 +51,17 @@ benchdiff:
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_faults.py tests/test_fleet.py -q -m "slow or not slow"
 
+# Pallas kernel tests standalone, interpret mode on CPU (doc/serving.md
+# "Fused quantized kernels"): the paged-attention kernel suite plus the
+# quantized-matmul / fused-decode kernel suite, without the rest of
+# tier-1. Fast inner loop when hacking on ops/pallas_kernels.py.
+kernels:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_pallas.py tests/test_pallas_quant.py -q
+
 lint:
 	python -m compileall -q mxnet_tpu tools example
 
 clean:
 	$(MAKE) -C cpp clean
 
-.PHONY: all native examples test manifest check bench benchdiff chaos lint clean
+.PHONY: all native examples test manifest check bench benchdiff chaos kernels lint clean

@@ -265,6 +265,8 @@ def bench_decode(prompt=64, layers=12, embed=768,
     from mxnet_tpu.models import get_transformer_lm
     from mxnet_tpu.parallel import Decoder
 
+    if wall_reps is None:
+        wall_reps = 3 if jax.default_backend() == "tpu" else 0
     sym = get_transformer_lm(vocab, num_layers=layers, embed_dim=embed,
                              num_heads=heads, impl="flash")
     rng = np.random.RandomState(0)
@@ -362,7 +364,7 @@ def bench_decode(prompt=64, layers=12, embed=768,
 def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
                   max_len=1024, n_requests=96, seed=0, arrival_ms=1.0,
                   attn_impl="dense", cache_dtype=None,
-                  weight_dtype=None):
+                  weight_dtype=None, matmul_impl=None):
     """Continuous-batching serving engine (mxnet_tpu/serving/) under
     SATURATING load: Poisson arrivals far above service capacity (the
     queue never empties), mixed prompt lengths across the bucket set
@@ -464,7 +466,8 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
                              max_queue=4 * slots, steps_per_round=8,
                              prefix_cache_mb=0, prefill_chunk=0,
                              attn_impl=attn_impl,
-                             weight_dtype=weight_dtype)
+                             weight_dtype=weight_dtype,
+                             matmul_impl=matmul_impl)
     # warmup compiles BOTH program families for every bucket up front
     # (one prompt per bucket), so the timed run measures execution only
     wrs = np.random.RandomState(seed + 1)
@@ -500,6 +503,7 @@ def bench_serving(slots=32, layers=12, embed=768, heads=12, vocab=32000,
         "cache_dtype": cache_dtype or "bf16",
         "weight_dtype": engine.weight_dtype,
         "weight_bytes": engine.weight_bytes,
+        "matmul_impl": engine.matmul_impl,
         "decode_bytes_accessed": prog.get("bytes_accessed"),
         "decode_flops": prog.get("flops"),
     }
@@ -606,7 +610,8 @@ def bench_serving_tp(tp=1, slots=16, layers=12, embed=768, heads=12,
 def bench_serving_quant_bytes(layers=12, embed=768, heads=12,
                               vocab=32000, max_len=1024, slots=32,
                               steps_per_round=8, attn_impl="paged",
-                              cache_dtype=None, hbm_gb=16.0):
+                              cache_dtype=None, hbm_gb=16.0,
+                              wall_reps=None):
     """Decode-bytes probe at the SERVING-BATCH geometry (ISSUE 15's
     headline config — the 124M LM, the PR 11 premise that the KV side
     is already cut by paged reads): lower the fp and int8-weight
@@ -634,13 +639,52 @@ def bench_serving_quant_bytes(layers=12, embed=768, heads=12,
     Also derives ``slots_at_hbm``: (hbm - weight bytes) / KV bytes
     per slot — the max-resident-slots read at a fixed HBM budget (the
     slots-per-chip lever the ROADMAP names; the weight cut frees HBM
-    that converts to resident slots at any model scale)."""
+    that converts to resident slots at any model scale).
+
+    PR 17 widens the arm set beyond the fp/int8-fori pair: the int8
+    Pallas ``quant_matmul`` arm (dequant-in-VMEM, no chunk-loop HLO),
+    the int4 arm (packed nibbles + per-group scales) and the int4
+    fused-decode arm (QKV->attention->out-proj in ONE kernel dispatch
+    per layer). Three byte columns per arm, because they answer
+    different questions:
+
+    * ``weight_stream_bytes`` / ``weight_stream_ratio_*``: the
+      ANALYTIC stored bytes one greedy decode step actually streams —
+      every matmul weight at its stored width (bf16 for fp, int8 +
+      per-channel f32 scales, packed nibbles + per-group scales) plus
+      only the GATHERED embedding rows (the table itself is never
+      read by a decode step). This is the headline: it is exact,
+      impl-invariant by the bitwise contract (``pallas`` walks the
+      same stored stream as ``dense``, staging bounded in VMEM), and
+      it is what HBM serves on hardware. int4 lands at ~0.27x fp
+      (0.5 nibble + group-scale overhead vs. 2-byte bf16), int8 at
+      ~0.51x — the ISSUE 17 / ISSUE 15 numbers.
+    * ``forward_bytes`` / ``program_bytes``: the XLA static cost
+      model of the lowered HLO, kept for continuity with the PR 15
+      column. On the quantized arms it is NOT comparable across
+      impls: the cost model caps ``fori_loop`` trip counts (it
+      under-counts the dense arms' weight stream at high chunk
+      counts) and, on the kernel arms, the CPU interpreter's HLO
+      materializes full-size dequant/unpack temporaries that live in
+      VMEM on hardware (it over-counts, the PR 11 static-model caveat
+      family). Read the stream column for cross-impl claims.
+
+    Each arm also reports ``wall_ms`` — the median wall clock of the
+    compiled decode forward (``wall_reps`` timed runs; default:
+    skipped off-TPU, where the interpreter executes every grid step
+    and a 124M compile takes tens of minutes — pass ``wall_reps=3``
+    to force) — and ``decode_dispatches``, the Pallas kernel-dispatch
+    count traced into one decode forward (the fused arm's cut is the
+    ``serving_fused_decode_dispatches`` headline)."""
     import jax
     import jax.numpy as jnp
     from mxnet_tpu.models import get_transformer_lm
+    from mxnet_tpu.ops import pallas_kernels as pk
     from mxnet_tpu.parallel import Decoder
     from mxnet_tpu.serving import InferenceEngine
 
+    if wall_reps is None:
+        wall_reps = 3 if jax.default_backend() == "tpu" else 0
     sym = get_transformer_lm(vocab, num_layers=layers, embed_dim=embed,
                              num_heads=heads, impl="flash")
     rng = np.random.RandomState(0)
@@ -657,6 +701,24 @@ def bench_serving_quant_bytes(layers=12, embed=768, heads=12,
             c = c[0]
         return c.get("bytes accessed")
 
+    def weight_stream(eng):
+        """Analytic stored bytes one greedy decode step streams:
+        every matmul weight at stored width; embedding tables
+        contribute only the ``slots`` gathered rows (one token per
+        slot per step)."""
+        from mxnet_tpu.serving.quant import QuantizedTensor
+        gather = dec._embedding_weight_names()
+        total = 0
+        for n, v in eng._params.items():
+            leaves = ((v.q, v.scale) if isinstance(v, QuantizedTensor)
+                      else jax.tree_util.tree_leaves(v))
+            nbytes = sum(x.nbytes for x in leaves)
+            if n in gather:
+                rows = max(x.shape[0] for x in leaves)
+                nbytes = slots * (nbytes // rows)
+            total += nbytes
+        return total
+
     out = {"config": {"layers": layers, "embed": embed, "vocab": vocab,
                       "max_len": max_len, "slots": slots,
                       "attn_impl": attn_impl,
@@ -670,36 +732,73 @@ def bench_serving_quant_bytes(layers=12, embed=768, heads=12,
                   cache_dtype=cache_dtype, weight_dtype="float")
     buckets = tuple(b for b in (64, 128, 256) if b <= max_len) \
         or (max_len,)
-    for wd in ("float", "int8"):
+    arms = (("fp", "float", "dense"),
+            ("int8", "int8", "dense"),
+            ("int8_pallas", "int8", "pallas"),
+            ("int4", "int4", "pallas"),
+            ("int4_fused", "int4", "fused"))
+    for key, wd, mi in arms:
         eng = InferenceEngine(
             dec, slots=slots, prefill_buckets=buckets,
             max_queue=4 * slots, steps_per_round=steps_per_round,
             prefix_cache_mb=0, prefill_chunk=0, attn_impl=attn_impl,
-            weight_dtype=wd)
+            weight_dtype=wd, matmul_impl=mi)
         prog = jax.jit(eng._make_step()).lower(
             eng._params, eng._aux, eng._caches, eng._state)
         pos = jnp.zeros((slots,), jnp.int32)
         toks = jnp.zeros((slots, 1), jnp.int32)
+        # dispatch count is bumped at TRACE time in every Pallas
+        # kernel entry, so one lowering of the single-step forward
+        # counts the kernel dispatches a greedy round issues
+        pk.reset_dispatch_count()
         fwd = jax.jit(
-            lambda p, a, c, po, t: dec._run_slots(
-                p, a, c, po, t, impl=attn_impl)).lower(
+            lambda p, a, c, po, t, _mi=mi: dec._run_slots(
+                p, a, c, po, t, impl=attn_impl, mm_impl=_mi)).lower(
             eng._params, eng._aux, eng._caches, pos, toks)
+        dispatches = pk.dispatch_count()
         kv_bytes = sum(x.nbytes for x in
                        jax.tree_util.tree_leaves(eng._caches))
-        key = "fp" if wd == "float" else "int8"
+        # wall clock of the compiled single-step forward: warm once,
+        # report the median of wall_reps timed runs
+        wall = None
+        if wall_reps:
+            run = fwd.compile()
+            args = (eng._params, eng._aux, eng._caches, pos, toks)
+            jax.block_until_ready(run(*args))
+            ts = []
+            for _ in range(wall_reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(run(*args))
+                ts.append(time.perf_counter() - t0)
+            wall = round(sorted(ts)[len(ts) // 2] * 1e3, 1)
         out[key] = {
             "program_bytes": cost(prog),
             "forward_bytes": cost(fwd),
+            "weight_stream_bytes": weight_stream(eng),
             "weight_bytes": eng.weight_bytes,
             "kv_bytes_per_slot": kv_bytes // slots,
             "slots_at_hbm": int((hbm_gb * 1e9 - eng.weight_bytes)
                                 // (kv_bytes / slots)),
+            "decode_dispatches": dispatches,
+            "wall_ms": wall,
         }
     for k in ("program", "forward"):
         f, q = out["fp"][k + "_bytes"], out["int8"][k + "_bytes"]
         out[k + "_ratio"] = None if not f or not q else round(q / f, 3)
+    fp_fwd = out["fp"]["forward_bytes"]
+    for key in ("int8_pallas", "int4", "int4_fused"):
+        q = out[key]["forward_bytes"]
+        out["forward_ratio_%s" % key] = \
+            None if not fp_fwd or not q else round(q / fp_fwd, 3)
+    fp_stream = out["fp"]["weight_stream_bytes"]
+    for key in ("int8", "int8_pallas", "int4", "int4_fused"):
+        out["weight_stream_ratio_%s" % key] = round(
+            out[key]["weight_stream_bytes"] / fp_stream, 3)
     out["weight_bytes_ratio"] = round(
         out["int8"]["weight_bytes"] / out["fp"]["weight_bytes"], 3)
+    out["weight_bytes_ratio_int4"] = round(
+        out["int4"]["weight_bytes"] / out["fp"]["weight_bytes"], 3)
+    out["fused_decode_dispatches"] = out["int4_fused"]["decode_dispatches"]
     return out
 
 
@@ -2029,9 +2128,22 @@ def main():
                     "HBM; on the CPU box the chunked dequant loop "
                     "serializes work the chip overlaps, so the bytes "
                     "cut is the honest CPU metric and wall clock the "
-                    "TPU lever (PR 11/14 precedent); "
-                    "tools/bench_serving.py --weight-dtypes sweeps "
-                    "this axis",
+                    "TPU lever (PR 11/14 precedent); PR 17 arms: "
+                    "int8_pallas/int4 = the quant_matmul kernel "
+                    "(dequant-in-VMEM, int4 = packed nibbles + "
+                    "per-group scales), int4_fused = the one-dispatch "
+                    "QKV->attention->out-proj decode kernel, each "
+                    "with wall_ms and traced decode_dispatches; "
+                    "tools/bench_serving.py --weight-dtypes / "
+                    "--matmul-impls sweep these axes; "
+                    "weight_stream_ratio_* = the analytic stored "
+                    "bytes a decode step streams (matmul weights at "
+                    "stored width + gathered embedding rows only) — "
+                    "exact and impl-invariant where the static HLO "
+                    "cost model caps fori trip counts and counts the "
+                    "interpreter's VMEM-resident dequant temporaries, "
+                    "so it is the cross-impl headline (int4 ~0.27x, "
+                    "int8 ~0.51x)",
         }
     except Exception:
         traceback.print_exc()
@@ -2325,6 +2437,14 @@ def main():
             "serving_quant_tokens_per_sec":
                 None if serving_quant is None
                 else serving_quant["int8"]["tokens_per_sec"],
+            "serving_int4_bytes_ratio":
+                None if serving_quant is None
+                else (serving_quant.get("serving_batch_probe")
+                      or {}).get("weight_stream_ratio_int4"),
+            "serving_fused_decode_dispatches":
+                None if serving_quant is None
+                else (serving_quant.get("serving_batch_probe")
+                      or {}).get("fused_decode_dispatches"),
             "serving_tp2_bytes_ratio":
                 None if serving_tp is None
                 else serving_tp.get("bytes_per_shard_ratio_tp2"),

@@ -129,18 +129,39 @@ class MoEFFN(OpSpec):
         return [moe_ffn_math(p, ins)], []
 
 
-def moe_ffn_math(p, ins, gate_mm=None, up_mm=None, down_mm=None):
+def moe_ffn_math(p, ins, gate_mm=None, up_mm=None, down_mm=None,
+                 ep=None):
     """The ONE MoE routing + combine implementation, parameterized
     over its three matmuls (``None`` = the plain einsums). The
     serving engine's weight-quantized path (``serving/quant.py``)
     passes scale-fused forms for whichever weights are quantized —
     sharing this function is what keeps quantized MoE routing from
-    silently diverging from the fp op it is tested against."""
+    silently diverging from the fp op it is tested against.
+
+    ``ep=(axis_name, degree)`` runs the SAME math expert-parallel
+    inside a ``shard_map``: every expert-stacked input (gate rows,
+    w1/b1/w2/b2) arrives sharded on its leading expert axis, so this
+    shard computes its local experts only. Routing needs the FULL
+    gate row — local logits are all-gathered over the expert axis
+    before top-k/softmax (tiny: one f32 per expert per token) — and
+    the weighted combine ends in one ``psum``: each token's output is
+    a sum over experts, partitioned across shards. The psum
+    reassociates the float sum, so ep>1 is token-stable rather than
+    bitwise vs ep=1 (the PR 14 all-gather precedent is the same
+    contract family)."""
     x, gate_w, w1, b1, w2, b2 = ins
     logits = gate_mm(x, gate_w) if gate_mm is not None \
         else jnp.einsum("bte,xe->btx", x, gate_w)
     k = int(p["top_k"])
     nx = int(p["num_experts"])
+    nloc = gate_w.shape[0] if hasattr(gate_w, "shape") else nx
+    if ep is not None:
+        ax, nep = ep
+        if nep > 1:
+            # full gate row for routing; this shard's slice of the
+            # renormalized gates comes back out below
+            logits = jax.lax.all_gather(logits, ax, axis=-1,
+                                        tiled=True)
     if k > 0:
         if k >= nx:
             raise MXNetError(
@@ -159,12 +180,20 @@ def moe_ffn_math(p, ins, gate_mm=None, up_mm=None, down_mm=None):
         logits = jnp.where(mask, logits,
                            jnp.float32(-1e30).astype(logits.dtype))
     gates = jax.nn.softmax(logits, axis=-1)
+    if ep is not None and ep[1] > 1:
+        # this shard's slice of the (globally renormalized) gates
+        i = jax.lax.axis_index(ep[0])
+        gates = jax.lax.dynamic_slice_in_dim(
+            gates, i * nloc, nloc, axis=-1)
     up = up_mm(x, w1) if up_mm is not None \
         else jnp.einsum("bte,xhe->btxh", x, w1)
     h = jax.nn.relu(up + b1[None, None])
     y = (down_mm(h, w2) if down_mm is not None
          else jnp.einsum("btxh,xeh->btxe", h, w2)) + b2[None, None]
-    return jnp.einsum("btxe,btx->bte", y, gates)
+    out = jnp.einsum("btxe,btx->bte", y, gates)
+    if ep is not None and ep[1] > 1:
+        out = jax.lax.psum(out, ep[0])
+    return out
 
 
 def rope_rotate(x, positions, base=10000.0):

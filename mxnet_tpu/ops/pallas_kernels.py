@@ -31,11 +31,39 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention", "fused_linear", "striped_pair_attention",
-           "matmul_stats", "paged_attention", "default_paged_block_k"]
+           "matmul_stats", "paged_attention", "default_paged_block_k",
+           "quant_matmul", "fused_decode_attention", "dispatch_count",
+           "reset_dispatch_count"]
 
 
 def _use_interpret():
     return jax.default_backend() != "tpu"
+
+
+# Trace-time kernel-dispatch accounting: every public kernel entry
+# bumps this when it STAGES a pallas_call (i.e. once per appearance in
+# a traced program — each appearance is one device dispatch per
+# execution of that program). bench.py's serving probes read it around
+# a decode-program trace to report dispatches-per-round, the headline
+# the fused decode chain exists to cut (HLO-level counting cannot see
+# kernels under the CPU interpreter, which inlines them).
+_DISPATCHES = 0
+
+
+def _count_dispatch(n=1):
+    global _DISPATCHES
+    _DISPATCHES += n
+
+
+def dispatch_count():
+    """Pallas kernel dispatches staged since the last
+    :func:`reset_dispatch_count` (trace-time count; see above)."""
+    return _DISPATCHES
+
+
+def reset_dispatch_count():
+    global _DISPATCHES
+    _DISPATCHES = 0
 
 
 def _round_up(x, m):
@@ -367,6 +395,7 @@ def flash_attention(q, k, v, *, causal=False, scale=None, block_q=None,
         block_k = dk
     if interpret is None:
         interpret = _use_interpret()
+    _count_dispatch()
     b, tq, h, d = q.shape
     tk = k.shape[1]
     if scale is None:
@@ -648,6 +677,7 @@ def striped_pair_attention(q, k, v, q_off, k_off, *, n_stride, scale=None,
     """
     if interpret is None:
         interpret = _use_interpret()
+    _count_dispatch()
     bh, tq, d = q.shape
     tk = k.shape[1]
     if scale is None:
@@ -792,6 +822,7 @@ def fused_linear(x, w, b, act="linear", *, block_m=256, block_n=256,
     """
     if interpret is None:
         interpret = _use_interpret()
+    _count_dispatch()
     if act not in _ACTS:
         raise ValueError("unknown activation %r" % act)
     if act == "gelu":
@@ -818,6 +849,7 @@ def fused_conv_bn_act(x, w, scale, bias, stride=(1, 1), pad=(0, 0),
     """
     if interpret is None:
         interpret = _use_interpret()
+    _count_dispatch()
     n, c, h, wdim = x.shape
     nf, _, kh, kw = w.shape
     patches = lax.conv_general_dilated_patches(
@@ -946,6 +978,7 @@ def matmul_stats(x, w, *, block_m=256, block_n=256, block_k=512,
     backward is the usual two MXU dots."""
     if interpret is None:
         interpret = _use_interpret()
+    _count_dispatch()
     return _matmul_stats_core(x, w, block_m, block_n, block_k, interpret)
 
 
@@ -1112,6 +1145,7 @@ def paged_attention(q, k, v, pos, *, k_scale=None, v_scale=None,
     smoke metrics."""
     if interpret is None:
         interpret = _use_interpret()
+    _count_dispatch()
     s_, c, h, d = q.shape
     l_ = k.shape[1]
     kv = k.shape[2]
@@ -1185,3 +1219,273 @@ def paged_attention(q, k, v, pos, *, k_scale=None, v_scale=None,
     )(pos, *operands)
     return out.reshape(s_, kv, g, c, d).reshape(s_, h, c, d) \
         .transpose(0, 2, 1, 3)
+
+
+# -- fused quantized matmuls (ISSUE 17) -------------------------------
+
+def _unpack4_block(u):
+    """Unpack a [rows, E/2] uint8 nibble-packed block to f32
+    [rows, E]: low nibble = even element, high nibble = odd,
+    sign-extended two's complement — the in-VMEM mirror of
+    serving.quant.unpack_int4 (kept bitwise in step with it: the
+    pallas-vs-fori identity tests pin the pair)."""
+    lo = (u & 0xF).astype(jnp.int32)
+    hi = ((u >> 4) & 0xF).astype(jnp.int32)
+    both = jnp.stack([lo, hi], axis=-1).reshape(
+        u.shape[:-1] + (2 * u.shape[-1],))
+    return (both - 16 * (both >= 8)).astype(jnp.float32)
+
+
+def _dequant_w(w_ref, s_ref, bits, group):
+    """Dequantize one weight tile in VMEM. int4: unpack + per-group
+    contraction-axis scales (must precede the dot). int8: raw cast —
+    the per-row scale folds into the OUTPUT (callers multiply the
+    accumulator by ``s^T`` instead, exactly like the fori fallback)."""
+    if bits == 4:
+        v = _unpack4_block(w_ref[...])
+        return v * jnp.repeat(s_ref[...], group, axis=-1)
+    return w_ref[...].astype(jnp.float32)
+
+
+def _quant_mm_kernel(x_ref, w_ref, s_ref, o_ref, *, bits, group):
+    w = _dequant_w(w_ref, s_ref, bits, group)
+    acc = lax.dot_general(x_ref[...], w, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    if bits == 8:
+        acc = acc * jnp.transpose(s_ref[...])
+    o_ref[...] = acc.astype(o_ref.dtype)
+
+
+def quant_matmul(x, q, scale, *, bits=8, group=None, block_f=None,
+                 out_dtype=None, interpret=None):
+    """``x [M, E] @ dequant(q) [F, E]^T -> [M, F]``: the Pallas
+    scale-fused matmul for quantized serving weights.
+
+    The grid walks OUTPUT-CHANNEL blocks only — each step streams one
+    ``[block_f, E]`` quantized tile into VMEM, dequantizes it there
+    (int8: cast, scale folded into the product after the dot; int4:
+    unpack nibbles + per-group contraction scales before the dot) and
+    contracts the full E axis. Blocking over output channels is a
+    PARTITION of independent dots, never a reassociation — on f32
+    inputs the result is bitwise identical to
+    ``serving.quant.scale_fused_matmul``'s ``fori_loop`` at any block
+    size, which is what lets ``matmul_impl="pallas"`` keep the
+    engine's byte-identity gauntlet intact. The compiled program
+    reads the stored int8/packed-int4 stream plus one tile of float
+    staging (the ``bytes_accessed`` story, now at kernel granularity).
+
+    ``q``: int8 ``[F, E]`` (``bits=8``, ``scale`` f32 ``[F]``) or
+    nibble-packed uint8 ``[F, E//2]`` (``bits=4``, ``scale`` f32
+    ``[F, E//group]``). ``block_f`` must divide F (callers pass the
+    ``MXNET_QUANT_CHUNK``-resolved chunk so both impls stage
+    identically); default: largest of (256..8) dividing F, else F.
+    On CPU the kernel runs under the Pallas interpreter (tests)."""
+    if interpret is None:
+        interpret = _use_interpret()
+    _count_dispatch()
+    m, e = x.shape
+    f = q.shape[0]
+    ew = q.shape[1]
+    if bits == 4:
+        if group is None or (2 * ew) % group:
+            raise ValueError(
+                "quant_matmul: bits=4 needs the per-group scale width "
+                "(an even divisor of E=%d), got group=%r"
+                % (2 * ew, group))
+        s2 = scale
+    else:
+        s2 = scale.reshape(f, 1)
+    if block_f is None:
+        for r in (256, 128, 64, 32, 16, 8):
+            if f % r == 0:
+                block_f = r
+                break
+        else:
+            block_f = f
+    block_f = min(block_f, f)
+    if f % block_f:
+        raise ValueError(
+            "quant_matmul: block_f=%d must divide the output-channel "
+            "count %d (the grid partitions whole blocks)"
+            % (block_f, f))
+    mp = m if interpret else _round_up(m, 8)
+    xp = x if mp == m else jnp.pad(x, ((0, mp - m), (0, 0)))
+    sw = s2.shape[1]
+    bf = block_f
+    out = pl.pallas_call(
+        functools.partial(_quant_mm_kernel, bits=bits, group=group),
+        out_shape=jax.ShapeDtypeStruct(
+            (mp, f), jnp.dtype(out_dtype) if out_dtype else x.dtype),
+        grid=(int(f // bf),),
+        in_specs=[
+            pl.BlockSpec((mp, e), lambda i: (np.int32(0), np.int32(0))),
+            pl.BlockSpec((bf, ew), lambda i: (i, np.int32(0))),
+            pl.BlockSpec((bf, sw), lambda i: (i, np.int32(0))),
+        ],
+        out_specs=pl.BlockSpec((mp, bf), lambda i: (np.int32(0), i)),
+        interpret=interpret,
+    )(xp, q, s2)
+    return out[:m]
+
+
+def _fused_decode_kernel(pos_ref, x_ref, k_ref, v_ref, wq_ref, sq_ref,
+                         bq_ref, wo_ref, so_ref, bo_ref, cs_ref,
+                         sn_ref, o_ref, kn_ref, vn_ref, *, heads,
+                         kv_heads, head_dim, max_len, bits, group,
+                         scale):
+    s = pl.program_id(0)
+    p = pos_ref[s]
+    e = x_ref.shape[1]
+    kv, d, g = kv_heads, head_dim, heads // kv_heads
+    xv = x_ref[...]                                    # [1, E]
+    # QKV projection, dequantized in VMEM
+    wq = _dequant_w(wq_ref, sq_ref, bits, group)
+    qkv = lax.dot_general(xv, wq, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    if bits == 8:
+        qkv = qkv * jnp.transpose(sq_ref[...])
+    qkv = qkv + bq_ref[...]
+    qh = qkv[0, :e].reshape(heads, d)
+    kh = qkv[0, e:e + kv * d].reshape(kv, d)
+    vh = qkv[0, e + kv * d:e + 2 * kv * d].reshape(kv, d)
+    # rope (half-split form), angles precomputed host-side per slot
+    cos, sin = cs_ref[...], sn_ref[...]                # [1, d/2]
+    half = d // 2
+
+    def rot(t):
+        t1, t2 = t[..., :half], t[..., half:]
+        return jnp.concatenate([t1 * cos - t2 * sin,
+                                t2 * cos + t1 * sin], -1)
+
+    qh, kh = rot(qh), rot(kh)
+    # attention: live cache rows [0, p) plus the current token's
+    # in-register (kh, vh) at position p — the cache write happens
+    # AFTER the kernel, equivalent to the dense path's write-then-read
+    qg = qh.reshape(kv, g, d)
+    ck = k_ref[...].reshape(max_len, kv, d).astype(jnp.float32)
+    cv = v_ref[...].reshape(max_len, kv, d).astype(jnp.float32)
+    s_cache = jnp.einsum("kgd,lkd->kgl", qg, ck) * scale
+    live = lax.broadcasted_iota(jnp.int32, (1, 1, max_len), 2) < p
+    s_cache = jnp.where(live, s_cache, -1e30)
+    s_new = jnp.einsum("kgd,kd->kg", qg, kh)[..., None] * scale
+    full = jnp.concatenate([s_cache, s_new], axis=-1)  # [kv, g, L+1]
+    mx = jnp.max(full, axis=-1, keepdims=True)
+    w = jnp.exp(full - mx)
+    denom = jnp.sum(w, axis=-1, keepdims=True)
+    o = jnp.einsum("kgl,lkd->kgd", w[..., :max_len], cv) \
+        + w[..., max_len:] * vh[:, None, :]
+    o = (o / denom).reshape(1, heads * d)
+    # output projection
+    wo = _dequant_w(wo_ref, so_ref, bits, group)
+    out = lax.dot_general(o, wo, (((1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    if bits == 8:
+        out = out * jnp.transpose(so_ref[...])
+    o_ref[...] = (out + bo_ref[...]).astype(o_ref.dtype)
+    kn_ref[...] = kh.reshape(1, kv, d).astype(kn_ref.dtype)
+    vn_ref[...] = vh.reshape(1, kv, d).astype(vn_ref.dtype)
+
+
+def fused_decode_attention(x, pos, k_cache, v_cache, wqkv, sqkv, bqkv,
+                           wo, so, bo, *, heads, kv_heads, bits=8,
+                           group=None, rope=True, rope_base=10000.0,
+                           scale=None, cache_dtype=None,
+                           interpret=None):
+    """The decode step's QKV-projection -> rope -> paged attention ->
+    out-projection chain as ONE kernel dispatch per round
+    (``matmul_impl="fused"``, paged path, chunk==1).
+
+    Per slot the kernel: dequantizes the QKV weight tile in VMEM and
+    projects the token, applies rotary embedding to q/k at the slot's
+    position, attends over the slot's LIVE cache rows plus the
+    current token's in-register k/v (so the cache scatter-write can
+    stay OUTSIDE — the returned ``(k_new, v_new)`` rows are written
+    after the kernel, which is read-equivalent to the dense path's
+    write-then-read), and runs the dequantized output projection. The
+    weight index maps ignore the slot grid index, so Mosaic keeps the
+    tiles resident across slots instead of re-fetching per grid step.
+
+    x: [S, E] current-token activations; pos: [S] int32;
+    k_cache/v_cache: [S, L, KV, D] float caches (int8 KV composes
+    with ``matmul_impl="pallas"`` instead — the fused path wants the
+    unquantized read). ``wqkv``/``wo`` + scales/biases as in
+    :func:`quant_matmul` (one ``bits`` for both). Returns
+    ``(out [S, E], k_new [S, KV, D], v_new [S, KV, D])`` with k_new
+    already roped. Numerics: plain (not streaming) softmax in f32
+    over L+1 scores — token-stable vs the unfused path, not bitwise
+    (different contraction blocking), which is why "fused" is its own
+    knob value rather than an automatic upgrade of "pallas"."""
+    if interpret is None:
+        interpret = _use_interpret()
+    _count_dispatch()
+    s_, e = x.shape
+    l_, kv, d = k_cache.shape[1], k_cache.shape[2], k_cache.shape[3]
+    fq = wqkv.shape[0]
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    half = d // 2
+    pos = jnp.asarray(pos, jnp.int32)
+    if rope:
+        freq = rope_base ** (-jnp.arange(half,
+                                         dtype=jnp.float32) / half)
+        ang = pos[:, None].astype(jnp.float32) * freq[None, :]
+        cs, sn = jnp.cos(ang), jnp.sin(ang)
+    else:
+        # identity rotation: cos=1/sin=0 make rot() exact pass-through
+        cs = jnp.ones((s_, half), jnp.float32)
+        sn = jnp.zeros((s_, half), jnp.float32)
+    if bits == 4:
+        sq2, so2 = sqkv, so
+    else:
+        sq2, so2 = sqkv.reshape(fq, 1), so.reshape(e, 1)
+    bq2 = bqkv.reshape(1, fq).astype(jnp.float32)
+    bo2 = bo.reshape(1, e).astype(jnp.float32)
+    cdt = jnp.dtype(cache_dtype) if cache_dtype else k_cache.dtype
+
+    def full(i, pref):
+        return (np.int32(0), np.int32(0))
+
+    def slot2(i, pref):
+        return (i, np.int32(0))
+
+    def slot4(i, pref):
+        return (i, np.int32(0), np.int32(0), np.int32(0))
+
+    def slot3(i, pref):
+        return (i, np.int32(0), np.int32(0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_,),
+        in_specs=[
+            pl.BlockSpec((1, e), slot2),                   # x
+            pl.BlockSpec((1, l_, kv, d), slot4),           # k cache
+            pl.BlockSpec((1, l_, kv, d), slot4),           # v cache
+            pl.BlockSpec((fq, wqkv.shape[1]), full),       # wqkv
+            pl.BlockSpec((fq, sq2.shape[1]), full),        # sqkv
+            pl.BlockSpec((1, fq), full),                   # bqkv
+            pl.BlockSpec((e, wo.shape[1]), full),          # wo
+            pl.BlockSpec((e, so2.shape[1]), full),         # so
+            pl.BlockSpec((1, e), full),                    # bo
+            pl.BlockSpec((1, half), slot2),                # cos
+            pl.BlockSpec((1, half), slot2),                # sin
+        ],
+        out_specs=[
+            pl.BlockSpec((1, e), slot2),
+            pl.BlockSpec((1, kv, d), slot3),
+            pl.BlockSpec((1, kv, d), slot3),
+        ],
+    )
+    out, kn, vn = pl.pallas_call(
+        functools.partial(_fused_decode_kernel, heads=heads,
+                          kv_heads=kv, head_dim=d, max_len=l_,
+                          bits=bits, group=group, scale=float(scale)),
+        out_shape=[
+            jax.ShapeDtypeStruct((s_, e), x.dtype),
+            jax.ShapeDtypeStruct((s_, kv, d), cdt),
+            jax.ShapeDtypeStruct((s_, kv, d), cdt),
+        ],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(pos, x, k_cache, v_cache, wqkv, sq2, bq2, wo, so2, bo2, cs, sn)
+    return out, kn, vn

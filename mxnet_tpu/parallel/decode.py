@@ -166,7 +166,8 @@ class Decoder:
 
     def __init__(self, symbol, params, max_len, aux_params=None,
                  compute_dtype=None, cache_block="auto",
-                 cache_dtype=None, attn_impl=None, weight_dtype=None):
+                 cache_dtype=None, attn_impl=None, weight_dtype=None,
+                 weight_group=None, matmul_impl=None):
         symbol = _logits_symbol(symbol)
         self._topo = symbol._topo()
         self._heads = symbol._heads
@@ -296,17 +297,44 @@ class Decoder:
         if weight_dtype is None:
             weight_dtype = os.environ.get(
                 "MXNET_SERVING_WEIGHT_DTYPE") or "float"
-        if weight_dtype not in ("float", "int8"):
+        if weight_dtype not in ("float", "int8", "int4"):
             raise MXNetError(
-                "Decoder: weight_dtype must be 'float' or 'int8', got "
-                "%r (MXNET_SERVING_WEIGHT_DTYPE sets the default)"
-                % (weight_dtype,))
+                "Decoder: weight_dtype must be 'float', 'int8' or "
+                "'int4', got %r (MXNET_SERVING_WEIGHT_DTYPE sets the "
+                "default)" % (weight_dtype,))
         self.weight_dtype = weight_dtype
-        if weight_dtype == "int8":
+        self.weight_group = weight_group
+        if matmul_impl is None:
+            matmul_impl = os.environ.get(
+                "MXNET_SERVING_MATMUL_IMPL") or "dense"
+        if matmul_impl not in ("dense", "pallas", "fused"):
+            raise MXNetError(
+                "Decoder: matmul_impl must be 'dense', 'pallas' or "
+                "'fused', got %r (MXNET_SERVING_MATMUL_IMPL sets the "
+                "default)" % (matmul_impl,))
+        self._matmul_impl = matmul_impl
+        if weight_dtype in ("int8", "int4"):
             from ..serving.quant import (quantize_params,
-                                         quantized_weight_names)
+                                         quantized_weight_names,
+                                         resolve_group)
+            bits = 8 if weight_dtype == "int8" else 4
+            row_quant = self._embedding_weight_names()
+            if bits == 4:
+                # resolve (and validate) the group width against the
+                # model's embedding dim ONCE, loudly, at build time
+                e_axis = None
+                for nn in self._topo:
+                    if not nn.is_var and nn.spec.name \
+                            == "MultiHeadAttention":
+                        wname = nn.inputs[1][0].name
+                        e_axis = self._params[wname].shape[-1]
+                        break
+                if e_axis is not None:
+                    self.weight_group = resolve_group(e_axis,
+                                                      weight_group)
             self._params = quantize_params(
-                self._params, quantized_weight_names(self._topo))
+                self._params, quantized_weight_names(self._topo),
+                bits=bits, group=weight_group, row_quant=row_quant)
 
         # params/aux pass as explicit jit arguments: closed-over
         # arrays would be baked into the HLO as literal constants
@@ -487,21 +515,90 @@ class Decoder:
             cv = lax.slice_in_dim(cv, 0, limit, axis=1)
         return ck, cv
 
-    def _cached_mha(self, node, ins, entry, pos, valid_len=None,
-                    tp=None):
+    def _embedding_weight_names(self):
+        """Parameter names consumed as Embedding tables — always
+        per-row int8 under quantization (``row_quant``): a
+        packed-nibble row gather would read-modify every byte for
+        half its bits (serving/quant.py ``embedding_rows``)."""
+        names = set()
+        for n in self._topo:
+            if not n.is_var and n.spec.name == "Embedding":
+                names.add(n.inputs[1][0].name)
+        return names
+
+    def _qmm(self, x, qt, impl):
+        """One quantized matmul ``x [..., E] @ qt [F, E]^T`` under the
+        decoder's ``matmul_impl``. ``"dense"`` (default) is the
+        chunked host-level ``fori_loop`` (``scale_fused_matmul``);
+        ``"pallas"``/``"fused"`` dispatch ``quant_matmul`` — the same
+        output-channel partition at the SAME chunk size
+        (``resolve_chunk``), so the two impls are bitwise identical
+        on f32 activations (pinned by the serving gauntlet)."""
+        from ..serving.quant import resolve_chunk, scale_fused_matmul
+        if impl in (None, "dense"):
+            return scale_fused_matmul(x, qt)
+        from ..ops.pallas_kernels import quant_matmul
+        f = qt.shape[0]
+        x2 = x.reshape(-1, x.shape[-1])
+        out = quant_matmul(x2, qt.q, qt.scale, bits=qt.bits,
+                           group=qt.group,
+                           block_f=resolve_chunk(f) or f,
+                           out_dtype=x.dtype)
+        return out.reshape(x.shape[:-1] + (f,))
+
+    def _fused_decode_mha(self, node, ins, entry, pos):
+        """``matmul_impl="fused"`` decode chain: QKV projection →
+        rope → attention over the live cache rows + the in-register
+        new token → output projection as ONE Pallas dispatch
+        (ops/pallas_kernels.py ``fused_decode_attention``). The
+        returned k/v rows are scattered into the cache AFTER the
+        kernel — read-equivalent to the unfused write-then-read.
+        Token-stable vs the unfused path, not bitwise (one plain-
+        softmax contraction instead of the paged kernel's streaming
+        blocks), which is why "fused" is its own knob value."""
         from ..ops.attention import MultiHeadAttention as _MHA
-        from ..serving.quant import QuantizedTensor, scale_fused_matmul
+        from ..ops.pallas_kernels import fused_decode_attention
+        x, wqkv, bqkv, wo, bo = ins
+        b, c, e = x.shape
+        h = node.params["num_heads"]
+        kv = _MHA.kv_heads(node.params)
+        posv = jnp.asarray(pos, jnp.int32) if jnp.ndim(pos) == 1 \
+            else jnp.full((b,), pos, jnp.int32)
+        out, kn, vn = fused_decode_attention(
+            x.reshape(b, e), posv, entry[0], entry[1],
+            wqkv.q, wqkv.scale, bqkv, wo.q, wo.scale, bo,
+            heads=h, kv_heads=kv, bits=wqkv.bits, group=wqkv.group,
+            rope=bool(node.params.get("rope")),
+            rope_base=float(node.params.get("rope_base") or 10000.0))
+        entry = self._write_cache(entry, kn[:, None], vn[:, None],
+                                  posv)
+        return out.reshape(b, 1, e), entry
+
+    def _cached_mha(self, node, ins, entry, pos, valid_len=None,
+                    tp=None, mm_impl=None):
+        from ..ops.attention import MultiHeadAttention as _MHA
+        from ..serving.quant import QuantizedTensor
 
         x, wqkv, bqkv, wo, bo = ins
         b, c, e = x.shape
         h = node.params["num_heads"]
         d = e // h
         kv = _MHA.kv_heads(node.params)
+        if (mm_impl == "fused" and c == 1 and tp is None
+                and len(entry) == 2
+                and not self._node_window(node)
+                and isinstance(wqkv, QuantizedTensor)
+                and isinstance(wo, QuantizedTensor)
+                and wqkv.bits == wo.bits and wqkv.group == wo.group
+                and (self._attn_impl == "paged"
+                     or jnp.ndim(pos) == 1)):
+            return self._fused_decode_mha(node, ins, entry, pos)
         if isinstance(wqkv, QuantizedTensor):
-            # weight-only int8: per-output-channel scales fold into
-            # the product (serving/quant.py) — the projection reads
-            # the stored int8 stream, no float weight copy
-            qkv = scale_fused_matmul(x, wqkv) + bqkv
+            # weight-only int8/int4: dequantized on the fly inside
+            # the product (serving/quant.py; matmul_impl picks the
+            # fori loop or the Pallas kernel) — the projection reads
+            # the stored quantized stream, no float weight copy
+            qkv = self._qmm(x, wqkv, mm_impl) + bqkv
         else:
             qkv = jnp.einsum("bte,fe->btf", x, wqkv) + bqkv
         q = qkv[..., :e].reshape(b, c, h, d)
@@ -525,7 +622,7 @@ class Decoder:
         def out_proj(o):
             o = o.reshape(b, c, e)
             if isinstance(wo, QuantizedTensor):
-                return scale_fused_matmul(o, wo) + bo
+                return self._qmm(o, wo, mm_impl) + bo
             return jnp.einsum("bte,fe->btf", o, wo) + bo
 
         if tp is not None:
@@ -789,7 +886,7 @@ class Decoder:
         return o.transpose(0, 2, 1, 3)             # [b,c,h,d]
 
     def _run(self, params, aux, caches, pos, tokens, valid_len=None,
-             tp=None):
+             tp=None, mm_impl=None, ep=None):
         """One chunk: tokens [B, C] at positions [pos, pos+C) →
         (logits [B, C, V], updated caches). ``valid_len`` marks a
         right-padded chunk's true length — only windowed ring WRITES
@@ -811,9 +908,12 @@ class Decoder:
         does) dequantize on the fly via the scale-fused forms
         below."""
         from ..serving.quant import (QuantizedTensor, embedding_rows,
-                                     moe_ffn_forward,
-                                     scale_fused_matmul)
+                                     moe_ffn_forward)
 
+        if mm_impl is None:
+            mm_impl = self._matmul_impl
+        qmm = None if mm_impl == "dense" \
+            else (lambda x, qt: self._qmm(x, qt, mm_impl))
         env = {}
         new_caches = list(caches)
         mha_i = 0
@@ -828,7 +928,8 @@ class Decoder:
             name = n.spec.name
             if name == "MultiHeadAttention":
                 out, new_caches[mha_i] = self._cached_mha(
-                    n, ins, new_caches[mha_i], pos, valid_len, tp)
+                    n, ins, new_caches[mha_i], pos, valid_len, tp,
+                    mm_impl=mm_impl)
                 mha_i += 1
                 env[(id(n), 0)] = out
                 continue
@@ -853,7 +954,7 @@ class Decoder:
                 xin = ins[0]
                 if n.params["flatten"]:
                     xin = xin.reshape(xin.shape[0], -1)
-                out = scale_fused_matmul(xin, ins[1])
+                out = self._qmm(xin, ins[1], mm_impl)
                 if not n.params["no_bias"]:
                     out = out + ins[2]
                 env[(id(n), 0)] = out
@@ -863,9 +964,10 @@ class Decoder:
                 idx = lax.stop_gradient(ins[0]).astype(jnp.int32)
                 env[(id(n), 0)] = embedding_rows(ins[1], idx)
                 continue
-            if name == "MoEFFN" and any(
-                    isinstance(z, QuantizedTensor) for z in ins[1:]):
-                env[(id(n), 0)] = moe_ffn_forward(n.params, ins)
+            if name == "MoEFFN" and (ep is not None or any(
+                    isinstance(z, QuantizedTensor) for z in ins[1:])):
+                env[(id(n), 0)] = moe_ffn_forward(n.params, ins,
+                                                  mm=qmm, ep=ep)
                 continue
             if name == "BatchNorm" and ins[0].ndim >= 3:
                 # BatchNorm normalizes axis 1, which for rank>=3 LM data
@@ -897,7 +999,7 @@ class Decoder:
     # included) with zero duplication.
 
     def _run_slots(self, params, aux, caches, pos, tokens, impl=None,
-                   tp=None):
+                   tp=None, mm_impl=None, ep=None):
         """Per-slot-position ``_run``: ``pos`` [S] int32 positions (one
         per cache slot), ``tokens`` [S, C] → (logits [S, C, V], updated
         caches).
@@ -938,14 +1040,14 @@ class Decoder:
         if impl == "paged":
             return self._run(params, aux, caches,
                              jnp.asarray(pos, jnp.int32), tokens,
-                             tp=tp)
+                             tp=tp, mm_impl=mm_impl, ep=ep)
 
         def one(slot_caches, p, t):
             # vmap hands each lane the slot's cache WITHOUT its leading
             # axis; _run wants b=1 buffers — re-add and strip it
             sub = jax.tree_util.tree_map(lambda c: c[None], slot_caches)
             logits, sub = self._run(params, aux, sub, p, t[None],
-                                    tp=tp)
+                                    tp=tp, mm_impl=mm_impl, ep=ep)
             return logits[0], jax.tree_util.tree_map(
                 lambda c: c[0], sub)
 
@@ -1027,7 +1129,8 @@ class Decoder:
         return jax.tree_util.tree_map(write, caches, rows)
 
     def verify_step_slots(self, params, aux, caches, state, drafts,
-                          dlen, impl=None, tp=None):
+                          dlen, impl=None, tp=None, mm_impl=None,
+                          ep=None):
         """Speculative draft-and-verify decode step over all S slots
         (the serving engine's verify program — doc/serving.md
         "Speculative decoding").
@@ -1069,8 +1172,9 @@ class Decoder:
         chunk = jnp.concatenate(
             [tok[:, None], drafts.astype(jnp.int32)], axis=1)
         logits, caches = self._run_slots(params, aux, caches, pos,
-                                         chunk, impl=impl,
-                                         tp=tp)             # [S,K+1,V]
+                                         chunk, impl=impl, tp=tp,
+                                         mm_impl=mm_impl,
+                                         ep=ep)             # [S,K+1,V]
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         def with_sampling(_):
@@ -1115,7 +1219,8 @@ class Decoder:
         return caches, state2, jnp.stack(outs)              # [K+1, S]
 
     def draft_propose_slots(self, params, aux, caches, pos, catchup,
-                            clen, k, impl=None, tp=None):
+                            clen, k, impl=None, tp=None, mm_impl=None,
+                            ep=None):
         """Greedy k-token proposal from a DRAFT model sharing the
         slot-paged layout (the serving engine's draft program —
         ``InferenceEngine(draft="model")``).
@@ -1131,8 +1236,9 @@ class Decoder:
         sampled requests the target's verify still gates acceptance
         against ITS sample, the draft just matches less often."""
         logits, caches = self._run_slots(params, aux, caches, pos,
-                                         catchup, impl=impl,
-                                         tp=tp)               # [S,W,V]
+                                         catchup, impl=impl, tp=tp,
+                                         mm_impl=mm_impl,
+                                         ep=ep)               # [S,W,V]
         idx = jnp.clip(clen - 1, 0, catchup.shape[1] - 1)
         lastlog = jnp.take_along_axis(
             logits, idx[:, None, None], axis=1)[:, 0]       # [S, V]
@@ -1142,7 +1248,8 @@ class Decoder:
         def body(carry, _):
             caches, p, t = carry
             lg, caches = self._run_slots(params, aux, caches, p,
-                                         t[:, None], impl=impl, tp=tp)
+                                         t[:, None], impl=impl, tp=tp,
+                                         mm_impl=mm_impl, ep=ep)
             nx = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
             return (caches, p + 1, nx), nx
 

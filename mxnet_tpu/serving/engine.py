@@ -222,6 +222,13 @@ _TM_TP_KV_BYTES = tele.gauge("serving.kv_bytes_per_shard")
 # built semantics like serving.attn_impl.
 _TM_WEIGHT_DTYPE = tele.gauge("serving.weight_dtype")
 _TM_WEIGHT_BYTES = tele.gauge("serving.weight_bytes")
+# fused quantized kernels (doc/serving.md "Fused quantized kernels"):
+# info gauges set at construction — which matmul impl the quantized
+# products trace (0 = dense fori loop, 1 = pallas, 2 = pallas + fused
+# decode chain) and the int4 per-group scale width (0 = not int4 /
+# auto). Engine-last-built semantics like serving.attn_impl.
+_TM_MATMUL_IMPL = tele.gauge("serving.matmul_impl")
+_TM_WEIGHT_GROUP = tele.gauge("serving.weight_group_size")
 # compile_counts re-exported as telemetry: the in-engine log stays the
 # tested contract; these make recompiles visible in ONE snapshot next
 # to everything else
@@ -664,7 +671,8 @@ class InferenceEngine:
                  flight_recorder=None, spec_k=None, draft=None,
                  draft_decoder=None, attn_impl=None, capture_dir=None,
                  capture_mb=None, tp=None, mesh=None,
-                 weight_dtype=None, engine_id=None, migrated_from=None):
+                 weight_dtype=None, weight_group=None, matmul_impl=None,
+                 ep=None, engine_id=None, migrated_from=None):
         if not isinstance(decoder, Decoder):
             raise MXNetError("InferenceEngine needs a Decoder, got %r"
                              % type(decoder).__name__)
@@ -789,11 +797,57 @@ class InferenceEngine:
             raise MXNetError("InferenceEngine: tp must be >= 1 "
                              "(1 = unsharded; MXNET_SERVING_TP sets "
                              "the default), got %d" % tp)
-        if tp > 1 and mesh is None:
+        # expert-parallel MoE (doc/serving.md "Expert-parallel MoE"):
+        # an "expert" mesh axis composed with tp — the per-expert
+        # weight stacks (the largest tensors in a MoE config) shard
+        # on their leading expert axis instead of replicating per
+        # shard; moe_ffn_math gathers gate logits / psums the combine
+        if ep is None:
+            ep = int(os.environ.get("MXNET_SERVING_EP", "") or 1)
+        ep = int(ep)
+        if ep < 1:
+            raise MXNetError("InferenceEngine: ep must be >= 1 "
+                             "(1 = no expert sharding; "
+                             "MXNET_SERVING_EP sets the default), "
+                             "got %d" % ep)
+        moe_nodes = [n for n in decoder._topo
+                     if not n.is_var and n.spec.name == "MoEFFN"]
+        if ep > 1:
+            if not moe_nodes:
+                raise MXNetError(
+                    "InferenceEngine: ep=%d needs a MoE decoder — no "
+                    "MoEFFN node to shard experts over" % ep)
+            for n in moe_nodes:
+                nx = int(n.params["num_experts"])
+                if nx % ep:
+                    raise MXNetError(
+                        "InferenceEngine: ep=%d must divide "
+                        "num_experts=%d (node %r) — the expert stacks "
+                        "shard their leading axis evenly"
+                        % (ep, nx, n.name))
+            if mesh is not None:
+                if "expert" not in mesh.axis_names \
+                        or int(mesh.shape["expert"]) != ep:
+                    raise MXNetError(
+                        "InferenceEngine: ep=%d disagrees with the "
+                        "mesh's expert axis (axes: %r) — "
+                        "parallel.build_mesh({'expert': ep, 'model': "
+                        "tp}) builds a composed mesh"
+                        % (ep, mesh.axis_names))
+            else:
+                from ..parallel.mesh import build_mesh
+                mesh = build_mesh({"expert": ep, "model": tp})
+        elif tp > 1 and mesh is None:
             from ..parallel.mesh import model_parallel_mesh
             mesh = model_parallel_mesh(tp)
         self.tp = tp
-        self._mesh = mesh if tp > 1 else None
+        self.ep = ep
+        self._mesh = mesh if (tp > 1 or ep > 1) else None
+        self._expert_names = set()
+        if ep > 1:
+            for n in moe_nodes:
+                for inp, _ in n.inputs[1:]:
+                    self._expert_names.add(inp.name)
         # weight-only quantization (doc/serving.md "Quantized
         # weights"): resolve BEFORE parameter placement — an int8
         # engine over a float decoder quantizes its OWN parameter
@@ -802,23 +856,47 @@ class InferenceEngine:
         # next to its fp oracle (the identity tests do)
         if weight_dtype is None:
             weight_dtype = decoder.weight_dtype
-        if weight_dtype not in ("float", "int8"):
+        if weight_dtype not in ("float", "int8", "int4"):
             raise MXNetError(
-                "InferenceEngine: weight_dtype must be 'float' or "
-                "'int8', got %r (MXNET_SERVING_WEIGHT_DTYPE sets the "
-                "default)" % (weight_dtype,))
-        if weight_dtype == "float" and decoder.weight_dtype == "int8":
+                "InferenceEngine: weight_dtype must be 'float', "
+                "'int8' or 'int4', got %r (MXNET_SERVING_WEIGHT_DTYPE "
+                "sets the default)" % (weight_dtype,))
+        if weight_dtype == "float" and decoder.weight_dtype != "float":
             raise MXNetError(
                 "InferenceEngine: weight_dtype='float' over a Decoder "
                 "built with weight_dtype='int8' — the float weights "
                 "are gone; build the decoder float (the engine "
                 "quantizes its own copy)")
+        if decoder.weight_dtype != "float" \
+                and weight_dtype != decoder.weight_dtype:
+            raise MXNetError(
+                "InferenceEngine: weight_dtype=%r over a Decoder "
+                "already quantized to %r — re-flavoring quantized "
+                "weights would re-round; build the decoder float (the "
+                "engine quantizes its own copy)"
+                % (weight_dtype, decoder.weight_dtype))
         self.weight_dtype = weight_dtype
+        if weight_group is None:
+            weight_group = decoder.weight_group
+        self.weight_group = weight_group
         params, auxs = decoder._params, decoder._aux
-        if weight_dtype == "int8" and decoder.weight_dtype != "int8":
+        if weight_dtype != "float" and decoder.weight_dtype == "float":
             from .quant import quantize_params, quantized_weight_names
             params = quantize_params(
-                params, quantized_weight_names(decoder._topo))
+                params, quantized_weight_names(decoder._topo),
+                bits=8 if weight_dtype == "int8" else 4,
+                group=weight_group,
+                row_quant=decoder._embedding_weight_names())
+        if weight_dtype == "int4" and self.weight_group is None:
+            # representative group for the gauges/geometry when the
+            # engine quantized its own copy under the auto pick: read
+            # it off a quantized matmul weight (the E-axis resolution
+            # Decoder records when IT quantizes)
+            from .quant import QuantizedTensor as _QT
+            for v in params.values():
+                if isinstance(v, _QT) and v.bits == 4:
+                    self.weight_group = v.group
+                    break
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             from ..ops.attention import MultiHeadAttention as _MHA
@@ -832,12 +910,20 @@ class InferenceEngine:
                 self._mesh, PartitionSpec(None, None, "model"))
             rep = NamedSharding(self._mesh, PartitionSpec())
             self._rep_shard = rep
-            # the engine's OWN replicated parameter placement (see the
+            # the engine's OWN parameter placement (see the
             # weight_dtype note above for why the decoder object is
             # never touched); QuantizedTensor entries are pytrees, so
-            # device_put replicates their int8 values and scales alike
-            self._params = {k: jax.device_put(v, rep)
-                            for k, v in params.items()}
+            # device_put replicates their int8 values and scales alike.
+            # Under ep>1 the MoE expert stacks shard their LEADING
+            # expert axis instead of replicating — the whole point of
+            # the expert mesh axis (quantized stacks shard values and
+            # scales alike: both carry the expert axis first)
+            exp = NamedSharding(self._mesh, PartitionSpec("expert")) \
+                if ep > 1 else rep
+            self._params = {
+                k: jax.device_put(v, exp if k in self._expert_names
+                                  else rep)
+                for k, v in params.items()}
             self._aux = [jax.device_put(v, rep) for v in auxs]
         else:
             self._kv_shard = None
@@ -913,6 +999,23 @@ class InferenceEngine:
         # per-shard cut multiply (doc/serving.md "Paged attention").
         self.attn_impl = attn_impl
         _TM_ATTN_IMPL.set(1 if attn_impl == "paged" else 0)
+        # fused quantized kernels (doc/serving.md "Fused quantized
+        # kernels"): which impl the quantized matmuls trace — threaded
+        # into every Decoder._run_slots/_run dispatch like attn_impl.
+        # "pallas" is bitwise-identical to "dense" (same output-
+        # channel partition at the same resolve_chunk size); "fused"
+        # additionally collapses each decode step's QKV→attention→
+        # out-proj chain into one dispatch where eligible (paged,
+        # c==1, tp=1, float KV) and falls back to the pallas product
+        # elsewhere — token-stable, so it is its OWN knob value
+        if matmul_impl is None:
+            matmul_impl = decoder._matmul_impl
+        if matmul_impl not in ("dense", "pallas", "fused"):
+            raise MXNetError(
+                "InferenceEngine: matmul_impl must be 'dense', "
+                "'pallas' or 'fused', got %r (MXNET_SERVING_MATMUL_"
+                "IMPL sets the default)" % (matmul_impl,))
+        self.matmul_impl = matmul_impl
         slot_bytes = sum(x.nbytes for x in
                          jax.tree_util.tree_leaves(self._caches)) // S
         # per-shard KV residency (jax Array.nbytes is GLOBAL, so the
@@ -1041,13 +1144,17 @@ class InferenceEngine:
         # the engine's total stored weight bytes — what int8 weights
         # buy is exactly this number shrinking while the programs
         # read it once per step (replicated per shard under tp)
-        _TM_WEIGHT_DTYPE.set(1 if self.weight_dtype == "int8" else 0)
+        _TM_WEIGHT_DTYPE.set(
+            {"float": 0, "int8": 1, "int4": 2}[self.weight_dtype])
         from .quant import weight_nbytes
         wbytes = weight_nbytes(self._params)
         if self._draft_dec is not None:
             wbytes += weight_nbytes(self._draft_params)
         self.weight_bytes = wbytes
         _TM_WEIGHT_BYTES.set(wbytes)
+        _TM_MATMUL_IMPL.set(
+            {"dense": 0, "pallas": 1, "fused": 2}[self.matmul_impl])
+        _TM_WEIGHT_GROUP.set(int(self.weight_group or 0))
 
         # host-side scheduler state
         self._pending = collections.deque()
@@ -1091,15 +1198,27 @@ class InferenceEngine:
         # (_wrap_tp) before jit — same families, same counts, sharded
         # execution.
         self._compile_log = []
-        self._tp_ax = ("model", self.tp) if self._mesh is not None \
-            else None
+        self._tp_ax = ("model", self.tp) \
+            if (self._mesh is not None and self.tp > 1) else None
+        self._ep_ax = ("expert", self.ep) if self.ep > 1 else None
+        # params in_spec: replicated, except the expert stacks under
+        # ep>1 (leading-axis expert sharding — QuantizedTensor leaves
+        # prefix-match the per-name spec)
+        if self.ep > 1:
+            from jax.sharding import PartitionSpec as _P
+            self._param_spec = {
+                k: (_P("expert") if k in self._expert_names else _P())
+                for k in self._params}
+        else:
+            self._param_spec = "r"
+        ps = self._param_spec
         on_chip = jax.default_backend() != "cpu"
         self._donate = (2, 3) if on_chip else ()
         self._copy_donate = (0, 1) if on_chip else ()
         cs = self._cache_spec(self._caches)
         self._step_fn = jax.jit(
             self._wrap_tp(self._make_step(),
-                          ("r", "r", cs, "r"), (cs, "r", "r")),
+                          (ps, "r", cs, "r"), (cs, "r", "r")),
             donate_argnums=self._donate)
         self._prefill_fns = {}
         self._copy_fns = {}
@@ -1112,7 +1231,7 @@ class InferenceEngine:
         if self._spec:
             self._verify_fn = jax.jit(
                 self._wrap_tp(self._make_verify(),
-                              ("r", "r", cs, "r", "r", "r"),
+                              (ps, "r", cs, "r", "r", "r"),
                               (cs, "r", "r")),
                 donate_argnums=self._donate)
             if self.spec_draft == "model":
@@ -1249,7 +1368,9 @@ class InferenceEngine:
         dec = self._dec
         k_rounds = self.steps_per_round
         impl = self.attn_impl
+        mm = self.matmul_impl
         tp_ax = self._tp_ax
+        ep_ax = self._ep_ax
 
         def one_step(caches, state, params, aux):
             pos, tok, live, temp, keys, eos, last = state
@@ -1258,7 +1379,8 @@ class InferenceEngine:
             # token in place — idempotent)
             logits, caches = dec._run_slots(params, aux, caches, pos,
                                             tok[:, None], impl=impl,
-                                            tp=tp_ax)
+                                            tp=tp_ax, mm_impl=mm,
+                                            ep=ep_ax)
             logits = logits[:, 0]
             nxt_pos = pos + 1
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -1320,7 +1442,9 @@ class InferenceEngine:
         program instead (the fallback path, counted)."""
         dec = self._dec
         impl = self.attn_impl
+        mm = self.matmul_impl
         tp_ax = self._tp_ax
+        ep_ax = self._ep_ax
 
         def verify(params, aux, caches, state, drafts, dlen):
             if not profiler.collecting():
@@ -1328,7 +1452,8 @@ class InferenceEngine:
                 _TM_COMPILE_VERIFY.inc()
             return dec.verify_step_slots(params, aux, caches, state,
                                          drafts, dlen, impl=impl,
-                                         tp=tp_ax)
+                                         tp=tp_ax, mm_impl=mm,
+                                         ep=ep_ax)
 
         return verify
 
@@ -1340,6 +1465,7 @@ class InferenceEngine:
         ddec = self._draft_dec
         k = self.spec_k
         impl = self.attn_impl
+        mm = self.matmul_impl
         tp_ax = self._tp_ax
 
         def draft(params, aux, caches, pos, catchup, clen):
@@ -1348,7 +1474,8 @@ class InferenceEngine:
                 _TM_COMPILE_DRAFT.inc()
             return ddec.draft_propose_slots(params, aux, caches, pos,
                                             catchup, clen, k,
-                                            impl=impl, tp=tp_ax)
+                                            impl=impl, tp=tp_ax,
+                                            mm_impl=mm)
 
         return draft
 
@@ -1362,6 +1489,7 @@ class InferenceEngine:
         beats maintaining a second pool)."""
         if bucket not in self._draft_prefill_fns:
             ddec = self._draft_dec
+            mm = self.matmul_impl
             tp_ax = self._tp_ax
 
             def dprefill(params, aux, caches, slot, tokens, start,
@@ -1374,7 +1502,7 @@ class InferenceEngine:
                     sub, only_if=start == jnp.int32(0))
                 _, sub = ddec._run(params, aux, sub, start, tokens,
                                    valid_len=start + true_len,
-                                   tp=tp_ax)
+                                   tp=tp_ax, mm_impl=mm)
                 return ddec.slot_update(caches, slot, sub)
 
             dcs = self._cache_spec(self._draft_caches)
@@ -1388,7 +1516,9 @@ class InferenceEngine:
     def _prefill_fn(self, bucket):
         if bucket not in self._prefill_fns:
             dec = self._dec
+            mm = self.matmul_impl
             tp_ax = self._tp_ax
+            ep_ax = self._ep_ax
 
             def prefill(params, aux, caches, state, slot, tokens,
                         start, true_len, final, temp, key, eos,
@@ -1413,7 +1543,8 @@ class InferenceEngine:
                 # cache rows are masked-until-overwritten, ring slots
                 # wrap)
                 logits, sub = dec._run(params, aux, sub, start, tokens,
-                                       valid_len=total, tp=tp_ax)
+                                       valid_len=total, tp=tp_ax,
+                                       mm_impl=mm, ep=ep_ax)
                 caches = dec.slot_update(caches, slot, sub)
                 v = logits.shape[2]
                 zero = jnp.int32(0)
@@ -1449,7 +1580,7 @@ class InferenceEngine:
             cs = self._cache_spec(self._caches)
             self._prefill_fns[bucket] = jax.jit(
                 self._wrap_tp(prefill,
-                              ("r", "r", cs) + ("r",) * 10,
+                              (self._param_spec, "r", cs) + ("r",) * 10,
                               (cs, "r", "r")),
                 donate_argnums=self._donate)
         return self._prefill_fns[bucket]
@@ -2910,7 +3041,10 @@ class InferenceEngine:
             "draft": self.spec_draft,
             "attn_impl": self.attn_impl,
             "tp": self.tp,
+            "ep": self.ep,
             "weight_dtype": self.weight_dtype,
+            "weight_group": self.weight_group,
+            "matmul_impl": self.matmul_impl,
             "capture_dir": getattr(self, "capture_dir", None),
         }
 

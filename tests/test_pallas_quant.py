@@ -1,0 +1,269 @@
+"""Quantized-matmul Pallas kernels (PR 17), interpreter mode on CPU —
+the same code runs compiled on TPU (backend-consistency oracle, as in
+test_pallas.py).
+
+The load-bearing contract: ``pk.quant_matmul`` is BITWISE identical to
+``serving.quant.scale_fused_matmul``'s host-level ``fori_loop`` — the
+grid walks output-channel blocks only and contracts the full E axis
+per step, a partition of independent dots, never a reassociation.
+That identity is what lets ``matmul_impl="pallas"`` ride the serving
+engine's byte-identity gauntlet unchanged (tests/test_serving_quant.py
+pins the engine side; this file pins the kernel side, zero engine
+compiles). The fused decode kernel is pinned against a composed
+fp reference instead — its plain-softmax attention is token-stable,
+not bitwise, vs the unfused path (why "fused" is its own knob value).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ops import pallas_kernels as pk
+from mxnet_tpu.serving.quant import (dequantize, pack_int4,
+                                     quantize_tensor, resolve_chunk,
+                                     scale_fused_matmul, unpack_int4)
+
+
+def _qt(rng, f, e, bits=8, group=None):
+    w = rng.randn(f, e).astype(np.float32)
+    return quantize_tensor(jnp.asarray(w), bits=bits, group=group)
+
+
+# The fori reference is compared UNDER JIT, like every serving program
+# that runs it: eager XLA materializes the int8->f32 cast before the
+# dot while jit folds the convert into the dot (a different gemv
+# accumulation at M=1), so eager-vs-kernel differs by ~1e-6 at single
+# rows even though the jitted pair — the pair the engine actually
+# ships — is bitwise identical at every shape.
+_fori = jax.jit(scale_fused_matmul)
+
+
+# -- quant_matmul vs the fori fallback: bitwise, by construction ------
+
+@pytest.mark.parametrize("m,e,f", [
+    (3, 16, 48),     # several 8-row blocks
+    (1, 32, 8),      # single block, single row
+    (5, 24, 7),      # F has no divisor in the block table -> whole
+    (2, 16, 256),    # exactly one max-size block
+    (4, 8, 72),      # block 8, 9 grid steps
+])
+def test_quant_matmul_int8_bitwise_vs_fori(m, e, f):
+    rng = np.random.RandomState(0)
+    qt = _qt(rng, f, e)
+    x = jnp.asarray(rng.randn(m, e).astype(np.float32))
+    got = pk.quant_matmul(x, qt.q, qt.scale, bits=8)
+    want = _fori(x, qt)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_quant_matmul_block_partition_invariance():
+    """Any block_f dividing F gives the bitwise-same product: blocking
+    partitions output channels, it never splits the contraction."""
+    rng = np.random.RandomState(1)
+    qt = _qt(rng, 48, 16)
+    x = jnp.asarray(rng.randn(3, 16).astype(np.float32))
+    outs = [np.asarray(pk.quant_matmul(x, qt.q, qt.scale, bits=8,
+                                       block_f=bf))
+            for bf in (48, 24, 16, 8)]
+    for o in outs[1:]:
+        np.testing.assert_array_equal(o, outs[0])
+
+
+@pytest.mark.parametrize("e,group", [
+    (16, 16),    # one group spanning the whole axis
+    (16, 2),     # minimal group width
+    (24, 8),     # several groups, E not a power of two
+])
+def test_quant_matmul_int4_bitwise_vs_fori(e, group):
+    rng = np.random.RandomState(2)
+    qt = _qt(rng, 32, e, bits=4, group=group)
+    assert qt.bits == 4 and qt.group == group
+    assert qt.q.shape == (32, e // 2) and qt.q.dtype == jnp.uint8
+    assert qt.scale.shape == (32, e // group)
+    x = jnp.asarray(rng.randn(3, e).astype(np.float32))
+    got = pk.quant_matmul(x, qt.q, qt.scale, bits=4, group=group)
+    want = _fori(x, qt)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_int4_pack_unpack_bitwise():
+    """pack/unpack round-trips every 4-bit value, and the kernel's
+    in-VMEM unpacker is the bitwise mirror of the host one."""
+    vals = np.tile(np.arange(-8, 8, dtype=np.int8), 4).reshape(4, 16)
+    packed = pack_int4(jnp.asarray(vals))
+    assert packed.shape == (4, 8) and packed.dtype == jnp.uint8
+    back = unpack_int4(packed)
+    np.testing.assert_array_equal(np.asarray(back), vals)
+    in_kernel = pk._unpack4_block(packed)
+    np.testing.assert_array_equal(np.asarray(in_kernel),
+                                  vals.astype(np.float32))
+
+
+def test_quant_matmul_all_zero_rows():
+    """All-zero output rows quantize to scale 1 / values 0 and come
+    out exactly zero — no NaNs from the amax/127 guard."""
+    rng = np.random.RandomState(3)
+    w = rng.randn(16, 8).astype(np.float32)
+    w[3] = 0.0
+    w[10] = 0.0
+    x = jnp.asarray(rng.randn(2, 8).astype(np.float32))
+    for bits, group in ((8, None), (4, 4)):
+        qt = quantize_tensor(jnp.asarray(w), bits=bits, group=group)
+        out = np.asarray(pk.quant_matmul(x, qt.q, qt.scale, bits=bits,
+                                         group=group))
+        assert np.isfinite(out).all()
+        np.testing.assert_array_equal(out[:, 3], 0.0)
+        np.testing.assert_array_equal(out[:, 10], 0.0)
+
+
+def test_quant_matmul_validation():
+    rng = np.random.RandomState(4)
+    qt = _qt(rng, 12, 8)
+    x = jnp.asarray(rng.randn(2, 8).astype(np.float32))
+    with pytest.raises(ValueError, match="block_f"):
+        pk.quant_matmul(x, qt.q, qt.scale, bits=8, block_f=5)
+    q4 = _qt(rng, 12, 8, bits=4, group=4)
+    with pytest.raises(ValueError, match="group"):
+        pk.quant_matmul(x, q4.q, q4.scale, bits=4, group=3)
+    with pytest.raises(ValueError, match="group"):
+        pk.quant_matmul(x, q4.q, q4.scale, bits=4)
+
+
+def test_quant_chunk_env_knob():
+    """MXNET_QUANT_CHUNK: explicit divisor honored by BOTH impls (they
+    stage identically — the bitwise pair stays a pair), >= F means
+    dequantize-whole, a non-divisor or non-integer is refused loudly
+    instead of silently falling back to the auto table."""
+    rng = np.random.RandomState(5)
+    qt = _qt(rng, 48, 16)
+    x = jnp.asarray(rng.randn(3, 16).astype(np.float32))
+    base = np.asarray(_fori(x, qt))
+    old = os.environ.get("MXNET_QUANT_CHUNK")
+    try:
+        os.environ["MXNET_QUANT_CHUNK"] = "12"
+        assert resolve_chunk(48) == 12
+        # fresh jit wrapper: the module-level _fori would replay its
+        # cached trace and never re-read the env knob
+        np.testing.assert_array_equal(
+            np.asarray(jax.jit(scale_fused_matmul)(x, qt)), base)
+        np.testing.assert_array_equal(
+            np.asarray(pk.quant_matmul(x, qt.q, qt.scale, bits=8,
+                                       block_f=resolve_chunk(48))),
+            base)
+        os.environ["MXNET_QUANT_CHUNK"] = "64"
+        assert resolve_chunk(48) is None      # whole-weight dequant
+        os.environ["MXNET_QUANT_CHUNK"] = "0"
+        assert resolve_chunk(48) == 16        # auto divisor table
+        os.environ["MXNET_QUANT_CHUNK"] = "7"
+        with pytest.raises(MXNetError, match="MXNET_QUANT_CHUNK"):
+            resolve_chunk(48)
+        os.environ["MXNET_QUANT_CHUNK"] = "lots"
+        with pytest.raises(MXNetError, match="MXNET_QUANT_CHUNK"):
+            resolve_chunk(48)
+    finally:
+        if old is None:
+            del os.environ["MXNET_QUANT_CHUNK"]
+        else:
+            os.environ["MXNET_QUANT_CHUNK"] = old
+
+
+# -- fused decode kernel vs a composed fp reference -------------------
+
+def _rot(v, cs, sn):
+    half = v.shape[-1] // 2
+    x1, x2 = v[..., :half], v[..., half:]
+    return np.concatenate([x1 * cs - x2 * sn, x2 * cs + x1 * sn],
+                          axis=-1)
+
+
+def _fused_ref(x, pos, kc, vc, wqkv, bqkv, wo, bo, heads, kv, rope,
+               rope_base=10000.0):
+    """Slot-by-slot numpy reference: QKV proj -> rope -> masked
+    attention over live rows + the in-register current token ->
+    out proj. Mirrors the kernel's kv-major head fold."""
+    s_, e = x.shape
+    l_ = kc.shape[1]
+    d = kc.shape[3]
+    g = heads // kv
+    half = d // 2
+    scale = 1.0 / np.sqrt(d)
+    outs, kns, vns = [], [], []
+    for i in range(s_):
+        p = int(pos[i])
+        qkv = x[i] @ wqkv.T + bqkv
+        qh = qkv[:heads * d].reshape(kv, g, d)
+        kh = qkv[heads * d:(heads + kv) * d].reshape(kv, d)
+        vh = qkv[(heads + kv) * d:].reshape(kv, d)
+        if rope:
+            freq = rope_base ** (-np.arange(half, dtype=np.float32)
+                                 / half)
+            cs, sn = np.cos(p * freq), np.sin(p * freq)
+            qh, kh = _rot(qh, cs, sn), _rot(kh, cs, sn)
+        sc = np.einsum("kgd,lkd->kgl", qh, kc[i]) * scale
+        sc = np.where(np.arange(l_)[None, None, :] < p, sc, -1e30)
+        s_new = np.einsum("kgd,kd->kg", qh, kh)[..., None] * scale
+        allsc = np.concatenate([sc, s_new], axis=-1)
+        w = np.exp(allsc - allsc.max(-1, keepdims=True))
+        w /= w.sum(-1, keepdims=True)
+        o = np.einsum("kgl,lkd->kgd", w[..., :l_], vc[i]) \
+            + w[..., l_:] * vh[:, None, :]
+        o = o.reshape(heads * d)
+        outs.append(o @ wo.T + bo)
+        kns.append(kh)
+        vns.append(vh)
+    return np.stack(outs), np.stack(kns), np.stack(vns)
+
+
+@pytest.mark.parametrize("bits,rope", [(8, True), (8, False),
+                                       (4, True)])
+def test_fused_decode_attention_vs_composed(bits, rope):
+    rng = np.random.RandomState(6)
+    heads, kv, d, l_, s_ = 4, 2, 8, 8, 2
+    e = heads * d
+    fq = (heads + 2 * kv) * d
+    group = 8 if bits == 4 else None
+    wq = quantize_tensor(
+        jnp.asarray(rng.randn(fq, e).astype(np.float32) * 0.2),
+        bits=bits, group=group)
+    wo = quantize_tensor(
+        jnp.asarray(rng.randn(e, e).astype(np.float32) * 0.2),
+        bits=bits, group=group)
+    bq = rng.randn(fq).astype(np.float32) * 0.1
+    bo = rng.randn(e).astype(np.float32) * 0.1
+    x = rng.randn(s_, e).astype(np.float32)
+    kc = rng.randn(s_, l_, kv, d).astype(np.float32)
+    vc = rng.randn(s_, l_, kv, d).astype(np.float32)
+    pos = np.array([3, 7], np.int32)
+    out, kn, vn = pk.fused_decode_attention(
+        jnp.asarray(x), jnp.asarray(pos), jnp.asarray(kc),
+        jnp.asarray(vc), wq.q, wq.scale, jnp.asarray(bq), wo.q,
+        wo.scale, jnp.asarray(bo), heads=heads, kv_heads=kv,
+        bits=bits, group=group, rope=rope)
+    ro, rk, rv = _fused_ref(x, pos, kc, vc,
+                            np.asarray(dequantize(wq)), bq,
+                            np.asarray(dequantize(wo)), bo,
+                            heads, kv, rope)
+    np.testing.assert_allclose(np.asarray(out), ro, rtol=2e-5,
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(kn), rk, rtol=2e-5,
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(vn), rv, rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_dispatch_counter():
+    """Every public kernel entry bumps the trace-time dispatch
+    counter — the bench's fused-vs-pallas dispatch cut reads it."""
+    rng = np.random.RandomState(7)
+    qt = _qt(rng, 16, 8)
+    x = jnp.asarray(rng.randn(2, 8).astype(np.float32))
+    pk.reset_dispatch_count()
+    pk.quant_matmul(x, qt.q, qt.scale, bits=8)
+    pk.quant_matmul(x, qt.q, qt.scale, bits=8)
+    assert pk.dispatch_count() == 2
+    pk.reset_dispatch_count()
+    assert pk.dispatch_count() == 0

@@ -355,7 +355,7 @@ def test_quant_validation_and_env_default(lm):
     engine alike."""
     sym, params, _ = lm
     with pytest.raises(MXNetError, match="weight_dtype"):
-        Decoder(sym, params, max_len=T, weight_dtype="int4")
+        Decoder(sym, params, max_len=T, weight_dtype="int2")
     with pytest.raises(MXNetError, match="weight_dtype"):
         InferenceEngine(Decoder(sym, params, max_len=T,
                                 cache_block=None),
@@ -385,3 +385,194 @@ def test_quant_validation_and_env_default(lm):
             del os.environ["MXNET_SERVING_WEIGHT_DTYPE"]
         else:
             os.environ["MXNET_SERVING_WEIGHT_DTYPE"] = old
+
+
+# -- PR 17: Pallas quantized kernels through the engine ---------------
+
+def test_engine_pallas_byte_identical(lm, qdec, quant_engine):
+    """matmul_impl="pallas" under the FULL gauntlet config (prefix
+    cache, chunked prefill, n-gram speculation, steps_per_round>1):
+    byte-identical to the quantized offline decoder — i.e. to the
+    dense fori engine, since both pin to the same oracle. The kernel
+    blocks output channels exactly where the fori loop chunks
+    (resolve_chunk), a partition not a reassociation, so swapping the
+    lowering cannot move a single bit. Compile contract unchanged;
+    the matmul_impl gauge and geometry carry the knob."""
+    sym, params, dec = lm
+    eng = InferenceEngine(
+        Decoder(sym, params, max_len=T, cache_block=None),
+        slots=2, prefill_buckets=(4, 8), prefix_cache_mb=0.0021,
+        prefill_chunk=3, draft="ngram", spec_k=3, steps_per_round=2,
+        weight_dtype="int8", matmul_impl="pallas")
+    assert eng.matmul_impl == "pallas"
+    rng = np.random.RandomState(11)
+    base = rng.randint(0, VOCAB, (7,))
+    cases = {
+        "miss_long": (base, 3),
+        "prefix_of": (base[:4].copy(), 6),
+        "accepting": (np.array([0, 3, 3]), 13),
+    }
+    rs = {k: eng.submit(*v) for k, v in cases.items()}
+    eng.serve_forever()
+    for k, (p, n) in cases.items():
+        np.testing.assert_array_equal(rs[k].result(), _oracle(qdec, p, n),
+                                      err_msg="pallas-vs-fori " + k)
+    assert_compile_contract(eng)
+    assert mx.telemetry.snapshot()["serving"]["matmul_impl"] == 1
+    assert eng._geometry()["matmul_impl"] == "pallas"
+    # knob validation + env default, compile-free
+    with pytest.raises(MXNetError, match="matmul_impl"):
+        InferenceEngine(Decoder(sym, params, max_len=T,
+                                cache_block=None),
+                        slots=2, prefill_buckets=(4,),
+                        prefix_cache_mb=0, matmul_impl="triton")
+    old = os.environ.get("MXNET_SERVING_MATMUL_IMPL")
+    os.environ["MXNET_SERVING_MATMUL_IMPL"] = "pallas"
+    try:
+        d = Decoder(sym, params, max_len=T, cache_block=None)
+        assert d._matmul_impl == "pallas"
+    finally:
+        if old is None:
+            del os.environ["MXNET_SERVING_MATMUL_IMPL"]
+        else:
+            os.environ["MXNET_SERVING_MATMUL_IMPL"] = old
+
+
+def test_engine_fused_decode_token_equal(lm, qdec):
+    """matmul_impl="fused" on the paged path (the one-dispatch
+    QKV->attention->out-proj decode kernel): token-equal to the
+    pallas engine on the same stream. Fused is token-stable, NOT
+    bitwise — its plain-softmax attention blocks the contraction
+    differently — which is exactly why it is a distinct knob value
+    instead of an automatic upgrade of "pallas". Compile contract
+    holds per arm (the fused chain replaces dispatches, it never adds
+    program families)."""
+    sym, params, _ = lm
+
+    def mkeng(mi):
+        return InferenceEngine(
+            Decoder(sym, params, max_len=T, cache_block=None),
+            slots=2, prefill_buckets=(4, 8), prefix_cache_mb=0,
+            attn_impl="paged", weight_dtype="int8", matmul_impl=mi)
+
+    ep, ef = mkeng("pallas"), mkeng("fused")
+    rng = np.random.RandomState(23)
+    cases = [(rng.randint(0, VOCAB, (pl,)), n)
+             for pl, n in [(3, 6), (5, 5), (2, 4)]]
+    rp = [ep.submit(p, max_tokens=n) for p, n in cases]
+    rf = [ef.submit(p, max_tokens=n) for p, n in cases]
+    ep.serve_forever()
+    ef.serve_forever()
+    for a, b in zip(rp, rf):
+        np.testing.assert_array_equal(a.result(), b.result())
+    assert_compile_contract(ep, copy={})
+    assert_compile_contract(ef, copy={})
+    assert mx.telemetry.snapshot()["serving"]["matmul_impl"] == 2
+    assert ef._geometry()["matmul_impl"] == "fused"
+
+
+def test_engine_int4_gauntlet_and_restore():
+    """weight_dtype="int4" (packed nibbles + per-group contraction
+    scales, Pallas quant_matmul): the engine is byte-identical to the
+    int4 OFFLINE decoder (the engine contract, any seed), argmax-
+    stable vs the fp oracle on this draw, stores fewer weight bytes
+    than int8, and snapshot/restore continues byte-identically with
+    weight_group carried through the geometry. Weight seed 4: int4's
+    ~5% rounding sits argmax-stable there (near-tie seeds flip one
+    token — the tolerance-bounded contract, as with seed 13 at
+    int8)."""
+    rng = np.random.RandomState(4)
+    sym = _lm()
+    params = _init_params(sym, rng)
+    dec = Decoder(sym, params, max_len=T)                 # fp oracle
+    dq4 = Decoder(sym, params, max_len=T, cache_block=None,
+                  weight_dtype="int4")
+    qt = dq4._params["layer0_qkv_weight"]
+    assert isinstance(qt, QuantizedTensor)
+    assert qt.bits == 4 and qt.q.dtype == jnp.uint8
+    assert qt.q.shape[-1] == EMBED // 2
+    eng = InferenceEngine(
+        Decoder(sym, params, max_len=T, cache_block=None),
+        slots=2, prefill_buckets=(4, 8), prefix_cache_mb=0,
+        weight_dtype="int4", matmul_impl="pallas")
+    assert eng.weight_dtype == "int4"
+    assert eng.weight_group == dq4.weight_group
+    p = np.array([1, 2, 3])
+    r = eng.submit(p, max_tokens=8)
+    eng.serve_forever()
+    np.testing.assert_array_equal(r.result(), _oracle(dq4, p, 8),
+                                  err_msg="engine-vs-int4-offline")
+    np.testing.assert_array_equal(r.result(), _oracle(dec, p, 8),
+                                  err_msg="int4 argmax-stability")
+    snap = mx.telemetry.snapshot()["serving"]    # before e8 overwrites
+    assert snap["weight_dtype"] == 2
+    assert snap["weight_group_size"] == eng.weight_group > 0
+    e8 = InferenceEngine(
+        Decoder(sym, params, max_len=T, cache_block=None),
+        slots=2, prefill_buckets=(4, 8), prefix_cache_mb=0,
+        weight_dtype="int8")
+    assert eng.weight_bytes < e8.weight_bytes
+    # restore over the float decoder: re-quantizes to int4 with the
+    # SAME group and finishes the in-flight request byte-identically
+    p2 = np.array([2, 5, 1, 3])
+    r2 = eng.submit(p2, max_tokens=6)
+    for _ in range(2):
+        eng.step()
+    s = eng.snapshot()
+    assert s["engine"]["weight_dtype"] == "int4"
+    assert s["engine"]["matmul_impl"] == "pallas"
+    eng2, handles = InferenceEngine.restore(s, eng._dec)
+    assert eng2.weight_dtype == "int4"
+    assert eng2.weight_group == eng.weight_group
+    eng2.serve_forever()
+    np.testing.assert_array_equal(handles[r2.id].result(),
+                                  _oracle(dq4, p2, 6))
+    eng.serve_forever()
+    assert eng.idle
+
+
+def test_engine_expert_parallel_moe(lm):
+    """ep=2 expert parallelism (int8, MoE): the expert stacks shard
+    their leading axis over the mesh's "expert" axis (values AND
+    scales), gate logits all-gather, per-shard partial outputs psum —
+    token-equal to ep=1 (the collective combine reassociates the sum,
+    so the contract is token-stability, not bitwise — same family as
+    the fused kernel). Construction refuses ep without MoE nodes and
+    non-divisor degrees, compile-free."""
+    rng = np.random.RandomState(2)
+    sym = _lm(num_experts=4, moe_top_k=2)
+    params = _init_params(sym, rng)
+
+    def mkeng(**kw):
+        return InferenceEngine(
+            Decoder(sym, params, max_len=T, cache_block=None),
+            slots=2, prefill_buckets=(4,), prefix_cache_mb=0,
+            weight_dtype="int8", **kw)
+
+    e1, e2 = mkeng(), mkeng(ep=2)
+    assert e2.ep == 2 and e2._mesh is not None
+    assert "expert" in e2._mesh.axis_names
+    qt = e2._params["layer0_expert_w1"]
+    assert isinstance(qt, QuantizedTensor)
+    for leaf in (qt.q, qt.scale):
+        assert leaf.sharding.spec[0] == "expert"
+    cases = [(rng.randint(0, VOCAB, (pl,)), n)
+             for pl, n in [(3, 5), (4, 4), (2, 6)]]
+    rs1 = [e1.submit(p, max_tokens=n) for p, n in cases]
+    rs2 = [e2.submit(p, max_tokens=n) for p, n in cases]
+    e1.serve_forever()
+    e2.serve_forever()
+    for a, b in zip(rs1, rs2):
+        np.testing.assert_array_equal(a.result(), b.result())
+    assert_compile_contract(e1, copy={})
+    assert_compile_contract(e2, copy={})
+    assert e2._geometry()["ep"] == 2
+    # construction contracts
+    sym_plain, params_plain, _ = lm
+    with pytest.raises(MXNetError, match="MoE"):
+        InferenceEngine(Decoder(sym_plain, params_plain, max_len=T,
+                                cache_block=None),
+                        slots=2, prefill_buckets=(4,),
+                        prefix_cache_mb=0, ep=2)
+    with pytest.raises(MXNetError, match="num_experts"):
+        mkeng(ep=3)

@@ -24,13 +24,19 @@ paged kernel that walks only each slot's live KV rows, same stream per
 seed — each cell reports tokens/s, cadence p50/p99, and the decode
 program's ``bytes_accessed`` per dispatch (the traffic-cut metric).
 
-``--weight-dtypes float int8`` adds one cell per weight storage dtype
-(ISSUE 15): float weights vs int8 + per-output-channel scales with
-chunked scale-fused dequant inside the programs, same stream per seed
-— each cell reports tokens/s, cadence p50/p99, stored ``weight_bytes``
-and the decode program's ``bytes_accessed`` per dispatch (the
+``--weight-dtypes float int8 int4`` adds one cell per weight storage
+dtype (ISSUE 15/17): float weights vs int8 + per-output-channel scales
+vs int4 packed nibbles + per-group scales, same stream per seed — each
+cell reports tokens/s, cadence p50/p99, stored ``weight_bytes`` and
+the decode program's ``bytes_accessed`` per dispatch (the
 weight-stream cut — at serving batch the weights, not the KV, dominate
 decode bytes; doc/serving.md "Quantized weights").
+
+``--matmul-impls dense pallas fused`` adds one cell per quantized
+matmul lowering (PR 17) with int8 weights and paged attention pinned:
+the chunked host-level fori loop vs the Pallas ``quant_matmul`` kernel
+(dequant-in-VMEM) vs the fused one-dispatch QKV->attention->out-proj
+decode kernel (doc/serving.md "Fused quantized kernels").
 
 ``--tps 1 2 4`` adds a tensor-parallel sweep over
 ``bench.bench_serving_tp`` (ISSUE 14): one cell per degree on the
@@ -127,16 +133,28 @@ def main():
                          "many devices (CPU smoke: export XLA_FLAGS="
                          "--xla_force_host_platform_device_count=8)")
     ap.add_argument("--weight-dtypes", nargs="+", default=[],
-                    choices=("float", "int8"),
+                    choices=("float", "int8", "int4"),
                     help="weight-storage sweep axis (e.g. float "
-                         "int8): one bench_serving cell per dtype at "
-                         "the first slots/arrival setting — int8 = "
-                         "per-output-channel quantized weights with "
-                         "chunked scale-fused dequant in-program; "
-                         "cells report tokens/s, cadence p50/p99, "
-                         "stored weight bytes, and the decode "
-                         "program's bytes_accessed per dispatch (the "
-                         "weight-stream cut)")
+                         "int8 int4): one bench_serving cell per "
+                         "dtype at the first slots/arrival setting — "
+                         "int8 = per-output-channel quantized weights "
+                         "with chunked scale-fused dequant "
+                         "in-program, int4 = packed nibbles + "
+                         "per-group scales; cells report tokens/s, "
+                         "cadence p50/p99, stored weight bytes, and "
+                         "the decode program's bytes_accessed per "
+                         "dispatch (the weight-stream cut)")
+    ap.add_argument("--matmul-impls", nargs="+", default=[],
+                    choices=("dense", "pallas", "fused"),
+                    help="quantized-matmul impl sweep axis (PR 17): "
+                         "one bench_serving cell per impl at the "
+                         "first slots/arrival setting, int8 weights "
+                         "pinned so the cells compare like-for-like "
+                         "— dense = the chunked host-level fori "
+                         "loop, pallas = the quant_matmul kernel "
+                         "(dequant-in-VMEM), fused = the one-dispatch "
+                         "QKV->attention->out-proj decode kernel "
+                         "(paged attention path)")
     ap.add_argument("--attn-impls", nargs="+", default=[],
                     help="attention-impl sweep axis (e.g. dense "
                          "paged): one bench_serving cell per impl at "
@@ -260,6 +278,24 @@ def main():
                  "weight_bytes", "compile_programs")}
         out["weights_%s" % wd] = cell
         print("weights_%s: %r" % (wd, cell), file=sys.stderr)
+    # quantized-matmul impl sweep (PR 17): dense fori vs the Pallas
+    # quant_matmul kernel vs the fused decode kernel, int8 weights and
+    # the paged attention path pinned so cells differ only in the
+    # matmul lowering — dense and pallas cells are byte-identical by
+    # the kernel contract, the fused cell is token-stable
+    for mi in args.matmul_impls:
+        r = bench.bench_serving(
+            slots=args.slots[0], layers=args.layers, embed=args.embed,
+            heads=args.heads, vocab=args.vocab, max_len=args.max_len,
+            n_requests=args.requests, seed=3,
+            arrival_ms=args.arrival_ms[0], attn_impl="paged",
+            weight_dtype="int8", matmul_impl=mi)
+        cell = {k: r[k] for k in
+                ("tokens_per_sec", "p50_ms_per_token",
+                 "p99_ms_per_token", "decode_bytes_accessed",
+                 "weight_bytes", "compile_programs")}
+        out["matmul_%s" % mi] = cell
+        print("matmul_%s: %r" % (mi, cell), file=sys.stderr)
     # tensor-parallel sweep (ISSUE 14): same stream/seed per degree,
     # byte-identity digest-asserted across cells before any number is
     # trusted; bytes_accessed is PER SHARD (the multi-chip cut)
