@@ -1598,6 +1598,161 @@ def bench_serving_fleet(replicas=2, slots=4, layers=2, embed=128,
         shutil.rmtree(cap_dir, ignore_errors=True)
 
 
+def bench_serving_disagg(slots=4, layers=2, embed=128, heads=4,
+                         vocab=4000, max_len=160, n_requests=36,
+                         seed=13, short_len=12, long_len=112,
+                         short_out=16, long_out=6, long_every=4):
+    """Disaggregated prefill/decode arm (ISSUE 18): the SAME
+    long-prompt adversarial mix — a steady stream of short decodes
+    with a near-max-bucket prompt every ``long_every`` submits, the
+    traffic shape whose chunked prefill rounds steal decode cadence —
+    served by (a) a 2-replica UNIFIED fleet and (b) a 1 prefill + 1
+    decode specialist fleet at the same chip count, outputs
+    byte-compared request-by-request. Headline pair:
+    ``disagg_decode_p99_ratio`` = disagg cadence p99 / unified cadence
+    p99 (lower is better; <= ~1 is the acceptance bar — decode
+    specialists never dispatch a prefill round, so long prompts stop
+    blocking everyone else's cadence) and
+    ``disagg_handoff_bytes_per_req`` (the transfer cost one request's
+    KV handoff ships). A third int8-transfer arm re-runs the disagg
+    fleet with ``handoff_dtype="int8"`` to pin the ~half-fp-bytes
+    encoding ratio. Small model on purpose: the contention being
+    measured is scheduling, not device math."""
+    import jax.numpy as jnp
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.models import get_transformer_lm
+    from mxnet_tpu.parallel import Decoder
+    from mxnet_tpu.serving import (InferenceEngine, FleetRouter,
+                                   EngineOverloaded)
+
+    sym = get_transformer_lm(vocab, num_layers=layers, embed_dim=embed,
+                             num_heads=heads, impl="dense")
+    rng = np.random.RandomState(seed)
+    shapes = {"data": (4, max_len), "softmax_label": (4, max_len)}
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    params = {n: jnp.asarray(rng.uniform(-0.05, 0.05, sh)
+                             .astype(np.float32))
+              for n, sh in zip(sym.list_arguments(), arg_shapes)
+              if n not in shapes}
+    base_cfg = dict(slots=slots, prefill_buckets=(32, 128),
+                    max_queue=4 * slots, prefix_cache_mb=1,
+                    prefill_chunk=16)
+
+    def decoder():
+        return Decoder(sym, params, max_len=max_len, cache_block=None)
+
+    # one fixed adversarial schedule, shared by every arm
+    traffic = []
+    for i in range(n_requests):
+        if i % long_every == long_every - 1:
+            traffic.append((rng.randint(0, vocab, (long_len,)),
+                            long_out))
+        else:
+            traffic.append((rng.randint(0, vocab, (short_len,)),
+                            short_out))
+
+    # warmup: two long + two short requests per arm, submitted
+    # back-to-back so least-loaded placement gives EVERY replica one
+    # of each — traces every program family (prefill/copy/handoff at
+    # both buckets, decode) before the measured window, so cadence
+    # percentiles read scheduling contention rather than one-time
+    # compile stalls
+    warmup = [(rng.randint(0, vocab, (long_len,)), 2),
+              (rng.randint(0, vocab, (long_len,)), 2),
+              (rng.randint(0, vocab, (short_len,)), 2),
+              (rng.randint(0, vocab, (short_len,)), 2)]
+
+    def run_arm(roles, handoff_dtype="native"):
+        engines = [InferenceEngine(decoder(), role=r,
+                                   handoff_dtype=handoff_dtype,
+                                   **base_cfg) for r in roles]
+        fleet = FleetRouter(engines, heartbeat_ms=1e6)
+        for prompt, out in warmup:
+            fleet.submit(prompt, max_tokens=out)
+        fleet.serve_forever()
+        handles = []
+        for prompt, out in traffic:
+            while True:
+                # backpressure: in a role fleet only the prefill
+                # replica admits, so its queue (not the fleet-wide
+                # sum) is the bound — drain until the submit lands
+                try:
+                    handles.append(
+                        fleet.submit(prompt, max_tokens=out))
+                    break
+                except (EngineOverloaded, MXNetError):
+                    fleet.step()
+        t0 = time.perf_counter()
+        fleet.serve_forever()
+        wall = time.perf_counter() - t0
+        cadence = [(h.t_done - h.t_first) / (len(h.tokens) - 1) * 1e3
+                   for h in handles
+                   if h.t_first is not None and h.t_done is not None
+                   and len(h.tokens) > 1]
+        toks = sum(len(h.tokens) for h in handles)
+        stats = dict(fleet.stats)
+        for e in engines:
+            cc = e.compile_counts
+            if e.role == "prefill":
+                assert cc["decode"] == 0 and cc["verify"] == 0, \
+                    "prefill specialist compiled decode: %r" % (cc,)
+            elif e.role == "decode":
+                assert not cc["prefill"], \
+                    "decode specialist compiled prefill: %r" % (cc,)
+        tokens_out = [list(h.tokens) for h in handles]
+        fleet.close()
+        return {
+            "cadence_p50_ms": round(float(np.percentile(cadence, 50)),
+                                    3),
+            "cadence_p99_ms": round(float(np.percentile(cadence, 99)),
+                                    3),
+            "tokens_per_sec": round(toks / wall, 1) if wall else None,
+            "stats": stats,
+        }, tokens_out
+
+    unified, toks_u = run_arm(("unified", "unified"))
+    disagg, toks_d = run_arm(("prefill", "decode"))
+    assert toks_u == toks_d, \
+        "disaggregation changed tokens (byte-identity violated)"
+    int8_arm, toks_q = run_arm(("prefill", "decode"),
+                               handoff_dtype="int8")
+
+    def per_req(stats):
+        n = stats.get("handoffs", 0) - stats.get("handoff_pool_hits",
+                                                 0)
+        return None if not n \
+            else round(stats.get("handoff_bytes", 0) / float(n))
+
+    native_bytes = per_req(disagg["stats"])
+    int8_bytes = per_req(int8_arm["stats"])
+    return {
+        "requests": n_requests,
+        "long_prompt_len": long_len,
+        "unified": {k: unified[k] for k in
+                    ("cadence_p50_ms", "cadence_p99_ms",
+                     "tokens_per_sec")},
+        "disagg_1p1d": {
+            **{k: disagg[k] for k in
+               ("cadence_p50_ms", "cadence_p99_ms",
+                "tokens_per_sec")},
+            "handoffs": disagg["stats"].get("handoffs", 0),
+            "handoff_pool_hits":
+                disagg["stats"].get("handoff_pool_hits", 0),
+        },
+        "byte_identical": 1,     # asserted above, both topologies
+        "disagg_decode_p99_ratio":
+            round(disagg["cadence_p99_ms"]
+                  / unified["cadence_p99_ms"], 3)
+            if unified["cadence_p99_ms"] else None,
+        "disagg_handoff_bytes_per_req": native_bytes,
+        "handoff_bytes_per_req_int8": int8_bytes,
+        "handoff_int8_bytes_ratio":
+            None if not native_bytes or not int8_bytes
+            else round(int8_bytes / float(native_bytes), 3),
+        "int8_transfer_tokens_match": int(toks_q == toks_d),
+    }
+
+
 def bench_recordio_io():
     """C++ ImageRecordIOIter: run tools/bench_io.py in a CLEAN
     subprocess (no jax): on this 1-core container the jax/axon runtime
@@ -2163,6 +2318,14 @@ def main():
     except Exception:
         traceback.print_exc()
         serving_fleet = None
+    # disaggregated prefill/decode (ISSUE 18): long-prompt adversarial
+    # mix on a 1P+1D specialist fleet vs a 2-unified fleet at matched
+    # chip count — decode p99 isolation + the per-request KV transfer
+    try:
+        serving_disagg = bench_serving_disagg()
+    except Exception:
+        traceback.print_exc()
+        serving_disagg = None
     # tensor-parallel sweep (ISSUE 14): same workload/seeds at
     # tp in {1, 2, 4}; outputs byte-identical across degrees
     # (digest-asserted), per-shard decode bytes_accessed is the cut
@@ -2308,6 +2471,27 @@ def main():
                     "replica; tools/replay_serving.py --replicas N "
                     "--rolling-restart runs the same drill on any "
                     "production capture",
+        },
+        "serving_disagg": None if serving_disagg is None
+        else {
+            **serving_disagg,
+            "note": "disaggregated prefill/decode (doc/serving.md "
+                    "'Disaggregated prefill/decode'): the same "
+                    "long-prompt adversarial mix served by a "
+                    "2-unified fleet and a 1 prefill + 1 decode "
+                    "specialist fleet at matched chip count, outputs "
+                    "byte-compared (byte_identical=1 asserted); "
+                    "disagg_decode_p99_ratio = specialist cadence p99 "
+                    "/ unified cadence p99 (lower better — decode "
+                    "replicas never dispatch prefill rounds, so long "
+                    "prompts stop stealing cadence); "
+                    "disagg_handoff_bytes_per_req = KV bytes one "
+                    "request's handoff ships (pool-affinity hits ship "
+                    "zero); handoff_int8_bytes_ratio pins the "
+                    "MXNET_SERVING_HANDOFF_DTYPE=int8 encoding at "
+                    "~half fp bytes; tools/replay_serving.py --roles "
+                    "PxD replays any capture through the same "
+                    "topology",
         },
         "serving_overload_shed_vs_block": None if serving_overload is None
         else {
@@ -2460,6 +2644,12 @@ def main():
             "fleet_zero_failed_restart":
                 None if serving_fleet is None
                 else serving_fleet["zero_failed_restart"],
+            "disagg_decode_p99_ratio":
+                None if serving_disagg is None
+                else serving_disagg["disagg_decode_p99_ratio"],
+            "disagg_handoff_bytes_per_req":
+                None if serving_disagg is None
+                else serving_disagg["disagg_handoff_bytes_per_req"],
             "cifar10_img_per_sec":
                 None if cifar is None else round(cifar, 1),
             "cifar10_vs_gtx980":

@@ -1128,6 +1128,23 @@ class Decoder:
 
         return jax.tree_util.tree_map(write, caches, rows)
 
+    @staticmethod
+    def slot_set_state(state, slot, values):
+        """Poke ONE slot's per-slot scheduler state (the serving
+        engine's ``(pos, tok, live, temp, key, eos, last)`` vectors)
+        host-side: pull each vector to host numpy, overwrite row
+        ``slot`` with the matching entry of ``values``, and return the
+        new tuple. No compiled program and no traced op — this is the
+        KV-handoff import's state write, which runs once per handed-off
+        request (the engine re-places the result on device, replicated
+        under tp). The source arrays are never mutated."""
+        out = []
+        for arr, v in zip(state, values):
+            host = np.array(np.asarray(arr))
+            host[slot] = v
+            out.append(host)
+        return tuple(out)
+
     def verify_step_slots(self, params, aux, caches, state, drafts,
                           dlen, impl=None, tp=None, mm_impl=None,
                           ep=None):

@@ -30,13 +30,18 @@ Fleet layer (doc/fault_tolerance.md "Fleet resilience"):
 :class:`FleetRouter` fronts N replicas with health-driven +
 prefix-affinity admission, heartbeat failover, live request migration
 (``drain``), and fleet-wide overload composition — a rolling restart
-fails zero requests, byte-identically.
+fails zero requests, byte-identically. Replicas may specialize
+(``role="prefill"``/``"decode"``, doc/serving.md "Disaggregated
+prefill/decode"): prefill engines hand finished KV rows to decode
+engines through the router (:class:`KVHandoff`), isolating decode
+cadence from long-prompt prefill.
 """
 from .capture import CaptureStream, load_capture
 from .engine import (InferenceEngine, Request, EngineOverloaded,
                      EngineClosed, EngineStuck)
 from .fleet import FleetRouter, FleetRequest
 from .flight import FlightRecorder
+from .handoff import KVHandoff, pack_rows, unpack_rows
 from .prefix import PrefixCache
 from .quant import (QuantizedTensor, quantize_tensor, quantize_params,
                     quantized_weight_names, dequantize)
@@ -47,4 +52,5 @@ __all__ = ["InferenceEngine", "Request", "PrefixCache",
            "load_capture", "QuantizedTensor", "quantize_tensor",
            "quantize_params", "quantized_weight_names", "dequantize",
            "EngineOverloaded", "EngineClosed", "EngineStuck",
-           "FleetRouter", "FleetRequest"]
+           "FleetRouter", "FleetRequest", "KVHandoff", "pack_rows",
+           "unpack_rows"]

@@ -103,6 +103,7 @@ from ..io import StagedStream
 from ..parallel.decode import Decoder
 from .capture import CaptureStream
 from .flight import FlightRecorder
+from .handoff import HANDOFF_DTYPES, KVHandoff, unpack_rows
 from .prefix import PrefixCache
 from .spec import NgramDrafter
 
@@ -229,6 +230,15 @@ _TM_WEIGHT_BYTES = tele.gauge("serving.weight_bytes")
 # auto). Engine-last-built semantics like serving.attn_impl.
 _TM_MATMUL_IMPL = tele.gauge("serving.matmul_impl")
 _TM_WEIGHT_GROUP = tele.gauge("serving.weight_group_size")
+# disaggregated prefill/decode (doc/serving.md "Disaggregated
+# prefill/decode"): info gauge for the engine's role (0 = unified,
+# 1 = prefill, 2 = decode; engine-last-built semantics like
+# serving.attn_impl) and the time a FINISHED prefill's package waited
+# between export-ready and decode-side admission — the queueing cost
+# the split adds in front of decode, observed by the router at
+# delivery
+_TM_ROLE = tele.gauge("serving.role")
+_TM_HANDOFF_WAIT = tele.histogram("serving.handoff_wait_ms")
 # compile_counts re-exported as telemetry: the in-engine log stays the
 # tested contract; these make recompiles visible in ONE snapshot next
 # to everything else
@@ -237,6 +247,7 @@ _TM_COMPILE_PREFILL = tele.counter("serving.compiles_prefill")
 _TM_COMPILE_COPY = tele.counter("serving.compiles_copy")
 _TM_COMPILE_VERIFY = tele.counter("serving.compiles_verify")
 _TM_COMPILE_DRAFT = tele.counter("serving.compiles_draft")
+_TM_COMPILE_HANDOFF = tele.counter("serving.compiles_handoff")
 # robustness counters (doc/observability.md): every abnormal retirement
 # path is visible in the same snapshot as the latencies it protects
 _TM_SHED = tele.counter("serving.shed")
@@ -672,7 +683,8 @@ class InferenceEngine:
                  draft_decoder=None, attn_impl=None, capture_dir=None,
                  capture_mb=None, tp=None, mesh=None,
                  weight_dtype=None, weight_group=None, matmul_impl=None,
-                 ep=None, engine_id=None, migrated_from=None):
+                 ep=None, engine_id=None, migrated_from=None,
+                 role=None, handoff_dtype=None):
         if not isinstance(decoder, Decoder):
             raise MXNetError("InferenceEngine needs a Decoder, got %r"
                              % type(decoder).__name__)
@@ -1016,6 +1028,39 @@ class InferenceEngine:
                 "'pallas' or 'fused', got %r (MXNET_SERVING_MATMUL_"
                 "IMPL sets the default)" % (matmul_impl,))
         self.matmul_impl = matmul_impl
+        # disaggregated prefill/decode (doc/serving.md "Disaggregated
+        # prefill/decode"): role gates which program families ever
+        # DISPATCH — a prefill engine runs admission + prefill only
+        # and hands finished KV off; a decode engine admits handoffs
+        # only and never traces a prefill program (a compile-memory
+        # win the compile contract pins). Purely a scheduler gate: the
+        # jit families are lazy, so nothing extra compiles either way.
+        if role is None:
+            role = os.environ.get("MXNET_SERVING_ROLE") or "unified"
+        if role not in ("unified", "prefill", "decode"):
+            raise MXNetError(
+                "InferenceEngine: role must be 'unified', 'prefill' "
+                "or 'decode', got %r (MXNET_SERVING_ROLE sets the "
+                "default)" % (role,))
+        if role != "unified" and self._windowed:
+            raise MXNetError(
+                "InferenceEngine: windowed-ring decoders do not "
+                "compose with role=%r — ring rows live at wrapped "
+                "positions, outside the [0, P) prefix contract the "
+                "KV handoff rows ride (slot_prefix_rows); serve "
+                "unified" % (role,))
+        self.role = role
+        if handoff_dtype is None:
+            handoff_dtype = os.environ.get(
+                "MXNET_SERVING_HANDOFF_DTYPE") or "native"
+        if handoff_dtype not in HANDOFF_DTYPES:
+            raise MXNetError(
+                "InferenceEngine: handoff_dtype must be one of %s, "
+                "got %r (MXNET_SERVING_HANDOFF_DTYPE sets the "
+                "default)" % (", ".join(map(repr, HANDOFF_DTYPES)),
+                              handoff_dtype))
+        self.handoff_dtype = handoff_dtype
+        _TM_ROLE.set({"unified": 0, "prefill": 1, "decode": 2}[role])
         slot_bytes = sum(x.nbytes for x in
                          jax.tree_util.tree_leaves(self._caches)) // S
         # per-shard KV residency (jax Array.nbytes is GLOBAL, so the
@@ -1182,6 +1227,16 @@ class InferenceEngine:
         self._watched = set()        # ids with a deadline / cancel mark
         self._done_buf = []          # finished since the last step()
         self._closed = False
+        # KV handoff state (role="prefill" exports, any non-prefill
+        # role imports): _handoff_out holds packaged finished prefills
+        # until the router resolves them; _handoff_slots are the cache
+        # slots those packages pin (out of _free but carrying no live
+        # request — idle/step accounting treats them as neither);
+        # _imported is a bounded id ring for exactly-once admission
+        # under retried deliveries
+        self._handoff_out = collections.deque()
+        self._handoff_slots = set()
+        self._imported = collections.OrderedDict()
         self.stats = {"submitted": 0, "completed": 0, "prefills": 0,
                       "steps": 0, "tokens": 0, "prefix_hits": 0,
                       "prefix_hit_tokens": 0, "prefill_chunks": 0,
@@ -1189,7 +1244,8 @@ class InferenceEngine:
                       "cancelled": 0, "errors": 0, "watchdog_trips": 0,
                       "restores": 0, "spec_rounds": 0,
                       "spec_fallback_rounds": 0, "spec_drafted": 0,
-                      "spec_accepted": 0}
+                      "spec_accepted": 0, "handoffs_out": 0,
+                      "handoffs_in": 0}
 
         # the compiled program families; the log records one tag
         # per TRACE (python side effects run at trace time only), so it
@@ -1222,6 +1278,7 @@ class InferenceEngine:
             donate_argnums=self._donate)
         self._prefill_fns = {}
         self._copy_fns = {}
+        self._handoff_fns = {}   # (bucket, write?) -> jitted row mover
         # speculative-decoding programs: ONE verify program (the whole
         # contract extension) plus, for draft="model", one draft
         # proposal program and a per-bucket draft prefill family
@@ -1647,6 +1704,84 @@ class InferenceEngine:
                  np.bool_(True), np.bool_(False)))
         self.stats["prefix_copies"] += 1
 
+    # -- KV handoff (disaggregated prefill/decode) ----------------------
+    def _handoff_fn(self, bucket, write=False):
+        """Per-bucket handoff row movers, jitted lazily like the copy
+        family: the EXPORT direction reads one slot's first ``bucket``
+        KV rows out of the serving cache (``Decoder.slot_prefix_rows``
+        — the same static-length/traced-slot contract the prefix pool
+        copies ride), the IMPORT direction writes host rows into one
+        slot (``slot_write_prefix_rows``, junk-row discipline
+        unchanged: rows past the request's position are never read).
+        Any one engine only ever fires ONE direction per bucket — a
+        prefill engine exports, everyone else imports — so the
+        ("handoff", bucket) compile tag stays once-per-bucket."""
+        key = (bucket, bool(write))
+        if key not in self._handoff_fns:
+            cs = self._cache_spec(self._caches)
+            if write:
+                def run(serv, slot, rows, _b=bucket):
+                    if not profiler.collecting():
+                        self._compile_log.append(("handoff", _b))
+                        _TM_COMPILE_HANDOFF.inc()
+                    return Decoder.slot_write_prefix_rows(serv, slot,
+                                                          rows)
+
+                self._handoff_fns[key] = jax.jit(
+                    self._wrap_tp(run, (cs, "r", cs), cs),
+                    donate_argnums=(0,) if self._donate else ())
+            else:
+                def run(serv, slot, _b=bucket):
+                    if not profiler.collecting():
+                        self._compile_log.append(("handoff", _b))
+                        _TM_COMPILE_HANDOFF.inc()
+                    return Decoder.slot_prefix_rows(serv, slot, _b)
+
+                # NO donation: the source cache must survive the read
+                # (other slots keep decoding against it)
+                self._handoff_fns[key] = jax.jit(
+                    self._wrap_tp(run, (cs, "r"), cs))
+        return self._handoff_fns[key]
+
+    def _export_rows(self, slot, length):
+        """Pull one slot's first ``length`` KV rows to host numpy
+        (rounded up to the covering bucket — the decode side clips by
+        position, so the pad rows are junk it never reads)."""
+        bucket = self._bucket_for(length)
+        tc0 = time.perf_counter()
+        with tele.span("serving.handoff_export", cat="serving",
+                       bucket=bucket):
+            rows = self._handoff_fn(bucket)(self._caches,
+                                            np.int32(slot))
+            rows = jax.tree_util.tree_map(np.asarray, rows)
+        self._phase_add("copy", time.perf_counter() - tc0)
+        if ("handoff", bucket, "export") not in self._prog_seen:
+            self._prog_seen.add(("handoff", bucket, "export"))
+            profiler.register_program(
+                "serving_handoff_b%d" % bucket,
+                self._handoff_fns[(bucket, False)],
+                (self._caches, np.int32(0)))
+        return rows
+
+    def _import_rows(self, slot, length, rows):
+        """Write transferred rows into ``slot`` through the
+        prefix-pool write path (dequantized to cache dtype first when
+        the transfer was int8)."""
+        bucket = self._bucket_for(length)
+        rows = unpack_rows(rows, self._caches)
+        tc0 = time.perf_counter()
+        with tele.span("serving.handoff_import", cat="serving",
+                       bucket=bucket):
+            self._caches = self._handoff_fn(bucket, write=True)(
+                self._caches, np.int32(slot), rows)
+        self._phase_add("copy", time.perf_counter() - tc0)
+        if ("handoff", bucket, "import") not in self._prog_seen:
+            self._prog_seen.add(("handoff", bucket, "import"))
+            profiler.register_program(
+                "serving_handoff_wr_b%d" % bucket,
+                self._handoff_fns[(bucket, True)],
+                (self._caches, np.int32(0), rows))
+
     @property
     def compile_counts(self):
         """{'decode': n, 'verify': n, 'prefill': {bucket: n},
@@ -1659,11 +1794,18 @@ class InferenceEngine:
         verify program serves every draft mix — drafts and their
         lengths are traced operands). Engines with ``draft="model"``
         additionally report ``'draft'`` (<= 1) and ``'draft_prefill'``
-        ({bucket: 1}). doc/serving.md."""
+        ({bucket: 1}). Engines that ever touched the KV handoff path
+        (role != "unified", or a unified engine that imported)
+        additionally report ``'handoff'`` ({bucket: 1} — one row mover
+        per bucket per engine; each engine only ever fires one
+        DIRECTION, so export and import never share a tag).
+        doc/serving.md."""
         out = {"decode": 0, "verify": 0, "prefill": {}, "copy": {}}
         if self.spec_draft == "model":
             out["draft"] = 0
             out["draft_prefill"] = {}
+        if self.role != "unified" or self._handoff_fns:
+            out["handoff"] = {}
         for tag in self._compile_log:
             if isinstance(tag, str):
                 out[tag] += 1
@@ -1736,10 +1878,14 @@ class InferenceEngine:
 
     @property
     def idle(self):
+        # handoff-pinned slots count as free here: the engine has no
+        # work left to STEP for them — delivery is the router's job,
+        # and FleetRouter.idle separately refuses to go idle while any
+        # replica still holds an unresolved package
         return not self._pending and self._stager.staged() == 0 \
             and self._held is None \
-            and len(self._free) == self.slots and not self._drain \
-            and not self._chunking
+            and len(self._free) + len(self._handoff_slots) == self.slots \
+            and not self._drain and not self._chunking
 
     def submit(self, prompt, max_tokens, eos_id=None, temperature=0.0,
                seed=None, request_id=None, deadline_ms=None,
@@ -1778,6 +1924,18 @@ class InferenceEngine:
             raise MXNetError(
                 "InferenceEngine: engine %s is draining — submit to "
                 "another replica" % self.engine_id)
+        if self.role == "decode":
+            # decode specialists admit work through admit_handoff
+            # ONLY: a fresh prompt — and equally a resumed/migrated
+            # one, which re-prefills prompt+tokens on the admitting
+            # engine — would trace the prefill family this role
+            # exists to avoid (the FleetRouter's role-aware placement
+            # never routes a submit here)
+            raise MXNetError(
+                "InferenceEngine: engine %s has role='decode' — "
+                "prompts go to a prefill or unified replica (the "
+                "FleetRouter's role-aware placement does this)"
+                % self.engine_id)
         # validate shape/dtype HERE, where the caller can see the
         # problem — a bad prompt forwarded to the compiled programs
         # surfaces as an opaque shape/dtype error rounds later;
@@ -1907,6 +2065,239 @@ class InferenceEngine:
         req._cancelled = True
         self._watched.add(request_id)
         return True
+
+    # -- KV handoff scheduler seams -------------------------------------
+    def _handoff_prefill(self, req, slot, t0, now):
+        """Prefill-role drain tail: the first token lands on the
+        request exactly as unified serving would land it (TTFT is
+        SERVED here — the decode side inherits it), then the finished
+        prefill is packaged for the router. The slot leaves the free
+        list into ``_handoff_slots`` — its KV rows must survive until
+        the package resolves — and the request retires locally with
+        ``retire_reason="handoff"`` (the FleetRequest facade treats
+        that as still-running)."""
+        self._push_token(req, slot, t0, now)
+        if req.done:
+            return          # eos / one-token limit on t0: completed
+                            # here, nothing left to hand off (the slot
+                            # was released by _push_token)
+        pkg = KVHandoff(self, req, slot)
+        self._handoff_slots.add(slot)
+        self._handoff_out.append(pkg)
+        self.stats["handoffs_out"] += 1
+        self.flight.event(req.id, "handoff_export", slot=slot,
+                          prefill_len=pkg.prefill_len)
+        self._finish(req, "handoff")
+
+    def take_handoffs(self):
+        """Drain the packaged finished prefills (router-facing). The
+        caller OWNS delivery: every returned package must eventually
+        be ``resolve()``d — delivered, deduped, or abandoned — or its
+        slot stays pinned forever."""
+        out = []
+        while self._handoff_out:
+            out.append(self._handoff_out.popleft())
+        return out
+
+    def _resolve_handoff(self, pkg):
+        """Release a package's slot, exactly once (KVHandoff.resolve
+        target). Double resolution is a transport-discipline bug —
+        refuse loudly rather than corrupt the free list."""
+        if pkg.resolved:
+            raise MXNetError(
+                "InferenceEngine: handoff package %r resolved twice — "
+                "each package has exactly one terminal path" % (pkg,))
+        pkg.resolved = True
+        if pkg.slot in self._handoff_slots:
+            self._handoff_slots.discard(pkg.slot)
+            self._release_slot(pkg.slot)
+
+    def set_role(self, role):
+        """Widen a specialist to ``"unified"`` (failover promotion:
+        the survivor of a dead prefill/decode pair serves both phases;
+        any program family it is missing compiles lazily on first
+        use). Narrowing a live engine is refused — slots may hold
+        state the narrower role could never have produced."""
+        if role == self.role:
+            return
+        if role != "unified":
+            raise MXNetError(
+                "InferenceEngine: role can only widen to 'unified' "
+                "(engine %s is %r, asked for %r) — build a new engine "
+                "to specialize" % (self.engine_id, self.role, role))
+        self.role = "unified"
+        _TM_ROLE.set(0)
+
+    def admit_handoff(self, payload, deadline_ms=None,
+                      ttft_deadline_ms=None):
+        """Admit a handed-off finished prefill (router-facing): write
+        the transferred KV rows into a free slot through the
+        prefix-pool write path — or skip the write entirely when
+        ``payload["rows"]`` is None because this engine's prefix pool
+        already retains the full prefill — poke the slot's scheduler
+        state to resume AFTER the prefill's first token, and continue
+        decoding byte-identically to a unified engine.
+
+        Exactly-once under retries: a package id already active or
+        already imported returns the existing request without touching
+        the cache (the router's retry ambiguity resolves here, the
+        ``_channel_submit`` adoption discipline). Raises
+        :class:`EngineOverloaded` when no slot is free — the router
+        tries the next decode replica or waits."""
+        self._check_open()
+        if self.role == "prefill":
+            raise MXNetError(
+                "InferenceEngine: engine %s has role='prefill' — it "
+                "exports handoffs, it cannot admit one"
+                % self.engine_id)
+        rid = payload["id"]
+        existing = self._active.get(rid)
+        if existing is not None:
+            return existing
+        existing = self._imported.get(rid)
+        if existing is not None:
+            return existing
+        # Flush every dispatched-but-undrained round BEFORE touching a
+        # slot: those rounds saw the slot device-dead (-1 sentinel) and
+        # must not drain after the mirror names the imported request —
+        # the same hazard the submit path avoids by deferring its
+        # mirror write to prefill-drain time. Draining may also retire
+        # finished requests and free slots, so it runs before the
+        # overload check.
+        while self._drain:
+            self._drain_one()
+        if not self._free:
+            raise EngineOverloaded(
+                "InferenceEngine: engine %s has no free slot for a "
+                "handoff (slots=%d busy)" % (self.engine_id,
+                                             self.slots))
+        prompt = np.asarray(payload["prompt"], np.int32)
+        tokens = [int(t) for t in payload["tokens"]]
+        if not tokens:
+            raise MXNetError(
+                "InferenceEngine: handoff payload %r carries no first "
+                "token — the prefill side emits it" % (rid,))
+        req = Request(rid, prompt, int(payload["max_tokens"]),
+                      payload["eos_id"], float(payload["temperature"]),
+                      int(payload["seed"]),
+                      min(int(payload["max_tokens"]),
+                          self.max_len - prompt.size),
+                      deadline_ms=deadline_ms,
+                      ttft_deadline_ms=ttft_deadline_ms,
+                      resume_tokens=tokens)
+        # TTFT was served on the prefill engine; mark it attained so
+        # cadence math never divides by a first-token gap this engine
+        # did not serve
+        req.t_first = req.t_submit
+        P = int(payload["prefill_len"])
+        if P != len(req.seq) - 1:
+            raise MXNetError(
+                "InferenceEngine: handoff payload %r is inconsistent — "
+                "prefill_len=%d but prompt+tokens cover %d positions "
+                "(+1 for the first emitted token)"
+                % (rid, P, len(req.seq)))
+        if P > self.prefill_buckets[-1] or payload["last"] >= self.max_len:
+            raise MXNetError(
+                "InferenceEngine: handoff %r does not fit this "
+                "engine's geometry (prefill_len=%d, last=%d vs "
+                "buckets %r, max_len=%d) — replicas in one fleet share "
+                "geometry" % (rid, P, payload["last"],
+                              self.prefill_buckets, self.max_len))
+        slot = self._free.popleft()
+        req.t_admit = time.perf_counter()
+        rows = payload.get("rows")
+        entry = None
+        try:
+            if rows is None:
+                # transfer skipped on prefix affinity: the router saw
+                # this engine's pool retaining the full prefill. The
+                # pin brackets the copy dispatch (PR 7 discipline).
+                if self._prefix is None:
+                    raise MXNetError(
+                        "InferenceEngine: rows-less handoff %r but "
+                        "engine %s has no prefix pool"
+                        % (rid, self.engine_id))
+                depth, entry = self._prefix.lookup(req.seq[:P])
+                if depth < P or entry is None:
+                    raise MXNetError(
+                        "InferenceEngine: rows-less handoff %r but "
+                        "the pool covers only %d of %d prefill "
+                        "positions — the router's affinity probe was "
+                        "stale; retry with rows" % (rid, depth, P))
+                self._prefix.acquire(entry)
+                self._dispatch_copy(P, src=entry.slot, dst=slot,
+                                    src_pool=True, dst_pool=False)
+                self._prefix.release(entry)
+                entry = None
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_hit_tokens"] += P
+                _TM_PREFIX_HITS.inc()
+                _TM_PREFIX_HIT_TOKENS.inc(P)
+            else:
+                self._import_rows(slot, P, rows)
+            # scheduler-state poke: resume exactly where the unified
+            # engine's prefill program would have left this slot
+            # (pos=P, tok=t_last, live, the sampling identity, and the
+            # same lastp clamp _prefill_fn computes)
+            vals = (np.int32(P), np.int32(tokens[-1]), True,
+                    np.float32(req.temperature), _raw_key(req.seed),
+                    np.int32(-1 if req.eos_id is None else req.eos_id),
+                    np.int32(payload["last"]))
+            new_state = Decoder.slot_set_state(self._state, slot, vals)
+            if self._mesh is not None:
+                new_state = tuple(
+                    jax.device_put(a, self._rep_shard)
+                    for a in new_state)
+            self._state = new_state
+        except Exception:
+            if entry is not None:
+                self._prefix.release(entry)
+            self._release_slot(slot)
+            self._free.remove(slot)      # popleft put-back, FIFO head
+            self._free.appendleft(slot)
+            raise
+        self._mirror[slot] = req
+        self._active[rid] = req
+        if req._deadline is not None or req._ttft_deadline is not None:
+            self._watched.add(rid)
+        if self.spec_draft == "ngram":
+            self._drafters[rid] = NgramDrafter(req.seq)
+        elif self.spec_draft == "model":
+            self._draft_prefill_all(req, slot)
+        # decode-side retention: park the prefill in THIS engine's
+        # pool so the next same-prefix handoff ships no rows at all
+        # (the router's affinity probe finds it via peek)
+        if rows is not None and self._prefix is not None \
+                and not self._pressure \
+                and P <= self.prefill_buckets[-1] \
+                and self._prefix.get(req.seq[:P]) is None:
+            try:
+                new = self._prefix.insert(req.seq[:P])
+                if new is not None:
+                    try:
+                        self._dispatch_copy(P, src=slot, dst=new.slot,
+                                            src_pool=False,
+                                            dst_pool=True)
+                    except Exception:
+                        self._prefix.discard(new)
+                        raise
+                _TM_PREFIX_BYTES.set(self._prefix.bytes_used)
+            except Exception:            # noqa: BLE001 — isolated
+                _TM_PREFIX_INSERT_SKIPPED.inc()
+        self.stats["handoffs_in"] += 1
+        self.stats["submitted"] += 1
+        self.capture.submit(req)
+        if self.flight.enabled:
+            self.flight.start(rid, prompt_len=int(prompt.size),
+                              max_tokens=int(payload["max_tokens"]),
+                              handoff=True, resumed=req.resumed)
+            self.flight.event(rid, "handoff_import", slot=slot,
+                              prefill_len=P,
+                              rows=rows is not None)
+        self._imported[rid] = req
+        while len(self._imported) > 256:
+            self._imported.popitem(last=False)
+        return req
 
     # -- lifecycle: retirement, shedding, shutdown ----------------------
     def _check_open(self):
@@ -2337,7 +2728,9 @@ class InferenceEngine:
         return True
 
     def _busy(self):
-        return (self.slots - len(self._free)) > 0 or bool(self._pending) \
+        return (self.slots - len(self._free)
+                - len(self._handoff_slots)) > 0 \
+            or bool(self._pending) \
             or self._stager.staged() > 0 or self._held is not None
 
     def _push_token(self, req, slot, t, now):
@@ -2445,6 +2838,10 @@ class InferenceEngine:
             if req.done:
                 return               # host-retired while staged: the
                                      # slot was already released
+            if self.role == "prefill":
+                self._handoff_prefill(req, slot, int(np.asarray(t0)),
+                                      now)
+                return
             self._mirror[slot] = req
             self._push_token(req, slot, int(np.asarray(t0)), now)
         elif entry[0] == "verify":
@@ -2689,8 +3086,12 @@ class InferenceEngine:
                 # fully-idle polls are not a scheduling round
                 _TM_ADMITTED.observe(admitted)
             # slots still mid-prefill have nothing to decode: a round
-            # with ONLY those resident would be pure wasted dispatch
-            if busy - len(self._chunking) > 0:
+            # with ONLY those resident would be pure wasted dispatch.
+            # Handoff-pinned slots likewise (their requests left), and
+            # a prefill-role engine NEVER dispatches the decode family
+            # — that is the role's compile contract
+            if busy - len(self._chunking) - len(self._handoff_slots) > 0 \
+                    and self.role != "prefill":
                 if self._spec and self._spec_round(busy):
                     dispatched = "verify"
                 else:
@@ -2723,8 +3124,13 @@ class InferenceEngine:
                     flt = _SERVING_FAULTS
                     if flt is not None:
                         flt.serving_crash()   # injected process death
-            while len(self._drain) > (self._drain_depth if self._busy()
-                                      else 0):
+            # a prefill-role engine drains eagerly: no decode rounds
+            # follow to push results out of the drain-lag window, and
+            # every drained prefill is a handoff package the router is
+            # waiting on
+            while len(self._drain) > (
+                    self._drain_depth
+                    if self._busy() and self.role != "prefill" else 0):
                 self._drain_one()
             self._last_ok_t = time.perf_counter()
             self._slo_tick(self._last_ok_t)
@@ -2831,10 +3237,12 @@ class InferenceEngine:
             "closed": self._closed,
             "stuck": self._watchdog_stuck_t is not None,
             "draining": self.draining,
+            "role": self.role,
             "watchdog_trips": self.stats["watchdog_trips"],
             "slots": self.slots,
             "slots_busy": self.slots - len(self._free),
             "queued": self.queued(),
+            "handoffs_waiting": len(self._handoff_out),
             "last_round_age_s": round(now - self._last_ok_t, 3),
         }
 
@@ -2960,6 +3368,15 @@ class InferenceEngine:
         self._chunking.clear()
         self._held = None
         self._drain.clear()
+        # outbound handoff packages die with the engine: mark them
+        # resolved so a router holding one cannot release the slot of
+        # (or deliver rows from) a closed engine, and free the pinned
+        # slots directly
+        while self._handoff_out:
+            self._handoff_out.popleft().resolved = True
+        for slot in sorted(self._handoff_slots):
+            self._release_slot(slot)
+        self._handoff_slots.clear()
         self._stager.close()
         self.capture.close()
 
@@ -3003,6 +3420,24 @@ class InferenceEngine:
                 if req._ttft_deadline is None or req.t_first is not None
                 else (req._ttft_deadline - now) * 1e3,
             })
+        # packaged-but-undelivered handoffs: locally retired, but the
+        # work is NOT done — a restore (or the fleet failover path)
+        # re-prefills prompt + the already-emitted first token and
+        # serves the remainder unified, byte-identically
+        for pkg in self._handoff_out:
+            if pkg.resolved:
+                continue
+            reqs.append({
+                "id": pkg.id,
+                "prompt": pkg.prompt.tolist(),
+                "tokens": list(pkg.tokens),
+                "max_tokens": int(pkg.max_tokens),
+                "eos_id": pkg.eos_id,
+                "temperature": float(pkg.temperature),
+                "seed": int(pkg.seed),
+                "deadline_ms": None,
+                "ttft_deadline_ms": None,
+            })
         return {
             "version": 1,
             "auto_seed": self._auto_seed,
@@ -3045,6 +3480,8 @@ class InferenceEngine:
             "weight_dtype": self.weight_dtype,
             "weight_group": self.weight_group,
             "matmul_impl": self.matmul_impl,
+            "role": self.role,
+            "handoff_dtype": self.handoff_dtype,
             "capture_dir": getattr(self, "capture_dir", None),
         }
 

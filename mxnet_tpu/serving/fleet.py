@@ -54,11 +54,25 @@ Knobs (constructor args override the ``MXNET_FLEET_*`` environment
 defaults — doc/env_var.md): ``timeout_ms``, ``max_retries``,
 ``backoff_ms``, ``heartbeat_ms``, ``heartbeat_misses``.
 
+**Disaggregated prefill/decode** (doc/serving.md "Disaggregated
+prefill/decode"): with role-specialized replicas
+(``InferenceEngine(role=...)``) the router places fresh prompts on
+prefill/unified replicas only, collects each finished prefill's
+:class:`~mxnet_tpu.serving.handoff.KVHandoff` package, and delivers it
+to the least-loaded decode-capable replica — consulting decode-side
+prefix affinity first, so a pool hit ships NO rows at all. Delivery
+rides the same transport discipline as submits (timeout, bounded
+retries, exactly-once via the target's import dedup), and losing
+either specialist falls back to unified serving on the survivor (the
+failover path widens its role).
+
 Observability: ``fleet.failovers``, ``fleet.drains``,
 ``fleet.migrated_requests``, ``fleet.retries``, ``fleet.dedup_hits``,
-``fleet.heartbeat_misses``, ``fleet.affinity_hits`` counters and the
-``fleet.replicas_live`` gauge (doc/observability.md);
-``tools/dump_telemetry.py --fleet`` prints the one-line summary.
+``fleet.heartbeat_misses``, ``fleet.affinity_hits``,
+``fleet.handoff_count``/``fleet.handoff_bytes`` counters, the
+``fleet.handoff_ms`` histogram and the ``fleet.replicas_live`` gauge
+(doc/observability.md); ``tools/dump_telemetry.py --fleet`` prints the
+one-line summary.
 
 Fault injection: ``mxnet_tpu.testing.faults`` installs itself as
 :data:`_FLEET_FAULTS` and drives the router's seams deterministically
@@ -77,7 +91,8 @@ import numpy as np
 
 from .. import telemetry as tele
 from ..base import MXNetError
-from .engine import EngineClosed, EngineOverloaded, EngineStuck
+from .engine import (EngineClosed, EngineOverloaded, EngineStuck,
+                     _TM_HANDOFF_WAIT)
 
 __all__ = ["FleetRouter", "FleetRequest"]
 
@@ -122,6 +137,13 @@ _TM_DEDUP = tele.counter("fleet.dedup_hits")
 _TM_HB_MISSES = tele.counter("fleet.heartbeat_misses")
 _TM_AFFINITY = tele.counter("fleet.affinity_hits")
 _TM_LIVE = tele.gauge("fleet.replicas_live")
+# KV handoff (disaggregated prefill/decode): delivered packages, the
+# bytes that actually shipped (0 for pool-hit skips), and per-delivery
+# channel time; serving.handoff_wait_ms (engine module) gets the
+# export-ready -> admitted wait observed here at delivery
+_TM_HANDOFF_COUNT = tele.counter("fleet.handoff_count")
+_TM_HANDOFF_BYTES = tele.counter("fleet.handoff_bytes")
+_TM_HANDOFF_MS = tele.histogram("fleet.handoff_ms")
 
 
 class FleetRequest:
@@ -175,7 +197,11 @@ class FleetRequest:
     def done(self):
         if self._error is not None or self._cancelled:
             return True
-        return self._cur is not None and self._cur.done
+        # "handoff" is a LOCAL retirement only: the prefill replica is
+        # finished with the request, the fleet is not — the package is
+        # in transit to a decode replica
+        return self._cur is not None and self._cur.done \
+            and self._cur.retire_reason != "handoff"
 
     @property
     def retire_reason(self):
@@ -184,7 +210,9 @@ class FleetRequest:
                 else "error"
         if self._cancelled:
             return "cancelled"
-        return None if self._cur is None else self._cur.retire_reason
+        if self._cur is None or self._cur.retire_reason == "handoff":
+            return None
+        return self._cur.retire_reason
 
     @property
     def replica_id(self):
@@ -312,6 +340,7 @@ class FleetRouter:
         self._order = 0
         self._requests = {}                # id -> FleetRequest (live)
         self._held = collections.deque()   # awaiting re-placement
+        self._handoffs = collections.deque()  # (pkg, fr) in transit
         self._dedup = {}                   # (client_id, seq) -> handle
         self._next_id = 0
         self._closed = False
@@ -383,8 +412,15 @@ class FleetRouter:
 
     @property
     def idle(self):
-        return not self._held and all(r.engine.idle
-                                      for r in self._live())
+        # a package awaiting delivery (router-side or still inside a
+        # prefill replica's outbox) is outstanding work: the fleet
+        # must keep stepping until it lands or falls back
+        if self._held or self._handoffs:
+            return False
+        for r in self._live():
+            if not r.engine.idle or r.engine._handoff_out:
+                return False
+        return True
 
     def health(self):
         """Fleet liveness: per-replica ``health()`` dicts (dead ones
@@ -400,6 +436,7 @@ class FleetRouter:
             "replicas": reps,
             "replicas_live": len(self._live()),
             "held": len(self._held),
+            "handoffs_in_transit": len(self._handoffs),
         }
 
     def _check_open(self):
@@ -501,8 +538,11 @@ class FleetRouter:
     def _ranked(self, fr):
         """Placement order: deepest prefix-affinity first, then least
         loaded, then rotation order. Counts an affinity hit when a
-        retained prefix actually decided placement."""
-        cands = self._candidates()
+        retained prefix actually decided placement. Decode specialists
+        never take fresh prompts — their whole point is to never trace
+        a prefill program."""
+        cands = [r for r in self._candidates()
+                 if getattr(r.engine, "role", "unified") != "decode"]
         if not cands:
             return []
         prompt = fr._rec["prompt"]
@@ -592,6 +632,218 @@ class FleetRouter:
         raise ConnectionError("fleet channel: replica %r failed (%s)"
                               % (rep.id, last_err))  # pragma: no cover
 
+    # -- KV handoff (disaggregated prefill/decode) ----------------------
+    @staticmethod
+    def _pool_covers(engine, pkg):
+        """Does this replica's prefix pool retain the package's FULL
+        prefill? Then delivery ships identity only — the target copies
+        the rows out of its own pool (peek: no LRU touch, no pin; the
+        engine re-walks and pins at admission)."""
+        pc = getattr(engine, "_prefix", None)
+        if pc is None:
+            return False
+        return pc.peek(pkg.prefill_seq) >= pkg.prefill_len
+
+    def _ranked_decode(self, pkg):
+        """Delivery order for one package: decode-capable replicas
+        (never prefill specialists), full-pool-affinity first — a hit
+        skips the row transfer entirely — then least loaded, then
+        rotation order."""
+        scored = []
+        for rep in self._candidates():
+            if getattr(rep.engine, "role", "unified") == "prefill":
+                continue
+            h = rep.engine.health()
+            load = h.get("queued", 0) + h.get("slots_busy", 0)
+            scored.append((0 if self._pool_covers(rep.engine, pkg)
+                           else 1, load, rep.order, rep))
+        scored.sort(key=lambda t: t[:3])
+        if scored and scored[0][0] == 0:
+            self.stats["affinity_hits"] += 1
+            _TM_AFFINITY.inc()
+        return [t[3] for t in scored]
+
+    def _collect_handoffs(self):
+        """Sweep every live replica's handoff outbox into the router's
+        in-transit queue. Packages whose fleet handle already retired
+        (cancelled / errored while the prefill ran) resolve on the
+        spot — the source slot frees, nothing ships."""
+        for rep in list(self._replicas.values()):
+            if not rep.alive or rep.engine._closed \
+                    or not rep.engine._handoff_out:
+                continue
+            for pkg in rep.engine.take_handoffs():
+                fr = self._requests.get(pkg.id)
+                if fr is None or fr.done:
+                    with contextlib.suppress(Exception):
+                        pkg.resolve()
+                    continue
+                self._handoffs.append((pkg, fr))
+
+    def _channel_handoff(self, rep, pkg, fr):
+        """Deliver one package over the replica channel with the same
+        transport discipline as ``_channel_submit``: per-op timeout
+        (the ``fleet_handoff`` fault hook is the injected network),
+        bounded backoff + jitter, ping-probe after a timeout, and
+        exactly-once — a retried delivery whose first attempt landed
+        finds the admitted request by id on the target (the target's
+        own import dedup backs this up). Returns ``(request,
+        shipped_bytes, pool_hit)``; raises ``ConnectionError`` when
+        the budget is exhausted."""
+        eng = rep.engine
+        skip = self._pool_covers(eng, pkg)
+        kw = {}
+        if fr._deadline_abs is not None:
+            kw["deadline_ms"] = \
+                (fr._deadline_abs - time.perf_counter()) * 1e3
+        backoff = self.backoff_s
+        last_err = None
+        for attempt in range(self.max_retries + 1):
+            flt = _FLEET_FAULTS
+            try:
+                if flt is not None:
+                    delay = flt.fleet_handoff(rep.id)
+                    if delay and delay * 1e3 > self.timeout_ms:
+                        raise TimeoutError(
+                            "fleet channel: KV handoff to %r exceeded "
+                            "timeout_ms=%g" % (rep.id, self.timeout_ms))
+                t0 = time.perf_counter()
+                req = eng.admit_handoff(pkg.payload(with_rows=not skip),
+                                        **kw)
+                _TM_HANDOFF_MS.observe(
+                    (time.perf_counter() - t0) * 1e3)
+                return req, (0 if skip else pkg.nbytes), skip
+            except (ConnectionError, TimeoutError) as e:
+                last_err = e
+                existing = eng._active.get(pkg.id)
+                if existing is not None:
+                    return existing, (0 if skip else pkg.nbytes), skip
+                alive = isinstance(e, TimeoutError) \
+                    and self._ping(rep)
+                if attempt >= self.max_retries:
+                    raise ConnectionError(
+                        "fleet channel: replica %r %s after %d handoff "
+                        "attempt(s) (%s)"
+                        % (rep.id,
+                           "is alive but slow" if alive
+                           else "is unreachable or died",
+                           attempt + 1, e))
+                self.stats["retries"] += 1
+                _TM_RETRIES.inc()
+                if not alive:
+                    delay = backoff * (2 ** attempt)
+                    time.sleep(min(
+                        delay * (0.5 + self._rng.random()), 0.5))
+        raise ConnectionError(
+            "fleet channel: replica %r failed handoff (%s)"
+            % (rep.id, last_err))  # pragma: no cover
+
+    def _deliver_handoffs(self):
+        """One delivery pass over the in-transit queue. Each package
+        tries every decode-capable replica in affinity/load order; all
+        slots busy → it keeps waiting (serving.handoff_wait_ms is
+        exactly this wait); NO decode-capable replica left → unified
+        fallback: the package is abandoned and the request re-prefills
+        on whatever survives via the hold queue, byte-identically."""
+        fell_back = False
+        for _ in range(len(self._handoffs)):
+            pkg, fr = self._handoffs.popleft()
+            if pkg.resolved:
+                continue
+            if fr.done or fr._cur is None \
+                    or fr._cur.retire_reason != "handoff":
+                # cancelled, errored, or already re-placed (the
+                # source failed over and the fallback path took it):
+                # this package has nothing left to deliver
+                with contextlib.suppress(Exception):
+                    pkg.resolve()
+                continue
+            placed = False
+            for rep in self._ranked_decode(pkg):
+                try:
+                    req, nbytes, pool_hit = \
+                        self._channel_handoff(rep, pkg, fr)
+                except EngineOverloaded:
+                    continue               # no free slot: next replica
+                except EngineClosed:
+                    self._fail_over(rep, "closed underneath the router")
+                    continue
+                except ConnectionError:
+                    self._fail_over(rep, "channel dead during KV "
+                                         "handoff")
+                    continue
+                except MXNetError:
+                    continue               # refused (geometry/stale)
+                _TM_HANDOFF_WAIT.observe(
+                    (time.perf_counter() - pkg.t_ready) * 1e3)
+                fr._point_at(req, rep.id)
+                pkg.resolve()
+                self.stats["handoffs"] += 1
+                _TM_HANDOFF_COUNT.inc()
+                if pool_hit:
+                    self.stats["handoff_pool_hits"] += 1
+                else:
+                    self.stats["handoff_bytes"] += nbytes
+                    _TM_HANDOFF_BYTES.inc(nbytes)
+                placed = True
+                break
+            if placed:
+                continue
+            if any(getattr(r.engine, "role", "unified") != "prefill"
+                   for r in self._candidates()):
+                # decode capacity exists but is full right now: keep
+                # waiting (the wait histogram is measuring this)
+                self._handoffs.append((pkg, fr))
+            else:
+                with contextlib.suppress(Exception):
+                    pkg.resolve()
+                fr._unhook({"tokens": pkg.tokens})
+                self._held.append(fr)
+                self.stats["handoff_fallbacks"] += 1
+                fell_back = True
+        if fell_back:
+            self._ensure_roles()
+            self._drain_held()
+
+    def _abandon_handoffs(self, rep):
+        """A replica is dying: packages IT exported cannot deliver
+        (their rows live in its cache) — unhook their requests onto
+        the hold queue for a unified re-prefill on the survivors."""
+        for _ in range(len(self._handoffs)):
+            pkg, fr = self._handoffs.popleft()
+            if pkg.source is not rep.engine:
+                self._handoffs.append((pkg, fr))
+                continue
+            with contextlib.suppress(Exception):
+                pkg.resolve()
+            if not fr.done:
+                fr._unhook({"tokens": pkg.tokens})
+                self._held.append(fr)
+                self.stats["handoff_fallbacks"] += 1
+
+    def _ensure_roles(self):
+        """Failover role repair: when the fleet has lost every replica
+        of one phase (all survivors are the same specialist), widen
+        the least-loaded survivor to unified so both phases keep
+        serving — the missing program family compiles lazily on first
+        use. No-op while a unified replica or both specialists are
+        live."""
+        live = self._live()
+        roles = {getattr(r.engine, "role", "unified") for r in live}
+        if not live or "unified" in roles \
+                or ("prefill" in roles and "decode" in roles):
+            return
+
+        def load(r):
+            h = r.engine.health()
+            return (h.get("queued", 0) + h.get("slots_busy", 0),
+                    r.order)
+
+        target = min(live, key=load)
+        with contextlib.suppress(Exception):
+            target.engine.set_role("unified")
+            self.stats["role_promotions"] += 1
+
     # -- heartbeats / liveness ------------------------------------------
     def _ping(self, rep):
         """One heartbeat probe: False = no answer (a blackholed or
@@ -631,9 +883,14 @@ class FleetRouter:
             snap = rep.engine.snapshot()
         except Exception:
             snap = {"requests": []}
+        # in-transit packages this replica exported die with it (their
+        # rows live in its cache); packages still in its outbox ride
+        # the snapshot into _detach — disjoint sets, no double-hold
+        self._abandon_handoffs(rep)
         self._detach(snap)
         with contextlib.suppress(Exception):
             rep.engine.close()
+        self._ensure_roles()
         self._drain_held()
 
     def drain(self, replica):
@@ -657,9 +914,11 @@ class FleetRouter:
         _TM_LIVE.set(len(self._live()))
         self.stats["drains"] += 1
         _TM_DRAINS.inc()
+        self._abandon_handoffs(rep)
         self._detach(snap)
         with contextlib.suppress(Exception):
             rep.engine.close()
+        self._ensure_roles()
         self._drain_held()
         return snap
 
@@ -744,6 +1003,8 @@ class FleetRouter:
                 raise                      # a bug, not a death
             except Exception:              # InjectedCrash / SIGKILL
                 self._fail_over(rep, "died mid-round")
+        self._collect_handoffs()
+        self._deliver_handoffs()
         if self._requests and not self.stats["steps"] % 16:
             self._requests = {k: v for k, v in self._requests.items()
                               if not v.done}
@@ -786,6 +1047,13 @@ class FleetRouter:
         fr = self._requests.get(request_id)
         if fr is None or fr.done:
             return False
+        if fr._cur is not None \
+                and fr._cur.retire_reason == "handoff":
+            # in transit between replicas: mark cancelled here; the
+            # next delivery pass sees ``done`` and resolves the
+            # package (source slot freed, nothing admitted)
+            fr._cancelled = True
+            return True
         if fr._cur is not None:
             rep = self._replicas.get(fr._replica_id)
             if rep is not None and rep.alive \
@@ -813,6 +1081,12 @@ class FleetRouter:
                            "request was re-placed")
         while self._held:
             fr = self._held.popleft()
+            if not fr.done:
+                fr._error = err
+        while self._handoffs:
+            pkg, fr = self._handoffs.popleft()
+            with contextlib.suppress(Exception):
+                pkg.resolve()
             if not fr.done:
                 fr._error = err
         _TM_LIVE.set(0)

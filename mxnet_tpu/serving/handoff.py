@@ -1,0 +1,207 @@
+"""KV handoff between role-specialized serving engines.
+
+Disaggregated prefill/decode serving (the Splitwise/DistServe shape)
+splits the two phases of a request across SPECIALIST engines: a
+prefill-role engine runs admission + bucketed/chunked prefill only and
+then hands the finished request — its live KV rows, sampling identity,
+and the first emitted token — to a decode-role engine, which continues
+it byte-identically to what one unified engine would have produced.
+Long-prompt prefill rounds then never share a dispatch queue with
+anyone's decode cadence, which is the whole point: decode p99
+isolation under a long-prompt adversarial mix.
+
+This module is the WIRE FORMAT half of that split, deliberately free
+of any scheduler knowledge:
+
+* :class:`KVHandoff` — one packaged finished-prefill. It pins the
+  source engine's slot until the router confirms delivery (or gives up
+  and falls back to unified serving), exports the slot's KV rows
+  lazily exactly once (retries re-serialize the cached export rather
+  than touching the source cache again), and carries everything the
+  decode side needs to resume: prompt, emitted tokens (including the
+  prefill's first sampled token), sampling identity (temperature +
+  resolved seed), eos/limit bounds, and the prefill length ``P`` whose
+  rows the payload covers.
+* :func:`pack_rows` / :func:`unpack_rows` — the transfer encoding.
+  ``native`` ships rows at cache dtype; ``int8`` quantizes float rows
+  per-row symmetric (amax/127 scales, the PR 15 tolerance contract) at
+  about half the fp bytes. Integer cache leaves (an int8 KV cache) are
+  already compact and always pass through, so int8 KV serialises at
+  half the fp bytes with NO opt-in needed.
+
+The scheduler half (role gating, export/import programs, exactly-once
+admission) lives in :mod:`.engine`; placement, transport discipline,
+and failure fallback live in :mod:`.fleet`.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+
+from ..base import MXNetError
+
+__all__ = ["KVHandoff", "pack_rows", "unpack_rows", "HANDOFF_DTYPES"]
+
+HANDOFF_DTYPES = ("native", "int8")
+
+
+class _Quant:
+    """One int8-quantized cache leaf: ``q`` (int8 rows) plus per-row
+    f32 ``scale``. A plain class — NOT a pytree node — so tree_map
+    over a packed payload treats it as a leaf."""
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    @property
+    def nbytes(self):
+        return self.q.nbytes + self.scale.nbytes
+
+    def __repr__(self):
+        return "_Quant(shape=%s, nbytes=%d)" % (self.q.shape, self.nbytes)
+
+
+def _quantize(rows):
+    """Per-row symmetric int8: scale over every axis but the row axis
+    (axis 0 of an exported ``[rows, ...]`` leaf), amax/127 with a zero
+    guard, round-and-clip. Matches the PR 15 weight-quant contract."""
+    x = np.asarray(rows, np.float32)
+    axes = tuple(range(1, x.ndim))
+    scale = np.max(np.abs(x), axis=axes, keepdims=True) / 127.0
+    scale = np.where(scale == 0.0, np.float32(1.0), scale).astype(np.float32)
+    q = np.clip(np.rint(x / scale), -127, 127).astype(np.int8)
+    return _Quant(q, scale)
+
+
+def pack_rows(rows, dtype):
+    """Encode an exported host-side cache-row tree for transfer.
+
+    ``native`` passes every leaf through as-is; ``int8`` replaces each
+    FLOAT leaf (f32/bf16 caches) with a :class:`_Quant` and leaves
+    integer leaves (already-int8 KV) untouched. Returns
+    ``(payload_tree, nbytes)`` where nbytes is what actually ships.
+    """
+    if dtype not in HANDOFF_DTYPES:
+        raise MXNetError("pack_rows: unknown handoff dtype %r (one of %s)"
+                         % (dtype, ", ".join(HANDOFF_DTYPES)))
+
+    def enc_leaf(x):
+        host = np.asarray(x)
+        if dtype == "int8" and jax.numpy.issubdtype(x.dtype,
+                                                    jax.numpy.floating):
+            return _quantize(host)
+        return host
+
+    payload = jax.tree_util.tree_map(enc_leaf, rows)
+    nbytes = sum(leaf.nbytes
+                 for leaf in jax.tree_util.tree_leaves(payload))
+    return payload, int(nbytes)
+
+
+def unpack_rows(payload, template):
+    """Decode a packed payload back to cache-dtype rows. ``template``
+    is any tree with the SAME treedef as the payload whose leaf dtypes
+    are the destination cache dtypes (the importing engine passes its
+    live cache tree). Dequantized rows land at the template dtype, so
+    an fp cache that opted into int8 transfer absorbs the quantization
+    error here — once, before the write — and an int8 cache's integer
+    leaves come back bit-exact."""
+    def dec(x, ref):
+        if isinstance(x, _Quant):
+            return (x.q.astype(np.float32) * x.scale).astype(ref.dtype)
+        return np.asarray(x)
+
+    return jax.tree_util.tree_map(
+        dec, payload, template,
+        is_leaf=lambda x: isinstance(x, _Quant))
+
+
+class KVHandoff:
+    """One finished prefill packaged for delivery to a decode engine.
+
+    Created by the source engine at the end of a prefill-role
+    request's prefill round (``InferenceEngine._handoff_prefill``); the
+    slot named here stays OUT of the source's free list until
+    :meth:`resolve` runs — exactly once, on whichever terminal path
+    the router drives the package down (delivered, deduped after a
+    retry, or abandoned to unified fallback).
+    """
+
+    __slots__ = ("id", "prompt", "tokens", "max_tokens", "eos_id",
+                 "temperature", "seed", "prefill_len", "last",
+                 "prefill_seq", "slot", "source", "resolved",
+                 "t_ready", "_packed", "_nbytes")
+
+    def __init__(self, engine, req, slot):
+        self.id = req.id
+        self.prompt = np.asarray(req.prompt, np.int32)
+        # tokens includes the first emitted token t0 (and any tokens a
+        # prior resume carried in) — the decode side resumes AFTER it.
+        self.tokens = [int(t) for t in req.tokens]
+        self.max_tokens = int(req.max_tokens)
+        self.eos_id = req.eos_id
+        self.temperature = float(req.temperature)
+        self.seed = int(req.seed)
+        # P: positions covered by the exported rows == len(req.seq)
+        # (prompt + previously-resumed tokens; t0 is sampled FROM the
+        # last prefill logits and has no KV row yet).
+        self.prefill_len = int(req.seq.size)
+        # absolute last position, same clamp as _prefill_fn's lastp
+        self.last = min(self.prefill_len + (req.limit - req.resumed) - 1,
+                        engine.max_len - 1)
+        self.prefill_seq = np.asarray(
+            np.concatenate([self.prompt,
+                            np.asarray(self.tokens[:-1], np.int32)])
+            if len(self.tokens) > 1 else self.prompt, np.int32)
+        self.slot = int(slot)
+        self.source = engine
+        self.resolved = False
+        self.t_ready = time.perf_counter()
+        self._packed = None
+        self._nbytes = 0
+
+    def materialize(self):
+        """Export + pack the KV rows, once; cached so a retried or
+        re-routed delivery never re-reads the source cache."""
+        if self._packed is None:
+            rows = self.source._export_rows(self.slot, self.prefill_len)
+            self._packed, self._nbytes = pack_rows(
+                rows, self.source.handoff_dtype)
+        return self._packed
+
+    @property
+    def nbytes(self):
+        self.materialize()
+        return self._nbytes
+
+    def payload(self, with_rows=True):
+        """The admission dict ``InferenceEngine.admit_handoff`` takes.
+        ``with_rows=False`` ships identity only — the router uses it
+        when the target's prefix pool already retains the full
+        prefill, so the transfer is skipped entirely."""
+        return {
+            "id": self.id,
+            "prompt": self.prompt,
+            "tokens": list(self.tokens),
+            "max_tokens": self.max_tokens,
+            "eos_id": self.eos_id,
+            "temperature": self.temperature,
+            "seed": self.seed,
+            "prefill_len": self.prefill_len,
+            "last": self.last,
+            "rows": self.materialize() if with_rows else None,
+        }
+
+    def resolve(self):
+        """Release the source-side slot (exactly once)."""
+        self.source._resolve_handoff(self)
+
+    def __repr__(self):
+        return ("KVHandoff(id=%r, P=%d, slot=%d, resolved=%s)"
+                % (self.id, self.prefill_len, self.slot, self.resolved))

@@ -108,6 +108,21 @@ class PrefixCache:
         touch — this is an existence probe, not a use)."""
         return self._by_tokens.get(tuple(int(t) for t in tokens))
 
+    def peek(self, tokens):
+        """Longest retained prefix length of ``tokens`` WITHOUT an LRU
+        touch or a pin — a placement probe, not a use. The fleet
+        router reads this off candidate replicas (submit affinity, and
+        the decode-side handoff check where a full-prefill hit means
+        no KV bytes need to ship at all); the engine re-walks with
+        :meth:`lookup` at admission and takes the hit itself."""
+        node, depth = self._root, 0
+        for t in tokens:
+            child = node.children.get(int(t))
+            if child is None:
+                break
+            node, depth = child, depth + 1
+        return depth
+
     # -- lookup ----------------------------------------------------------
     def lookup(self, tokens):
         """Longest cached prefix of ``tokens``: returns

@@ -55,9 +55,11 @@ Two injection surfaces:
   (the channel to the replica stalls; the router's per-op timeout and
   ping probe decide slow-not-dead), and
   :meth:`FaultInjector.fleet_submit_failures` (the channel drops the
-  submit — retry/backoff and the exactly-once adoption path). A
-  directive naming replica ``None`` matches whichever replica reaches
-  that seam first.
+  submit — retry/backoff and the exactly-once adoption path), and
+  :meth:`FaultInjector.fleet_handoff_failures` (the channel drops a
+  KV-handoff delivery to a decode replica — same retry/dedup
+  discipline on the disaggregated path). A directive naming replica
+  ``None`` matches whichever replica reaches that seam first.
 
 Every injected fault is appended to ``FaultInjector.log`` as
 ``(kind, op)`` so tests can assert the schedule actually fired.
@@ -266,6 +268,15 @@ class FaultInjector:
         the fault, the exactly-once adoption path — must absorb it."""
         return self._fleet_scheduled([("submit_fail", replica_id)] * n)
 
+    def fleet_handoff_failures(self, replica_id=None, n=1):
+        """Drop the next ``n`` KV-handoff deliveries to the decode
+        replica (``ConnectionError`` from the channel): the router's
+        retry must re-deliver the SAME package, and when the admit
+        landed before the fault died on the wire, the decode engine's
+        dedup table must admit exactly once (adoption, not double
+        admission)."""
+        return self._fleet_scheduled([("handoff_fail", replica_id)] * n)
+
     @contextlib.contextmanager
     def _fleet_scheduled(self, directives):
         from ..serving import fleet as _sf
@@ -333,6 +344,28 @@ class FaultInjector:
             self.log.append(("submit_fail", replica_id))
             raise ConnectionError(
                 "fault injection: submit to replica %r lost"
+                % (replica_id,))
+        if slow is not None:
+            self.log.append(("slow", replica_id))
+            return slow[2]
+        return 0
+
+    def fleet_handoff(self, replica_id):
+        """Channel fault for one KV-handoff delivery attempt: raises
+        ``ConnectionError`` (package lost on the wire), or returns a
+        stall in seconds, or 0 (clean) — same contract as
+        :meth:`fleet_submit`, separate directive kind so a schedule
+        can fault handoffs without touching ordinary submits."""
+        with self._lock:
+            head = self._fleet_head("handoff_fail", replica_id)
+            if head is None:
+                slow = self._fleet_head("slow", replica_id)
+            else:
+                slow = None
+        if head is not None:
+            self.log.append(("handoff_fail", replica_id))
+            raise ConnectionError(
+                "fault injection: handoff to replica %r lost"
                 % (replica_id,))
         if slow is not None:
             self.log.append(("slow", replica_id))

@@ -10,23 +10,28 @@ from mxnet_tpu.operator import NumpyOp
 
 def assert_compile_contract(engine, decode=1, verify="<=1",
                             prefill="once", copy="once", draft="<=1",
-                            draft_prefill="once"):
+                            draft_prefill="once", handoff="once"):
     """Pin the serving engine's compile-count contract
     ({decode: 1, verify: <=1, prefill: 1/bucket, copy: 1/bucket,
-    + draft families for draft="model" engines} — doc/serving.md):
-    ONE shared assertion instead of a hand-copied pin per test, so the
-    contract can never drift between files.
+    + draft families for draft="model" engines, + a handoff family on
+    role-specialized engines} — doc/serving.md): ONE shared assertion
+    instead of a hand-copied pin per test, so the contract can never
+    drift between files.
 
     Scalar families (``decode``/``verify``/``draft``) take an exact
     int or ``"<=1"``; bucketed families (``prefill``/``copy``/
-    ``draft_prefill``) take an exact ``{bucket: count}`` dict or
-    ``"once"`` (= every bucket actually used compiled exactly once,
-    whatever the bucket set — the default, since most workloads'
+    ``draft_prefill``/``handoff``) take an exact ``{bucket: count}``
+    dict or ``"once"`` (= every bucket actually used compiled exactly
+    once, whatever the bucket set — the default, since most workloads'
     bucket sets are draw-dependent). ``copy={}`` pins that NO copy
     programs exist (prefix cache off). The draft families are only
-    checked on engines that report them (draft="model"). Returns
-    ``engine.compile_counts`` for any extra assertions the caller
-    wants to stack on."""
+    checked on engines that report them (draft="model"); ``handoff``
+    likewise only on engines that report it (role != "unified", or a
+    unified engine that imported/exported a handoff). Per-role pins
+    ride the scalars: a prefill-role engine passes ``decode=0,
+    verify=0`` (it never compiles a decode program), a decode-role
+    engine passes ``prefill={}``. Returns ``engine.compile_counts``
+    for any extra assertions the caller wants to stack on."""
     cc = engine.compile_counts
 
     def scalar(name, want):
@@ -58,6 +63,8 @@ def assert_compile_contract(engine, decode=1, verify="<=1",
     if "draft" in cc:
         scalar("draft", draft)
         family("draft_prefill", draft_prefill)
+    if "handoff" in cc:
+        family("handoff", handoff)
     return cc
 
 

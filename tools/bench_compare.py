@@ -41,7 +41,7 @@ _HIGHER = ("tokens_per_sec", "img_per_sec", "speedup", "tflops",
            "accept", "mfu", "goodput", "samples_per_sec", "hit_tokens",
            "zero_failed")
 _LOWER = ("_ms", "overhead", "_pct", "bytes_accessed", "_bytes",
-          "spread", "bytes_ratio", "dispatches")
+          "spread", "bytes_ratio", "dispatches", "p99_ratio")
 
 
 def flatten(doc, prefix=""):

@@ -184,10 +184,25 @@ def print_serving(snap, out=None):
                   % (s.get("capture_records", 0),
                      s.get("capture_skipped", 0),
                      s.get("capture_bytes", 0)))
-    out.write("compiles:         decode=%s prefill=%s copy=%s\n"
+    # disaggregated prefill/decode (ISSUE 18): the engine's role and
+    # how long finished prefills waited in the router's transit queue
+    # before a decode slot took them (doc/serving.md "Disaggregated
+    # prefill/decode") — a growing wait says decode capacity, not
+    # prefill, is the bottleneck
+    role = s.get("role")
+    wait = s.get("handoff_wait_ms")
+    wait_live = _is_histogram(wait) and wait["count"]
+    if (role is not None and int(role)) or wait_live:
+        out.write("disaggregation:   role=%s handoff_wait_ms=%s\n"
+                  % ({0: "unified", 1: "prefill", 2: "decode"}.get(
+                      int(role or 0), "?"),
+                     _fmt_hist(wait) if wait_live else "(empty)"))
+    out.write("compiles:         decode=%s prefill=%s copy=%s "
+              "handoff=%s\n"
               % (s.get("compiles_decode", 0),
                  s.get("compiles_prefill", 0),
-                 s.get("compiles_copy", 0)))
+                 s.get("compiles_copy", 0),
+                 s.get("compiles_handoff", 0)))
     # round-phase breakdown (ISSUE 13): where a scheduling round's
     # wall time went, as total-ms shares — the one-look answer to
     # "is the engine device-bound or stuck in host scheduling"
@@ -247,6 +262,16 @@ def print_fleet(snap, out=None):
                  s.get("heartbeat_misses", 0)))
     out.write("placement:        affinity_hits=%s\n"
               % s.get("affinity_hits", 0))
+    # KV handoff (disaggregated prefill/decode — ISSUE 18): volume,
+    # bytes actually shipped (pool hits ship none), and per-delivery
+    # admit latency
+    hms = s.get("handoff_ms")
+    hms_live = _is_histogram(hms) and hms["count"]
+    if s.get("handoff_count", 0) or hms_live:
+        out.write("handoff:          count=%s bytes=%s ms=%s\n"
+                  % (int(s.get("handoff_count", 0)),
+                     int(s.get("handoff_bytes", 0)),
+                     _fmt_hist(hms) if hms_live else "(empty)"))
 
 
 def print_trace(doc, name_filters=(), out=None):

@@ -79,11 +79,15 @@ from mxnet_tpu.serving.capture import load_capture  # noqa: E402
 
 # capture-header keys that must NOT feed the replay engine's
 # constructor: max_len belongs to the Decoder, capture_dir would
-# re-capture, and engine_id/migrated_from are the CAPTURED run's
+# re-capture, engine_id/migrated_from are the CAPTURED run's
 # identity/provenance — replay engines get fresh ids (a fleet replay
-# builds N engines from one header; cloned ids would collide)
+# builds N engines from one header; cloned ids would collide) — and
+# role is a TOPOLOGY axis, not request content: a capture recorded on
+# a prefill specialist replays fine on a unified engine (outputs are
+# role-independent by the disaggregation contract), and ``--roles``
+# decides the replay topology explicitly
 _NON_CTOR_KEYS = ("max_len", "capture_dir", "engine_id",
-                  "migrated_from")
+                  "migrated_from", "role")
 
 
 def build_engine(cap, decoder, **overrides):
@@ -123,7 +127,7 @@ def recorded_latency(cap):
     return _latency_summary(ttft, cadence)
 
 
-def rolling_restart(router, cap, mkreplica):
+def rolling_restart(router, cap, mkreplica, per_role=False):
     """An ``on_round`` hook that drains-and-replaces every replica of
     ``router`` in turn while the capture replays: replica ``k`` is
     drained (in-flight requests migrate live to its peers) once
@@ -131,9 +135,20 @@ def rolling_restart(router, cap, mkreplica):
     ``mkreplica()`` successor joins the rotation — the
     zero-failed-request rolling-restart drill. Byte-identity under
     ``--verify`` is the acceptance bar: migration must not change a
-    single token."""
+    single token.
+
+    ``per_role=True`` (a ``--roles`` fleet) calls
+    ``mkreplica(role=...)`` with the drained replica's ORIGINAL role
+    so a restarted prefill specialist is replaced by a prefill
+    specialist — restarts must not silently erode the disaggregated
+    topology. Roles are snapshotted here, not read at drain time:
+    draining one side of a 1P+1D fleet promotes the survivor to
+    unified (the empty-phase fallback), and a post-promotion read
+    would replace the original specialist with a unified replica."""
     total = max(1, len(cap["submits"]))
     rids = router.replica_ids(live_only=True)
+    roles = [getattr(router.replica(r), "role", "unified")
+             for r in rids] if per_role else None
     milestones = [(k + 1) * total // (len(rids) + 1)
                   for k in range(len(rids))]
     state = {"next": 0}
@@ -143,7 +158,10 @@ def rolling_restart(router, cap, mkreplica):
         if k < len(milestones) and submitted >= max(1, milestones[k]):
             state["next"] += 1
             router.drain(rids[k])
-            router.add_replica(mkreplica())
+            if per_role:
+                router.add_replica(mkreplica(role=roles[k]))
+            else:
+                router.add_replica(mkreplica())
     return on_round
 
 
@@ -339,9 +357,20 @@ def main(argv=None):
                          "resilience'); health-driven + prefix-"
                          "affinity placement decides where each "
                          "captured request lands")
+    ap.add_argument("--roles", default=None, metavar="PxD",
+                    help="disaggregated replay topology: P prefill-"
+                         "role + D decode-role replicas (e.g. "
+                         "'--roles 2x2'; doc/serving.md "
+                         "'Disaggregated prefill/decode'). Composes "
+                         "with --replicas (adds N unified replicas to "
+                         "the same fleet), --rolling-restart "
+                         "(restarted specialists keep their role) and "
+                         "every engine-config override incl. --tp; "
+                         "--verify must stay clean — disaggregation "
+                         "is byte-invisible")
     ap.add_argument("--rolling-restart", action="store_true",
-                    help="with --replicas: drain and replace every "
-                         "replica in turn mid-replay (in-flight "
+                    help="with --replicas/--roles: drain and replace "
+                         "every replica in turn mid-replay (in-flight "
                          "requests migrate live to peers) — the "
                          "zero-failed-request restart drill; combine "
                          "with --verify for the byte-identity bar")
@@ -377,26 +406,44 @@ def main(argv=None):
         ("tp", args.tp),
         ("weight_dtype", args.weight_dtype),
     ) if v is not None}
+    roles_pd = None
+    if args.roles:
+        try:
+            p, d = (int(x) for x in args.roles.lower().split("x"))
+        except ValueError:
+            p = d = 0
+        if p < 1 or d < 1:
+            ap.error("--roles takes PxD with P,D >= 1 (e.g. 2x2)")
+        roles_pd = (p, d)
     on_round = None
-    if args.replicas:
+    if args.replicas or roles_pd:
         from mxnet_tpu.serving import FleetRouter
 
-        engine = FleetRouter([build_engine(cap, mkdec(), **overrides)
-                              for _ in range(args.replicas)])
+        def mkreplica(role="unified"):
+            return build_engine(cap, mkdec(), role=role, **overrides)
+
+        engines = [mkreplica() for _ in range(args.replicas or 0)]
+        if roles_pd:
+            engines += [mkreplica(role="prefill")
+                        for _ in range(roles_pd[0])]
+            engines += [mkreplica(role="decode")
+                        for _ in range(roles_pd[1])]
+        engine = FleetRouter(engines)
         if args.rolling_restart:
-            on_round = rolling_restart(
-                engine, cap,
-                lambda: build_engine(cap, mkdec(), **overrides))
+            on_round = rolling_restart(engine, cap, mkreplica,
+                                       per_role=bool(roles_pd))
     elif args.rolling_restart:
-        ap.error("--rolling-restart needs --replicas")
+        ap.error("--rolling-restart needs --replicas or --roles")
     else:
         engine = build_engine(cap, mkdec(), **overrides)
     report = replay(cap, engine, timing=args.timing,
                     verify=args.verify, verify_mode=args.verify_mode,
                     on_round=on_round)
     report["overrides"] = overrides
-    if args.replicas:
+    if args.replicas or roles_pd:
         report["fleet"] = dict(engine.stats)
+        if roles_pd:
+            report["roles"] = "%dx%d" % roles_pd
     print(json.dumps(report, sort_keys=True))
     if args.verify and report["mismatches"]:
         print("REPLAY VERIFY FAILED: %d mismatch(es)"
