@@ -61,7 +61,13 @@ kernels:
 lint:
 	python -m compileall -q mxnet_tpu tools example
 
+# Observability drift gate standalone (doc/observability.md): every
+# registered metric has a catalog row, every MXNET_* knob a doc entry
+# (tools/lint_metrics.py) — doc drift fails fast without a tier-1 run.
+lintobs:
+	python tools/lint_metrics.py
+
 clean:
 	$(MAKE) -C cpp clean
 
-.PHONY: all native examples test manifest check bench benchdiff chaos kernels lint clean
+.PHONY: all native examples test manifest check bench benchdiff chaos kernels lint lintobs clean

@@ -1908,7 +1908,9 @@ def bench_telemetry_overhead(batch=256, chain_steps=10, pairs=40,
     the train step, and this pins that arming the knob alone costs
     the step path nothing (the serving-path cost of a ROLLING capture
     is measured by ``bench_serving_replay``'s
-    ``capture_overhead_frac``)."""
+    ``capture_overhead_frac``). Since ISSUE 19 FLEET tracing is armed
+    too: a live 1P+1D router with stitched journeys in its flight
+    ring, the scraper cycling the /fleet plane in with /metrics."""
     import shutil
     import tempfile
 
@@ -1957,13 +1959,50 @@ def bench_telemetry_overhead(batch=256, chain_steps=10, pairs=40,
     from mxnet_tpu import telemetry_http
     own_server = telemetry_http._server is None
     srv = tele.serve(port=0) if own_server else telemetry_http._server
+    # Since ISSUE 19 the A/B ALSO runs with fleet tracing armed: a
+    # live 1P+1D FleetRouter whose flight ring holds real stitched
+    # cross-replica journeys (served once, before the chains), and
+    # the scraper polls the fleet plane (/fleet aggregation + a
+    # per-trace /fleet/flight stitch) alongside /metrics. The fleet
+    # idles during the chains — the contract being pinned is that an
+    # ARMED tracing plane (ring retention, SLO windows ticking under
+    # _refresh, stitching under scrape) costs the train step nothing.
+    import jax.numpy as jnp
+    from mxnet_tpu.models import get_transformer_lm
+    from mxnet_tpu.parallel import Decoder
+    from mxnet_tpu.serving import FleetRouter, InferenceEngine
+    fvocab, flen = 17, 16
+    fsym = get_transformer_lm(fvocab, num_layers=1, embed_dim=16,
+                              num_heads=2, impl="dense")
+    fshapes = {"data": (2, flen), "softmax_label": (2, flen)}
+    farg_shapes, _, _ = fsym.infer_shape(**fshapes)
+    frng = np.random.RandomState(0)
+    fparams = {n: jnp.asarray(frng.uniform(-0.3, 0.3, s)
+                              .astype(np.float32))
+               for n, s in zip(fsym.list_arguments(), farg_shapes)
+               if n not in fshapes}
+
+    def _feng(role):
+        return InferenceEngine(
+            Decoder(fsym, fparams, max_len=flen, cache_block=None),
+            slots=2, prefill_buckets=(4, 8), max_queue=8,
+            prefix_cache_mb=0.0042, role=role)
+
+    fleet = FleetRouter([_feng("prefill"), _feng("decode")],
+                        heartbeat_ms=1e6)
+    fhandles = [fleet.submit(frng.randint(0, fvocab, (5,)),
+                             max_tokens=4) for _ in range(4)]
+    fleet.serve_forever()
+    scrape_paths = ["/metrics", "/fleet"] \
+        + ["/fleet/flight/%s" % h.id for h in fhandles[:2]]
     stop_scraper = threading.Event()
     scrapes = [0]
 
     def scraper():
         while not stop_scraper.wait(scrape_interval_s):
             try:
-                with urllib.request.urlopen(srv.url + "/metrics",
+                path = scrape_paths[scrapes[0] % len(scrape_paths)]
+                with urllib.request.urlopen(srv.url + path,
                                             timeout=5) as resp:
                     resp.read()
                 scrapes[0] += 1
@@ -1995,6 +2034,7 @@ def bench_telemetry_overhead(batch=256, chain_steps=10, pairs=40,
         tele.enable(was_enabled)
         stop_scraper.set()
         scraper_thread.join(timeout=5)
+        fleet.close()
         if own_server:
             tele.stop_server()
         pause.__exit__(None, None, None)
@@ -2015,6 +2055,8 @@ def bench_telemetry_overhead(batch=256, chain_steps=10, pairs=40,
         "asserted_within": 0.02,
         "exposition_server": True,
         "capture_armed": True,
+        "fleet_tracing_armed": True,
+        "fleet_journeys": len(fhandles),
         "scrape_interval_s": scrape_interval_s,
         "scrapes": scrapes[0],
     }

@@ -34,12 +34,16 @@ fails zero requests, byte-identically. Replicas may specialize
 (``role="prefill"``/``"decode"``, doc/serving.md "Disaggregated
 prefill/decode"): prefill engines hand finished KV rows to decode
 engines through the router (:class:`KVHandoff`), isolating decode
-cadence from long-prompt prefill.
+cadence from long-prompt prefill. Every fleet request carries a trace
+context across those hops; the router's
+:class:`FleetFlightRecorder` stitches router + wire + per-engine
+events into one cross-replica timeline with an end-to-end SLO
+decomposition (doc/observability.md "The fleet tracing plane").
 """
 from .capture import CaptureStream, load_capture
 from .engine import (InferenceEngine, Request, EngineOverloaded,
                      EngineClosed, EngineStuck)
-from .fleet import FleetRouter, FleetRequest
+from .fleet import FleetRouter, FleetRequest, FleetFlightRecorder
 from .flight import FlightRecorder
 from .handoff import KVHandoff, pack_rows, unpack_rows
 from .prefix import PrefixCache
@@ -52,5 +56,5 @@ __all__ = ["InferenceEngine", "Request", "PrefixCache",
            "load_capture", "QuantizedTensor", "quantize_tensor",
            "quantize_params", "quantized_weight_names", "dequantize",
            "EngineOverloaded", "EngineClosed", "EngineStuck",
-           "FleetRouter", "FleetRequest", "KVHandoff", "pack_rows",
-           "unpack_rows"]
+           "FleetRouter", "FleetRequest", "FleetFlightRecorder",
+           "KVHandoff", "pack_rows", "unpack_rows"]

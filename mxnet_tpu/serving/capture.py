@@ -201,6 +201,10 @@ class CaptureStream:
             rec["ttft_deadline_ms"] = float(req.ttft_deadline_ms)
         if req.resumed:
             rec["resume_tokens"] = list(req.tokens[:req.resumed])
+        trace = getattr(req, "trace", None)
+        if trace is not None:
+            # fleet identity rides the capture so replay preserves it
+            rec["trace_id"], rec["hop"] = trace
         if self._write(rec):
             with self._lock:
                 self._captured.add(req.id)

@@ -358,6 +358,9 @@ class Request:
         self.prefill_chunks = 0
         self.deadline_ms = deadline_ms
         self.ttft_deadline_ms = ttft_deadline_ms
+        # fleet trace context: (trace_id, hop) when a FleetRouter
+        # minted this request's identity, None for direct submits
+        self.trace = None
         self._deadline = None if deadline_ms is None \
             else self.t_submit + deadline_ms / 1e3
         self._ttft_deadline = None if ttft_deadline_ms is None \
@@ -1889,7 +1892,7 @@ class InferenceEngine:
 
     def submit(self, prompt, max_tokens, eos_id=None, temperature=0.0,
                seed=None, request_id=None, deadline_ms=None,
-               ttft_deadline_ms=None, _resume_tokens=()):
+               ttft_deadline_ms=None, _resume_tokens=(), _trace=None):
         """Queue one generation request; returns its :class:`Request`
         handle (fills in as the engine steps).
 
@@ -2033,6 +2036,8 @@ class InferenceEngine:
                       deadline_ms=deadline_ms,
                       ttft_deadline_ms=ttft_deadline_ms,
                       resume_tokens=_resume_tokens)
+        if _trace is not None:
+            req.trace = (str(_trace[0]), int(_trace[1]))
         self._pending.append(req)
         self._active[rid] = req
         if req._deadline is not None or req._ttft_deadline is not None:
@@ -2050,6 +2055,8 @@ class InferenceEngine:
                 meta["deadline_ms"] = deadline_ms
             if ttft_deadline_ms is not None:
                 meta["ttft_deadline_ms"] = ttft_deadline_ms
+            if req.trace is not None:
+                meta["trace"], meta["hop"] = req.trace
             self.flight.start(rid, **meta)
         return req
 
@@ -2189,6 +2196,11 @@ class InferenceEngine:
         # cadence math never divides by a first-token gap this engine
         # did not serve
         req.t_first = req.t_submit
+        trace = payload.get("trace")
+        if trace is not None:
+            # the wire crossing is one hop: the decode-side record
+            # carries hop+1 relative to the exporting prefill engine
+            req.trace = (str(trace[0]), int(trace[1]) + 1)
         P = int(payload["prefill_len"])
         if P != len(req.seq) - 1:
             raise MXNetError(
@@ -2288,9 +2300,12 @@ class InferenceEngine:
         self.stats["submitted"] += 1
         self.capture.submit(req)
         if self.flight.enabled:
-            self.flight.start(rid, prompt_len=int(prompt.size),
-                              max_tokens=int(payload["max_tokens"]),
-                              handoff=True, resumed=req.resumed)
+            meta = {"prompt_len": int(prompt.size),
+                    "max_tokens": int(payload["max_tokens"]),
+                    "handoff": True, "resumed": req.resumed}
+            if req.trace is not None:
+                meta["trace"], meta["hop"] = req.trace
+            self.flight.start(rid, **meta)
             self.flight.event(rid, "handoff_import", slot=slot,
                               prefill_len=P,
                               rows=rows is not None)
@@ -3266,6 +3281,12 @@ class InferenceEngine:
                 "prefix_hit_tokens": req.prefix_hit_tokens,
             })
         rows.extend(self.flight.rows())
+        # multi-replica processes expose every engine's table on ONE
+        # /requests endpoint — rows are indistinguishable without the
+        # owning engine's identity and role
+        for row in rows:
+            row["engine_id"] = self.engine_id
+            row["role"] = self.role
         return rows
 
     def serve_forever(self, requests=None):

@@ -74,6 +74,26 @@ Observability: ``fleet.failovers``, ``fleet.drains``,
 (doc/observability.md); ``tools/dump_telemetry.py --fleet`` prints the
 one-line summary.
 
+**Fleet tracing plane** (doc/observability.md "Fleet tracing"): the
+router mints a request-scoped trace context at :meth:`submit` — the
+fleet request id plus a hop counter — and threads it through every
+engine placement, the :class:`KVHandoff` wire format, and failover
+resubmits, so each engine's flight record carries the fleet identity.
+Its own :class:`FleetFlightRecorder` ring records the transitions the
+fleet owns (``placed`` / ``in_transit`` / ``admitted`` / ``retried`` /
+``failover`` / ``drained`` / ``migrated``) on the ABSOLUTE
+``perf_counter`` clock and absorbs each per-engine flight record as
+its hop completes, so ``FleetRouter.flight.timeline(trace_id)``
+stitches one ordered cross-replica journey (``GET
+/fleet/flight/<id>`` on the exposition server; ``?chrome=1`` exports
+a Perfetto track-per-replica trace). End-to-end SLOs are measured
+from ROUTER arrival and decomposed into ``router_queue / prefill /
+handoff_wait / decode_admission / decode`` components that sum to the
+end-to-end wall time by construction (the PR 13 phases-sum-to-wall
+discipline): ``fleet.ttft_ms``/``fleet.cadence_ms`` histograms,
+``fleet.slo_*`` attained/missed counters and multi-window burn gauges
+(``telemetry.SloWindow``), all surfaced by ``GET /fleet``.
+
 Fault injection: ``mxnet_tpu.testing.faults`` installs itself as
 :data:`_FLEET_FAULTS` and drives the router's seams deterministically
 (kill-replica-mid-round, heartbeat blackhole, slow replica, submit
@@ -85,7 +105,9 @@ import collections
 import contextlib
 import os
 import random
+import threading
 import time
+import weakref
 
 import numpy as np
 
@@ -94,7 +116,11 @@ from ..base import MXNetError
 from .engine import (EngineClosed, EngineOverloaded, EngineStuck,
                      _TM_HANDOFF_WAIT)
 
-__all__ = ["FleetRouter", "FleetRequest"]
+__all__ = ["FleetRouter", "FleetRequest", "FleetFlightRecorder"]
+
+# live routers, for the exposition server's /fleet plane (weak: a
+# router the caller dropped must not be kept alive by telemetry)
+_ROUTERS = weakref.WeakSet()
 
 # FaultInjector hook point (mxnet_tpu.testing.faults installs itself
 # here while a fleet fault plan is active)
@@ -144,6 +170,262 @@ _TM_LIVE = tele.gauge("fleet.replicas_live")
 _TM_HANDOFF_COUNT = tele.counter("fleet.handoff_count")
 _TM_HANDOFF_BYTES = tele.counter("fleet.handoff_bytes")
 _TM_HANDOFF_MS = tele.histogram("fleet.handoff_ms")
+# End-to-end SLO accounting measured from ROUTER arrival (the engine's
+# serving.ttft_ms starts at engine admission and restarts on every
+# migration — the fleet figure is what the caller actually saw).
+# Attainment counters tick once per request at the same host-side
+# points that feed the histograms; the burn gauges are multi-window
+# derivatives (tele.SloWindow), refreshed per step and per scrape.
+# Declared with literal names so the metric catalog lint sees them.
+_TM_FLEET_TTFT = tele.histogram("fleet.ttft_ms")
+_TM_FLEET_CADENCE = tele.histogram("fleet.cadence_ms")
+_TM_FLEET_SLO_TTFT_OK = tele.counter("fleet.slo_ttft_attained")
+_TM_FLEET_SLO_TTFT_MISS = tele.counter("fleet.slo_ttft_missed")
+_TM_FLEET_SLO_CAD_OK = tele.counter("fleet.slo_cadence_attained")
+_TM_FLEET_SLO_CAD_MISS = tele.counter("fleet.slo_cadence_missed")
+_FLEET_SLO_TTFT_WINDOWS = (
+    (60.0, tele.gauge("fleet.slo_ttft_burn_1m")),
+    (300.0, tele.gauge("fleet.slo_ttft_burn_5m")),
+    (3600.0, tele.gauge("fleet.slo_ttft_burn_1h")))
+_FLEET_SLO_CADENCE_WINDOWS = (
+    (60.0, tele.gauge("fleet.slo_cadence_burn_1m")),
+    (300.0, tele.gauge("fleet.slo_cadence_burn_5m")),
+    (3600.0, tele.gauge("fleet.slo_cadence_burn_1h")))
+
+# the five SLO decomposition components, in journey order; they sum to
+# the end-to-end wall time by construction (``decode`` is the
+# remainder, the PR 13 phases-sum-to-wall discipline)
+_SLO_COMPONENTS = ("router_queue", "prefill", "handoff_wait",
+                   "decode_admission", "decode")
+
+
+class _FleetFlight:
+    """One fleet request's stitched record: router/wire events plus
+    the per-engine flight events absorbed as each hop completed.
+    Events carry ABSOLUTE ``perf_counter`` stamps (``"t"``) and the
+    scope that recorded them (``"router"`` or an engine id); rendering
+    re-bases everything onto ``t0`` — the router submit — so one
+    monotonic ``t_ms`` axis orders the whole cross-replica journey."""
+
+    __slots__ = ("rid", "t0", "meta", "events", "dropped", "hops",
+                 "absorbed")
+
+    def __init__(self, rid, t0, meta):
+        self.rid = rid
+        self.t0 = t0
+        self.meta = meta
+        self.events = []
+        self.dropped = 0
+        self.hops = []          # engine ids, placement order
+        self.absorbed = {}      # (engine_id, t0_us) -> events taken
+
+
+class FleetFlightRecorder:
+    """Bounded ring of stitched cross-replica request timelines — the
+    fleet-level counterpart of :class:`~.flight.FlightRecorder`, same
+    design constraints (host-side only, bounded everywhere, terminal
+    event always lands).
+
+    The router records its OWN transitions directly (placement,
+    wire movement, retries, failover) and ABSORBS each engine's
+    flight record when the request's hop there ends — engine records
+    are keyed by request id and a failover resubmit or decode-side
+    admission restarts/evicts them, so copying events out at hop
+    boundaries is what makes the stitched journey survive the very
+    faults it exists to explain. Event budget is per-request
+    (``max_events``, terminal ``retire`` always lands); the ring keeps
+    the last ``retain`` retired journeys."""
+
+    def __init__(self, retain=256, max_events=512):
+        self.retain = max(0, int(retain))
+        self.max_events = max(8, int(max_events))
+        self._live = {}                            # rid -> _FleetFlight
+        self._retired = collections.OrderedDict()  # FIFO ring
+        self._lock = threading.Lock()
+        self._owner = None      # weakref to the router (set by it)
+
+    @property
+    def enabled(self):
+        return self.retain > 0 and tele.enabled()
+
+    # -- recording (router thread) --------------------------------------
+    def start(self, rid, **meta):
+        if not self.enabled:
+            return
+        now = time.perf_counter()
+        with self._lock:
+            self._live[rid] = fl = _FleetFlight(rid, now, dict(meta))
+        self._append(fl, now, "router", "submit", meta or None)
+
+    def event(self, rid, name, scope="router", **args):
+        if not self.enabled:
+            return
+        with self._lock:
+            fl = self._live.get(rid)
+        if fl is not None:
+            self._append(fl, time.perf_counter(), scope, name,
+                         args or None)
+
+    def hop(self, rid, engine_id):
+        """Record a placement hop (consecutive duplicates collapse)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            fl = self._live.get(rid)
+            if fl is not None and (not fl.hops
+                                   or fl.hops[-1] != engine_id):
+                fl.hops.append(engine_id)
+
+    def absorb(self, rid, engine_id, records):
+        """Fold one engine's flight records for ``rid`` into the
+        stitched journey. ``records`` is
+        ``FlightRecorder.records(rid)`` — ``(t0, events)`` pairs with
+        ABSOLUTE ``t0`` and per-record-relative event times.
+        Idempotent per record: a record absorbed mid-life (a live
+        ``timeline()`` query) and again at hop end only appends the
+        events that arrived in between."""
+        if not self.enabled:
+            return
+        with self._lock:
+            fl = self._live.get(rid)
+            if fl is None:
+                return
+            for t0, events in records:
+                key = (engine_id, int(round(t0 * 1e6)))
+                taken = fl.absorbed.get(key, 0)
+                for ev in events[taken:]:
+                    if len(fl.events) >= self.max_events:
+                        fl.dropped += 1
+                        continue
+                    out = dict(ev)
+                    out["t"] = t0 + out.pop("t_ms", 0.0) / 1e3
+                    out["scope"] = engine_id
+                    fl.events.append(out)
+                fl.absorbed[key] = len(events)
+
+    def retire(self, rid, reason, **args):
+        """Terminal event: moves the journey to the retired ring.
+        ``slo=`` (the decomposition dict) is folded into the record's
+        meta so ``timeline()`` surfaces it without event spelunking."""
+        if not self.enabled:
+            return
+        now = time.perf_counter()
+        with self._lock:
+            fl = self._live.pop(rid, None)
+            if fl is None:
+                return
+            fl.meta["retire_reason"] = reason
+            if "slo" in args:
+                fl.meta["slo"] = args["slo"]
+            self._retired[rid] = fl
+            self._retired.move_to_end(rid)
+            while len(self._retired) > self.retain:
+                self._retired.popitem(last=False)
+        args = dict(args)
+        args["reason"] = reason
+        self._append(fl, now, "router", "retire", args, terminal=True)
+
+    def _append(self, fl, now, scope, name, args, terminal=False):
+        ev = {"t": now, "scope": scope, "event": name}
+        if args:
+            for k, v in args.items():
+                ev.setdefault(k, v)
+        with self._lock:
+            if len(fl.events) >= self.max_events and not terminal:
+                fl.dropped += 1
+            else:
+                fl.events.append(ev)
+        if tele.tracing():
+            tele.mark("fleet.flight." + name, cat="fleet.flight",
+                      request=str(fl.rid), scope=scope)
+
+    # -- reconstruction (any thread) ------------------------------------
+    def _get(self, rid):
+        fl = self._live.get(rid)
+        live = fl is not None
+        if fl is None:
+            fl = self._retired.get(rid)
+        return fl, live
+
+    def timeline(self, rid):
+        """The stitched journey: ``{"id", "live", "meta", "hops",
+        "events", "dropped_events"}``, events sorted on one monotonic
+        clock with ``t_ms`` relative to ROUTER submit and ``scope``
+        naming who recorded each one — or None if never recorded /
+        evicted. Live queries first sweep the current replica's flight
+        record so in-progress hops show up too."""
+        owner = self._owner() if self._owner is not None else None
+        if owner is not None:
+            owner._absorb_live(rid)
+        with self._lock:
+            fl, live = self._get(rid)
+            if fl is None:
+                return None
+            events = sorted(fl.events, key=lambda ev: ev["t"])
+            out = []
+            for ev in events:
+                r = {"t_ms": round((ev["t"] - fl.t0) * 1e3, 3),
+                     "scope": ev["scope"], "event": ev["event"]}
+                r.update({k: v for k, v in ev.items()
+                          if k not in ("t", "scope", "event")})
+                out.append(r)
+            return {"id": fl.rid, "live": live, "meta": dict(fl.meta),
+                    "hops": list(fl.hops), "events": out,
+                    "dropped_events": fl.dropped}
+
+    def chrome_trace(self, rid):
+        """Perfetto/chrome://tracing export of one stitched journey:
+        one track ("thread") per scope — router first, then each
+        engine in hop order — instant events for the journey, and the
+        SLO decomposition rendered as back-to-back spans on the router
+        track (they sum to end-to-end by construction, so the spans
+        tile the request's wall time). Times in µs since router
+        submit."""
+        tl = self.timeline(rid)
+        if tl is None:
+            return None
+        scopes = ["router"]
+        for ev in tl["events"]:
+            if ev["scope"] not in scopes:
+                scopes.append(ev["scope"])
+        tid = {s: i for i, s in enumerate(scopes)}
+        evs = [{"name": "thread_name", "ph": "M", "pid": 0,
+                "tid": tid[s], "args": {"name": s}} for s in scopes]
+        for ev in tl["events"]:
+            evs.append({
+                "name": ev["event"], "ph": "i", "s": "t", "pid": 0,
+                "tid": tid[ev["scope"]], "ts": ev["t_ms"] * 1e3,
+                "cat": "fleet.flight",
+                "args": {k: v for k, v in ev.items()
+                         if k not in ("t_ms", "scope", "event")}})
+        slo = tl["meta"].get("slo")
+        if slo:
+            t = 0.0
+            for comp in _SLO_COMPONENTS:
+                dur = float(slo.get(comp, 0.0))
+                evs.append({"name": comp, "ph": "X", "pid": 0,
+                            "tid": tid["router"], "ts": t * 1e3,
+                            "dur": dur * 1e3, "cat": "fleet.slo",
+                            "args": {"ms": dur}})
+                t += dur
+        return {"traceEvents": evs, "displayTimeUnit": "ms",
+                "otherData": {"trace_id": str(tl["id"]),
+                              "hops": tl["hops"]}}
+
+    def rows(self):
+        """Summary rows for the retired ring (oldest first)."""
+        now = time.perf_counter()
+        with self._lock:
+            return [{"id": fl.rid, "state": "retired",
+                     "retire_reason": fl.meta.get("retire_reason"),
+                     "hops": list(fl.hops),
+                     "age_s": round(now - fl.t0, 3),
+                     "events": len(fl.events)}
+                    for fl in self._retired.values()]
+
+    def ids(self):
+        with self._lock:
+            return list(self._live), list(self._retired)
 
 
 class FleetRequest:
@@ -162,12 +444,27 @@ class FleetRequest:
     __slots__ = ("id", "client_key", "migrations", "resumed",
                  "_rec", "_cur", "_replica_id", "_t_submit", "_t_first",
                  "_deadline_abs", "_ttft_deadline_abs", "_error",
-                 "_cancelled")
+                 "_cancelled", "_hop", "_detached_from", "_t_place",
+                 "_t_ready", "_t_deliver", "_admit_ms", "_ttft_seen",
+                 "_finalized")
 
     def __init__(self, rid, rec, client_key=None):
         self.id = rid
         self.client_key = client_key
         self.migrations = 0
+        # trace context: the fleet id IS the trace id; _hop counts
+        # engine placements (0 = still router-side). The _t_* stamps
+        # are the SLO decomposition breakpoints (doc/observability.md
+        # "Fleet tracing"): first placement, handoff package ready,
+        # handoff delivered, and the delivery channel-op cost.
+        self._hop = 0
+        self._detached_from = None
+        self._t_place = None
+        self._t_ready = None
+        self._t_deliver = None
+        self._admit_ms = None
+        self._ttft_seen = None    # fleet TTFT observed (once)
+        self._finalized = False   # fleet SLO/flight retirement ran
         # what replay() subtracts from the token count: the resume
         # prefix of the ORIGINAL fleet submit, never inflated by
         # migrations (migrated tokens were generated in this run)
@@ -263,6 +560,7 @@ class FleetRequest:
             seed=self._rec["seed"],
             request_id=self.id,
             _resume_tokens=tuple(self._rec["tokens"]),
+            _trace=(self.id, self._hop + 1),
         )
         if self._deadline_abs is not None:
             kw["deadline_ms"] = (self._deadline_abs - now) * 1e3
@@ -274,6 +572,8 @@ class FleetRequest:
     def _point_at(self, req, replica_id):
         self._cur = req
         self._replica_id = replica_id
+        trace = getattr(req, "trace", None)
+        self._hop = trace[1] if trace is not None else self._hop + 1
         if self._rec["seed"] is None:      # engine drew it: pin for
             self._rec["seed"] = int(req.seed)   # any later migration
 
@@ -318,10 +618,24 @@ class FleetRouter:
 
     def __init__(self, engines, timeout_ms=None, max_retries=None,
                  backoff_ms=None, heartbeat_ms=None,
-                 heartbeat_misses=None, seed=0):
+                 heartbeat_misses=None, seed=0,
+                 slo_ttft_ms=None, slo_cadence_ms=None, slo_target=0.99,
+                 flight_recorder=None):
         engines = list(engines)
         if not engines:
             raise MXNetError("FleetRouter: need at least one replica")
+        # end-to-end SLO thresholds, measured from ROUTER arrival
+        # (constructor-only: per-engine MXNET_SERVING_SLO_* knobs keep
+        # meaning the engine-local figures)
+        self.slo_ttft_ms = None if slo_ttft_ms is None \
+            else float(slo_ttft_ms)
+        self.slo_cadence_ms = None if slo_cadence_ms is None \
+            else float(slo_cadence_ms)
+        self.slo_target = float(slo_target)
+        self._slo_windows = {}
+        self.flight = flight_recorder if flight_recorder is not None \
+            else FleetFlightRecorder()
+        self.flight._owner = weakref.ref(self)
         self.timeout_ms = float(timeout_ms) if timeout_ms is not None \
             else _timeout_s() * 1e3
         self.max_retries = int(max_retries) if max_retries is not None \
@@ -347,6 +661,7 @@ class FleetRouter:
         self.stats = collections.defaultdict(int)
         for e in engines:
             self.add_replica(e)
+        _ROUTERS.add(self)
 
     # -- replica set ----------------------------------------------------
     def add_replica(self, engine):
@@ -493,7 +808,22 @@ class FleetRouter:
             "ttft_deadline_ms": ttft_deadline_ms,
         }
         fr = FleetRequest(rid, rec, client_key=key)
-        self._place_new(fr)
+        if self.flight.enabled:
+            meta = {"prompt_len": int(rec["prompt"].size),
+                    "max_tokens": int(max_tokens)}
+            if rec["tokens"]:
+                meta["resumed"] = len(rec["tokens"])
+            if deadline_ms is not None:
+                meta["deadline_ms"] = deadline_ms
+            if ttft_deadline_ms is not None:
+                meta["ttft_deadline_ms"] = ttft_deadline_ms
+            self.flight.start(rid, **meta)
+        try:
+            self._place_new(fr)
+        except Exception:
+            # fleet-wide refusal: the journey ends at the router
+            self.flight.retire(rid, "refused")
+            raise
         self._requests[rid] = fr
         if key is not None:
             self._dedup[key] = fr
@@ -522,6 +852,11 @@ class FleetRouter:
                     continue
                 raise                      # validation error: caller bug
             fr._point_at(req, rep.id)
+            fr._t_place = time.perf_counter()
+            self.flight.hop(fr.id, rep.id)
+            self.flight.event(fr.id, "placed", replica=rep.id,
+                              reason=self._place_reason(rep, fr),
+                              hop=fr._hop)
             return
         if shed_err is not None:
             raise EngineOverloaded(
@@ -557,6 +892,16 @@ class FleetRouter:
             self.stats["affinity_hits"] += 1
             _TM_AFFINITY.inc()
         return [t[3] for t in scored]
+
+    def _place_reason(self, rep, fr):
+        """Why placement chose this replica, for the ``placed`` flight
+        event: a retained prompt prefix → ``affinity``, a prefill
+        specialist → ``role``, otherwise plain ``least_loaded``."""
+        if self._affinity(rep.engine, fr._rec["prompt"]) > 0:
+            return "affinity"
+        if getattr(rep.engine, "role", "unified") == "prefill":
+            return "role"
+        return "least_loaded"
 
     @staticmethod
     def _affinity(engine, prompt):
@@ -625,6 +970,8 @@ class FleetRouter:
                            attempt + 1, e))
                 self.stats["retries"] += 1
                 _TM_RETRIES.inc()
+                self.flight.event(fr.id, "retried", replica=rep.id,
+                                  op="submit", attempt=attempt + 1)
                 if not alive:
                     delay = backoff * (2 ** attempt)
                     time.sleep(min(
@@ -678,6 +1025,19 @@ class FleetRouter:
                     with contextlib.suppress(Exception):
                         pkg.resolve()
                     continue
+                # the prefill hop is over: pin the first-token stamp
+                # before _point_at re-points the handle at a decode
+                # request whose t_first is its own admission time, and
+                # absorb the prefill engine's flight record while its
+                # retired ring still holds it
+                if fr._t_first is None and fr._cur is not None \
+                        and fr._cur.t_first is not None:
+                    fr._t_first = fr._cur.t_first
+                fr._t_ready = pkg.t_ready
+                self._absorb_hop(fr, rep)
+                self.flight.event(
+                    fr.id, "in_transit",
+                    **{"from": rep.id, "prefill_len": pkg.prefill_len})
                 self._handoffs.append((pkg, fr))
 
     def _channel_handoff(self, rep, pkg, fr):
@@ -710,8 +1070,8 @@ class FleetRouter:
                 t0 = time.perf_counter()
                 req = eng.admit_handoff(pkg.payload(with_rows=not skip),
                                         **kw)
-                _TM_HANDOFF_MS.observe(
-                    (time.perf_counter() - t0) * 1e3)
+                fr._admit_ms = (time.perf_counter() - t0) * 1e3
+                _TM_HANDOFF_MS.observe(fr._admit_ms)
                 return req, (0 if skip else pkg.nbytes), skip
             except (ConnectionError, TimeoutError) as e:
                 last_err = e
@@ -730,6 +1090,8 @@ class FleetRouter:
                            attempt + 1, e))
                 self.stats["retries"] += 1
                 _TM_RETRIES.inc()
+                self.flight.event(fr.id, "retried", replica=rep.id,
+                                  op="handoff", attempt=attempt + 1)
                 if not alive:
                     delay = backoff * (2 ** attempt)
                     time.sleep(min(
@@ -769,6 +1131,15 @@ class FleetRouter:
                     self._fail_over(rep, "closed underneath the router")
                     continue
                 except ConnectionError:
+                    # the journey's delivery target died under it —
+                    # record that on the stitched timeline (the request
+                    # itself was never resident there, so _fail_over's
+                    # per-request _detach sweep won't see it)
+                    self.flight.event(
+                        fr.id, "failover",
+                        reason="target died in transit",
+                        **{"from": rep.id,
+                           "resume_len": len(pkg.tokens)})
                     self._fail_over(rep, "channel dead during KV "
                                          "handoff")
                     continue
@@ -776,7 +1147,15 @@ class FleetRouter:
                     continue               # refused (geometry/stale)
                 _TM_HANDOFF_WAIT.observe(
                     (time.perf_counter() - pkg.t_ready) * 1e3)
+                fr._t_deliver = time.perf_counter()
                 fr._point_at(req, rep.id)
+                self.flight.hop(fr.id, rep.id)
+                self.flight.event(
+                    fr.id, "admitted", replica=rep.id,
+                    bytes=int(nbytes), pool_hit=bool(pool_hit),
+                    dtype=getattr(pkg.source, "handoff_dtype",
+                                  "native"),
+                    hop=fr._hop)
                 pkg.resolve()
                 self.stats["handoffs"] += 1
                 _TM_HANDOFF_COUNT.inc()
@@ -798,8 +1177,13 @@ class FleetRouter:
                 with contextlib.suppress(Exception):
                     pkg.resolve()
                 fr._unhook({"tokens": pkg.tokens})
+                fr._detached_from = pkg.source.engine_id
                 self._held.append(fr)
                 self.stats["handoff_fallbacks"] += 1
+                self.flight.event(
+                    fr.id, "failover", reason="no decode capacity",
+                    **{"from": pkg.source.engine_id,
+                       "resume_len": len(pkg.tokens)})
                 fell_back = True
         if fell_back:
             self._ensure_roles()
@@ -818,8 +1202,13 @@ class FleetRouter:
                 pkg.resolve()
             if not fr.done:
                 fr._unhook({"tokens": pkg.tokens})
+                fr._detached_from = rep.id
                 self._held.append(fr)
                 self.stats["handoff_fallbacks"] += 1
+                self.flight.event(
+                    fr.id, "failover", reason="source died in transit",
+                    **{"from": rep.id,
+                       "resume_len": len(pkg.tokens)})
 
     def _ensure_roles(self):
         """Failover role repair: when the fleet has lost every replica
@@ -887,7 +1276,7 @@ class FleetRouter:
         # rows live in its cache); packages still in its outbox ride
         # the snapshot into _detach — disjoint sets, no double-hold
         self._abandon_handoffs(rep)
-        self._detach(snap)
+        self._detach(snap, rep, event="failover")
         with contextlib.suppress(Exception):
             rep.engine.close()
         self._ensure_roles()
@@ -915,23 +1304,33 @@ class FleetRouter:
         self.stats["drains"] += 1
         _TM_DRAINS.inc()
         self._abandon_handoffs(rep)
-        self._detach(snap)
+        self._detach(snap, rep, event="drained")
         with contextlib.suppress(Exception):
             rep.engine.close()
         self._ensure_roles()
         self._drain_held()
         return snap
 
-    def _detach(self, snap):
+    def _detach(self, snap, rep=None, event="failover"):
         """Re-point every fleet handle off a dying replica onto the
         hold queue, snapshot record absorbed (token prefix + remaining
-        deadline budgets)."""
+        deadline budgets). The dying engine's flight records are
+        absorbed FIRST — ``close()`` is about to retire them with
+        reasons that belong to the corpse, and the resubmit on a peer
+        will reuse the request id."""
         for r in snap.get("requests", ()):
             fr = self._requests.get(r["id"])
             if fr is None or fr.done:
                 continue
+            if rep is not None:
+                self._absorb_hop(fr, rep)
+                fr._detached_from = rep.id
             fr._unhook(r)
             self._held.append(fr)
+            self.flight.event(
+                fr.id, event,
+                **{"from": None if rep is None else rep.id,
+                   "resume_len": len(r.get("tokens", ()))})
 
     def _drain_held(self):
         """One re-placement pass over the hold queue (each held
@@ -964,8 +1363,148 @@ class FleetRouter:
             except MXNetError:
                 continue
             fr._point_at(req, rep.id)
+            self.flight.hop(fr.id, rep.id)
+            self.flight.event(
+                fr.id, "migrated", hop=fr._hop,
+                reason=self._place_reason(rep, fr),
+                **{"from": fr._detached_from, "to": rep.id,
+                   "resume_len": len(fr._rec["tokens"])})
             return True
         return False
+
+    # -- fleet tracing / SLO decomposition ------------------------------
+    def _absorb_hop(self, fr, rep):
+        """Copy one engine's flight records for this request into the
+        stitched journey (idempotent — see
+        :meth:`FleetFlightRecorder.absorb`)."""
+        if not self.flight.enabled:
+            return
+        try:
+            recs = rep.engine.flight.records(fr.id)
+        except Exception:   # noqa: BLE001 — tracing never breaks serving
+            return
+        if recs:
+            self.flight.absorb(fr.id, rep.id, recs)
+
+    def _absorb_live(self, rid):
+        """Lazy sweep backing a live ``timeline()`` query: fold in
+        whatever the request's CURRENT replica has recorded so far."""
+        fr = self._requests.get(rid)
+        if fr is None or fr._replica_id is None:
+            return
+        rep = self._replicas.get(fr._replica_id)
+        if rep is not None:
+            self._absorb_hop(fr, rep)
+
+    def _breakdown(self, fr, t_end):
+        """The end-to-end SLO decomposition, phases-sum-to-wall style
+        (PR 13): ``router_queue`` and ``prefill`` are exact
+        sub-intervals of the TTFT window (they sum to fleet TTFT by
+        construction), ``handoff_wait``/``decode_admission`` split the
+        wire crossing, and ``decode`` is the remainder — so the five
+        components sum to the measured end-to-end wall time exactly,
+        failover gaps and all."""
+        e2e = (t_end - fr._t_submit) * 1e3
+        comp = dict.fromkeys(_SLO_COMPONENTS, 0.0)
+        t_first = fr.t_first
+        if fr._t_place is not None:
+            comp["router_queue"] = (fr._t_place - fr._t_submit) * 1e3
+            if t_first is not None:
+                comp["prefill"] = (t_first - fr._t_place) * 1e3
+        if fr._t_ready is not None and fr._t_deliver is not None:
+            admit = fr._admit_ms or 0.0
+            wait = (fr._t_deliver - fr._t_ready) * 1e3
+            comp["decode_admission"] = min(admit, wait)
+            comp["handoff_wait"] = max(0.0, wait - admit)
+        comp["decode"] = max(0.0, e2e - sum(comp.values()))
+        return e2e, comp
+
+    def _observe(self, fr):
+        """Once-per-request fleet SLO accounting, run every step and
+        at close: observe fleet TTFT the first time a first token is
+        visible, and on completion observe cadence, absorb the final
+        hop's flight record, and retire the stitched journey with the
+        decomposition in its meta."""
+        t_first = fr.t_first
+        if fr._ttft_seen is None and t_first is not None:
+            ttft = (t_first - fr._t_submit) * 1e3
+            fr._ttft_seen = ttft
+            _TM_FLEET_TTFT.observe(ttft)
+            if self.slo_ttft_ms is not None:
+                (_TM_FLEET_SLO_TTFT_OK if ttft <= self.slo_ttft_ms
+                 else _TM_FLEET_SLO_TTFT_MISS).inc()
+        if not fr.done or fr._finalized:
+            return
+        fr._finalized = True
+        t_done = fr.t_done
+        gen = len(fr.tokens) - fr.resumed
+        cadence = None
+        if t_first is not None and t_done is not None and gen > 1:
+            cadence = (t_done - t_first) / (gen - 1) * 1e3
+            _TM_FLEET_CADENCE.observe(cadence)
+            if self.slo_cadence_ms is not None:
+                (_TM_FLEET_SLO_CAD_OK
+                 if cadence <= self.slo_cadence_ms
+                 else _TM_FLEET_SLO_CAD_MISS).inc()
+        if not self.flight.enabled:
+            return
+        rep = self._replicas.get(fr._replica_id) \
+            if fr._replica_id is not None else None
+        if rep is not None:
+            self._absorb_hop(fr, rep)
+        e2e, comp = self._breakdown(
+            fr, t_done if t_done is not None else time.perf_counter())
+        slo = {k: round(v, 3) for k, v in comp.items()}
+        slo["e2e_ms"] = round(e2e, 3)
+        if fr._ttft_seen is not None:
+            slo["ttft_ms"] = round(fr._ttft_seen, 3)
+        if cadence is not None:
+            slo["cadence_ms"] = round(cadence, 3)
+        self.flight.retire(fr.id, fr.retire_reason or "done",
+                           tokens=len(fr.tokens),
+                           migrations=fr.migrations, slo=slo)
+
+    def _slo_tick(self, now=None):
+        """Refresh the fleet multi-window burn gauges (rate-limited
+        inside ``tele.SloWindow``) — the engine-side ``_slo_tick``
+        mirrored at fleet scope. Called at the end of every
+        :meth:`step` and by the exposition server per ``/fleet``
+        scrape."""
+        for kind, thr, hist, windows in (
+                ("ttft", self.slo_ttft_ms, _TM_FLEET_TTFT,
+                 _FLEET_SLO_TTFT_WINDOWS),
+                ("cadence", self.slo_cadence_ms, _TM_FLEET_CADENCE,
+                 _FLEET_SLO_CADENCE_WINDOWS)):
+            if thr is None:
+                continue
+            w = self._slo_windows.get(kind)
+            if w is None or w.threshold != float(thr):
+                w = tele.SloWindow(
+                    hist, thr, target=self.slo_target,
+                    windows=[(s, g) for s, g in windows])
+                self._slo_windows[kind] = w
+            w.tick(now)
+
+    def fleet_table(self):
+        """The ``GET /fleet`` rollup: per-replica health (role,
+        occupancy, queue — dead replicas abbreviated), router queue
+        state, lifetime stats, handoff figures, the SLO thresholds
+        with their current burn-gauge readings, and the flight ring
+        occupancy."""
+        tbl = self.health()
+        tbl["stats"] = {k: int(v) for k, v in self.stats.items()}
+        live, retired = self.flight.ids()
+        tbl["flight"] = {"live": live, "retired": retired}
+        slo = {"ttft_ms": self.slo_ttft_ms,
+               "cadence_ms": self.slo_cadence_ms,
+               "target": self.slo_target}
+        for kind, windows in (("ttft", _FLEET_SLO_TTFT_WINDOWS),
+                              ("cadence", _FLEET_SLO_CADENCE_WINDOWS)):
+            slo[kind + "_burn"] = {
+                g.name.rsplit("_", 1)[-1]: g.value
+                for _, g in windows}
+        tbl["slo"] = slo
+        return tbl
 
     # -- the drive loop -------------------------------------------------
     def step(self):
@@ -1005,6 +1544,13 @@ class FleetRouter:
                 self._fail_over(rep, "died mid-round")
         self._collect_handoffs()
         self._deliver_handoffs()
+        # fleet SLO + journey finalization BEFORE the prune drops done
+        # handles (attribute guards make the sweep a no-op per settled
+        # request)
+        for fr in list(self._requests.values()):
+            if not fr._finalized:
+                self._observe(fr)
+        self._slo_tick(now)
         if self._requests and not self.stats["steps"] % 16:
             self._requests = {k: v for k, v in self._requests.items()
                               if not v.done}
@@ -1089,6 +1635,11 @@ class FleetRouter:
                 pkg.resolve()
             if not fr.done:
                 fr._error = err
+        # settle the books: every journey retires (post-close replica
+        # flight records are still readable — host-side rings)
+        for fr in list(self._requests.values()):
+            if not fr._finalized:
+                self._observe(fr)
         _TM_LIVE.set(0)
 
     def __enter__(self):

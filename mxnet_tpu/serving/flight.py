@@ -176,6 +176,27 @@ class FlightRecorder:
                     "events": list(fl.events),
                     "dropped_events": fl.dropped}
 
+    def records(self, rid):
+        """Raw records for a request id, retired-then-live, each as
+        ``(t0, events)`` with ``t0`` the ABSOLUTE ``perf_counter``
+        stamp of the record's submit. A fleet router stitches these
+        onto its own clock (``serving/fleet.py``): the same id can
+        legitimately own TWO records at once on one engine — a
+        prefill-role record retired with ``reason="handoff"`` plus the
+        live decode-side record ``admit_handoff`` opened — and a
+        failover resubmit restarts the live record, so the router
+        copies events out as hops complete rather than referencing
+        them in place."""
+        with self._lock:
+            out = []
+            fl = self._retired.get(rid)
+            if fl is not None:
+                out.append((fl.t0, list(fl.events)))
+            fl = self._live.get(rid)
+            if fl is not None:
+                out.append((fl.t0, list(fl.events)))
+            return out
+
     def rows(self):
         """Summary rows for the retired ring (oldest first) — the
         "recently retired" half of the exposition server's

@@ -136,7 +136,7 @@ class KVHandoff:
     __slots__ = ("id", "prompt", "tokens", "max_tokens", "eos_id",
                  "temperature", "seed", "prefill_len", "last",
                  "prefill_seq", "slot", "source", "resolved",
-                 "t_ready", "_packed", "_nbytes")
+                 "t_ready", "trace", "_packed", "_nbytes")
 
     def __init__(self, engine, req, slot):
         self.id = req.id
@@ -162,6 +162,10 @@ class KVHandoff:
         self.slot = int(slot)
         self.source = engine
         self.resolved = False
+        # fleet trace context ((trace_id, hop) or None) rides the
+        # package so the decode side's flight events keep the fleet
+        # identity across the wire.
+        self.trace = getattr(req, "trace", None)
         self.t_ready = time.perf_counter()
         self._packed = None
         self._nbytes = 0
@@ -195,6 +199,7 @@ class KVHandoff:
             "seed": self.seed,
             "prefill_len": self.prefill_len,
             "last": self.last,
+            "trace": self.trace,
             "rows": self.materialize() if with_rows else None,
         }
 

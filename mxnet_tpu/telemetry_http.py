@@ -28,6 +28,15 @@ strictly read-only GET endpoints:
     One request's flight-recorder timeline (submit → … → retire
     reason), available after retirement for the last
     ``MXNET_SERVING_FLIGHT_RECORDER`` retired requests.
+``/fleet``
+    Aggregated fleet plane across every live :class:`FleetRouter`:
+    per-replica role/health/occupancy, handoff stats, SLO thresholds
+    + burn readings, and the stitched-journey ring occupancy.
+``/fleet/flight/<trace_id>``
+    One request's STITCHED cross-replica journey (router + wire +
+    per-engine events on one monotonic clock, SLO decomposition in
+    the meta); ``?chrome=1`` returns the Perfetto/chrome-trace export
+    (one track per replica).
 ``/healthz``
     Engine liveness fed by the PR 7 watchdog state: 200 while no
     engine is stuck, 503 when a ``round_timeout_ms`` trip has not yet
@@ -74,11 +83,23 @@ def _engines():
         return []
 
 
+def _routers():
+    """Live FleetRouters in this process (weak registry in
+    serving.fleet; empty when the fleet layer was never imported)."""
+    fleet = sys.modules.get("mxnet_tpu.serving.fleet")
+    if fleet is None:
+        return []
+    try:
+        return [r for r in fleet._ROUTERS if not r._closed]
+    except Exception:
+        return []
+
+
 def _refresh():
     """Pre-scrape refresh, all best-effort and host-side: program
     cost analyses (cached lowerings — no compile, no trace), device
-    memory gauges, serving SLO burn rates. A failure in any refresher
-    must never fail the scrape."""
+    memory gauges, serving + fleet SLO burn rates. A failure in any
+    refresher must never fail the scrape."""
     try:
         from . import profiler
         profiler.collect_program_stats()
@@ -88,6 +109,11 @@ def _refresh():
     for e in _engines():
         try:
             e._slo_tick()
+        except Exception:
+            pass
+    for r in _routers():
+        try:
+            r._slo_tick()
         except Exception:
             pass
 
@@ -146,6 +172,32 @@ def _route(path, query=None):
                 continue
         return (200, "application/json",
                 json.dumps({"requests": _scrub(rows)}).encode())
+    if path.startswith("/fleet/flight/"):
+        rid = path[len("/fleet/flight/"):].rstrip("/")
+        chrome = query.get("chrome") in ("1", "true", "yes")
+        for r in _routers():
+            try:
+                tl = r.flight.chrome_trace(rid) if chrome \
+                    else r.flight.timeline(rid)
+            except Exception:
+                tl = None
+            if tl is not None:
+                return (200, "application/json",
+                        json.dumps(_scrub(tl)).encode())
+        return (404, "application/json",
+                json.dumps({"error": "no stitched journey for trace "
+                            "%r (ring keeps the last N retired "
+                            "journeys per router)" % rid}).encode())
+    if path in ("/fleet", "/fleet/"):
+        _refresh()
+        fleets = []
+        for r in _routers():
+            try:
+                fleets.append(r.fleet_table())
+            except Exception:
+                continue
+        return (200, "application/json",
+                json.dumps({"fleets": _scrub(fleets)}).encode())
     if path.startswith("/flight/"):
         rid = path[len("/flight/"):].rstrip("/")
         keys = [rid]
@@ -184,6 +236,7 @@ def _route(path, query=None):
         return (200, "application/json", json.dumps(
             {"endpoints": ["/metrics", "/snapshot", "/requests",
                            "/flight/<request_id>", "/rounds",
+                           "/fleet", "/fleet/flight/<trace_id>",
                            "/healthz"]}
         ).encode())
     return (404, "application/json",

@@ -280,9 +280,16 @@ def test_http_requests_flight_healthz_with_stub_engine():
             self.stuck = False
 
         def request_table(self):
-            return [{"id": "stub-1", "state": "running",
+            # the engine contract since ISSUE 19: every row names its
+            # owning engine and role (a multi-replica process exposes
+            # every engine's table on ONE /requests endpoint)
+            rows = [{"id": "stub-1", "state": "running",
                      "prompt_len": 3, "tokens": 1, "age_s": 0.5}] \
                 + self.flight.rows()
+            for row in rows:
+                row["engine_id"] = "stub-e0"
+                row["role"] = "unified"
+            return rows
 
         def health(self):
             return {"closed": False, "stuck": self.stuck,
@@ -298,8 +305,14 @@ def test_http_requests_flight_healthz_with_stub_engine():
         _, _, body = _get(srv.url + "/requests")
         rows = json.loads(body)["requests"]
         assert {"id": "stub-1", "state": "running", "prompt_len": 3,
-                "tokens": 1, "age_s": 0.5} in rows
+                "tokens": 1, "age_s": 0.5, "engine_id": "stub-e0",
+                "role": "unified"} in rows
         assert any(r.get("state") == "retired" for r in rows)
+        # ISSUE 19 S1 pin: every row carries the owning engine + role
+        stub_rows = [r for r in rows if r["id"] == "stub-1"]
+        assert len(stub_rows) >= 2        # the running + retired rows
+        assert all(r["engine_id"] == "stub-e0" and r["role"] == "unified"
+                   for r in stub_rows)
         _, _, body = _get(srv.url + "/flight/stub-1")
         tl = json.loads(body)
         assert [e["event"] for e in tl["events"]] == \
@@ -772,3 +785,201 @@ def test_dump_telemetry_url_and_watch_read_live_server(capsys):
     # exactly one source required
     with pytest.raises(SystemExit):
         dump_telemetry.main([])
+
+
+# -- the fleet tracing plane (ISSUE 19) --------------------------------
+
+def _stub_journey(rid="f9"):
+    """A stitched journey built without a fleet: router events plus an
+    engine-side FlightRecorder absorbed at hop boundaries — the exact
+    shape FleetRouter produces, minus the engines."""
+    from mxnet_tpu.serving.fleet import FleetFlightRecorder
+
+    ffr = FleetFlightRecorder(retain=4)
+    ffr.start(rid, prompt_len=3, max_tokens=4)
+    ffr.hop(rid, "eng-a")
+    ffr.hop(rid, "eng-a")                 # consecutive dup collapses
+    ffr.event(rid, "placed", replica="eng-a", reason="least_loaded",
+              hop=1)
+    efr = FlightRecorder(retain=4)
+    efr.start(rid, prompt_len=3, trace=rid, hop=1)
+    efr.event(rid, "admitted", slot=0)
+    ffr.absorb(rid, "eng-a", efr.records(rid))   # mid-life absorption
+    efr.event(rid, "first_token", ttft_ms=1.0)
+    efr.retire(rid, "length", tokens=4)
+    ffr.absorb(rid, "eng-a", efr.records(rid))   # hop-end absorption
+    ffr.absorb(rid, "eng-a", efr.records(rid))   # idempotent
+    ffr.retire(rid, "length", tokens=4, migrations=0,
+               slo={"router_queue": 0.1, "prefill": 0.9,
+                    "handoff_wait": 0.0, "decode_admission": 0.0,
+                    "decode": 2.0, "e2e_ms": 3.0, "ttft_ms": 1.0})
+    return ffr
+
+
+def test_fleet_flight_recorder_stitching_and_bounds():
+    """FleetFlightRecorder unit pins: absorption is idempotent per
+    engine record (a live timeline() query mid-hop plus the hop-end
+    sweep double-absorbs the same record — events must not
+    duplicate), absorbed events land on ONE ascending clock tagged
+    with their scope, consecutive duplicate hops collapse, the
+    per-journey event cap drops-and-counts with the terminal retire
+    always landing, and the ring evicts oldest-first."""
+    from mxnet_tpu.serving.fleet import FleetFlightRecorder
+
+    ffr = _stub_journey()
+    tl = ffr.timeline("f9")
+    assert tl is not None and not tl["live"]
+    assert tl["hops"] == ["eng-a"]
+    names = [(e["scope"], e["event"]) for e in tl["events"]]
+    # each engine event exactly once despite the triple absorb
+    assert names.count(("eng-a", "admitted")) == 1
+    assert names.count(("eng-a", "first_token")) == 1
+    assert names.count(("eng-a", "retire")) == 1
+    assert names[0] == ("router", "submit")
+    assert names[-1] == ("router", "retire")
+    ts = [e["t_ms"] for e in tl["events"]]
+    assert ts == sorted(ts) and ts[0] == 0.0
+    # the absorbed submit kept the trace context it was recorded with
+    sub = [e for e in tl["events"]
+           if e["scope"] == "eng-a" and e["event"] == "submit"][0]
+    assert sub["trace"] == "f9" and sub["hop"] == 1
+    assert tl["meta"]["slo"]["e2e_ms"] == 3.0
+    # chrome export: one named track per scope, SLO components as
+    # back-to-back spans on the router track
+    ch = ffr.chrome_trace("f9")
+    tracks = {e["args"]["name"] for e in ch["traceEvents"]
+              if e.get("ph") == "M"}
+    assert tracks == {"router", "eng-a"}
+    spans = [e for e in ch["traceEvents"] if e.get("ph") == "X"]
+    assert [s["name"] for s in spans] == [
+        "router_queue", "prefill", "handoff_wait",
+        "decode_admission", "decode"]
+    assert ch["otherData"]["trace_id"] == "f9"
+
+    # event cap: drops counted, terminal retire still lands
+    capped = FleetFlightRecorder(retain=2, max_events=8)
+    capped.start("c", prompt_len=1)
+    for i in range(12):
+        capped.event("c", "placed", attempt=i)
+    capped.retire("c", "done")
+    tl = capped.timeline("c")
+    assert tl["dropped_events"] == 5       # 1 submit + 7 of 12 + retire
+    assert tl["events"][-1]["event"] == "retire"
+    # ring eviction, oldest first
+    for rid in ("r1", "r2"):
+        capped.start(rid, prompt_len=1)
+        capped.retire(rid, "done")
+    assert capped.timeline("c") is None
+    assert capped.timeline("r1") is not None
+    live, retired = capped.ids()
+    assert live == [] and retired == ["r1", "r2"]
+    # disabled recorder: every call a no-op
+    off = FleetFlightRecorder(retain=0)
+    off.start("x", prompt_len=1)
+    off.retire("x", "done")
+    assert off.timeline("x") is None and off.rows() == []
+
+
+def test_http_fleet_endpoints_with_stub_router():
+    """/fleet aggregates fleet_table() over the live-router registry
+    and /fleet/flight/<id> searches each router's stitched ring
+    (?chrome=1 for the Perfetto export) — duck-typed like the engine
+    endpoints, so a stub keeps this zero-compile (the real fleet path
+    is pinned in test_serving_disagg.py)."""
+    from mxnet_tpu.serving import fleet as fleet_mod
+
+    class _StubRouter:
+        _closed = False
+
+        def __init__(self):
+            self.flight = _stub_journey()
+            self.ticks = 0
+
+        def _slo_tick(self, now=None):
+            self.ticks += 1
+
+        def fleet_table(self):
+            live, retired = self.flight.ids()
+            return {"replicas": [{"id": "eng-a", "role": "unified",
+                                  "alive": True}],
+                    "stats": {"handoffs": 0},
+                    "flight": {"live": live, "retired": retired},
+                    "slo": {"ttft_ms": None, "cadence_ms": None}}
+
+    router = _StubRouter()
+    fleet_mod._ROUTERS.add(router)
+    srv = tele.serve(port=0)
+    try:
+        _, _, body = _get(srv.url + "/fleet")
+        fleets = json.loads(body)["fleets"]
+        ours = [f for f in fleets
+                if f["replicas"][0]["id"] == "eng-a"]
+        assert len(ours) == 1
+        assert ours[0]["flight"]["retired"] == ["f9"]
+        assert router.ticks >= 1          # the scrape's SLO refresh
+        _, _, body = _get(srv.url + "/fleet/flight/f9")
+        tl = json.loads(body)
+        assert tl["id"] == "f9" and tl["hops"] == ["eng-a"]
+        assert tl["meta"]["slo"]["ttft_ms"] == 1.0
+        scopes = {e["scope"] for e in tl["events"]}
+        assert scopes == {"router", "eng-a"}
+        _, _, body = _get(srv.url + "/fleet/flight/f9?chrome=1")
+        ch = json.loads(body)
+        assert ch["otherData"]["trace_id"] == "f9"
+        assert any(e.get("cat") == "fleet.slo"
+                   for e in ch["traceEvents"])
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.url + "/fleet/flight/never-traced")
+        assert e.value.code == 404
+        assert "stitched" in json.loads(e.value.read())["error"]
+        # a closed router drops out of the aggregation
+        router._closed = True
+        _, _, body = _get(srv.url + "/fleet")
+        assert not [f for f in json.loads(body)["fleets"]
+                    if f.get("replicas", [{}])[0].get("id") == "eng-a"]
+    finally:
+        fleet_mod._ROUTERS.discard(router)
+        tele.stop_server()
+
+
+def test_dump_telemetry_fleet_trace_printer(capsys):
+    """``--fleet --trace <id> --url ...`` prints one stitched journey
+    from /fleet/flight/<id> — hops header, per-event scope table, the
+    SLO decomposition — and composes with ``--watch`` for a live
+    view."""
+    from tools import dump_telemetry
+    from mxnet_tpu.serving import fleet as fleet_mod
+
+    class _StubRouter:
+        _closed = False
+        flight = None
+
+        def _slo_tick(self, now=None):
+            pass
+
+        def fleet_table(self):
+            return {"replicas": [], "stats": {}, "flight": {}, "slo": {}}
+
+    router = _StubRouter()
+    router.flight = _stub_journey()
+    fleet_mod._ROUTERS.add(router)
+    srv = tele.serve(port=0)
+    try:
+        dump_telemetry.main(["--url", srv.url, "--fleet",
+                             "--trace", "f9"])
+        out = capsys.readouterr().out
+        assert "trace f9" in out and "retired(length)" in out
+        assert "hops: eng-a" in out
+        assert "first_token" in out and "eng-a" in out
+        assert "slo decomposition" in out
+        assert "router_queue" in out and "e2e_ms" in out
+        # --watch composes: the journey re-prints per refresh
+        dump_telemetry.main(["--url", srv.url, "--fleet", "--trace",
+                             "f9", "--watch", "0.01",
+                             "--watch-count", "2"])
+        out = capsys.readouterr().out
+        assert out.count("--- refresh") == 2
+        assert out.count("trace f9") == 2
+    finally:
+        fleet_mod._ROUTERS.discard(router)
+        tele.stop_server()

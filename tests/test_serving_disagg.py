@@ -1,5 +1,9 @@
 """Disaggregated prefill/decode serving (ISSUE 18): role-specialized
-engines with KV handoff through the fleet router.
+engines with KV handoff through the fleet router — and the fleet
+tracing plane stitched over it (ISSUE 19): every journey here that
+crosses a role boundary, a retry, or a failover must reconstruct as
+ONE ordered cross-replica timeline whose SLO decomposition sums to
+the measured end-to-end time.
 
 The correctness bar: a request prefilled on a ``role="prefill"``
 engine, packaged (live KV rows + sampling identity + first emitted
@@ -425,3 +429,248 @@ def test_replay_roles_1p1d_verify_clean(lm, tmp_path):
                 for r in fleet.replica_ids(live_only=True)]
         assert "decode" in [e.role for e in live]
         _assert_clean(*live)
+
+
+def test_capture_role_round_trip(lm, tmp_path):
+    """Satellite S3 (ISSUE 19): the capture header names the role it
+    was recorded on, and the fleet identity rides every record. A
+    1P+1D fleet with capture armed yields a DECODE-specialist capture
+    whose submits are all handoff admissions (resume_tokens present,
+    hop 2, trace_id = the fleet request id); ``role_report`` flags a
+    specialist capture replayed without ``--roles`` (and stays silent
+    when the topology is reproduced); and the specialist capture
+    replays ``--verify``-clean on ONE unified engine — byte-identical
+    by the disaggregation contract, topology change noted, not
+    hidden."""
+    fleet, (ep, ed) = _mkfleet(lm, ("prefill", "decode"),
+                               eng_kw={"capture_dir": str(tmp_path)})
+    rng = np.random.RandomState(37)
+    p = rng.randint(0, VOCAB, (5,))
+    with fleet:
+        h = fleet.submit(p, max_tokens=4)
+        fleet.serve_forever()
+        want = _oracle(lm, p, 4)
+        np.testing.assert_array_equal(np.asarray(h.result()), want)
+        dpath = ed.capture.path
+        trace_id = h.id
+        _assert_clean(ep, ed)
+    # the decode side's capture: role in the header, fleet identity
+    # in every submit, every submit a handoff admission
+    cap = load_capture(dpath)
+    assert cap["engine"]["role"] == "decode"
+    subs = cap["submits"]
+    assert len(subs) == 1
+    assert subs[0]["trace_id"] == trace_id
+    assert subs[0]["hop"] == 2
+    assert subs[0]["resume_tokens"]          # admitted mid-journey
+    # role_report: specialist capture without a role topology → note;
+    # with the captured topology reproduced → silent
+    role, note = replay_serving.role_report(cap)
+    assert role == "decode"
+    assert note is not None and "decode" in note and "--roles" in note
+    role, note = replay_serving.role_report(cap, (1, 1))
+    assert role == "decode" and note is None
+    # the round trip: replay the specialist capture on one UNIFIED
+    # engine — byte-identical even though no role boundary is crossed
+    uni = replay_serving.build_engine(cap, _mkdec(lm), role="unified")
+    report = replay_serving.replay(cap, uni, timing="max", verify=True)
+    assert report["mismatches"] == []
+    assert report["verified"] == 1
+    assert report["verify_skipped"] == 0
+    # the captured fleet identity survived the plain-engine replay
+    rows = uni.request_table()
+    assert [r["id"] for r in rows] == [trace_id]
+    _assert_clean(uni)
+    uni.close()
+
+
+def _slo_sums(slo):
+    """The decomposition's arithmetic pins: the five components sum to
+    the measured end-to-end wall time, and the first two are EXACTLY
+    the fleet TTFT window (tolerance covers per-component 0.001 ms
+    rounding only — the sums hold by construction, not by luck)."""
+    comps = ("router_queue", "prefill", "handoff_wait",
+             "decode_admission", "decode")
+    total = sum(slo[c] for c in comps)
+    assert abs(total - slo["e2e_ms"]) <= 0.01, slo
+    assert abs(slo["router_queue"] + slo["prefill"]
+               - slo["ttft_ms"]) <= 0.01, slo
+    assert all(slo[c] >= 0.0 for c in comps), slo
+
+
+def test_fleet_trace_stitched_timeline_under_faults(lm):
+    """THE ISSUE 19 acceptance drill: one request through a 1P+1D
+    fleet with a forced handoff retry AND a decode-replica death
+    mid-decode reconstructs — over HTTP, ``GET /fleet/flight/<id>`` —
+    as a single ordered timeline: submit, role placement, the prefill
+    hop's own events (first_token, handoff_export), the wire retry,
+    the decode-side admission, the failover, the migration onto the
+    promoted survivor, and the terminal retire, timestamps ascending
+    on one clock. The TTFT decomposition in the journey's meta sums
+    to the measured TTFT and end-to-end time; output stays
+    byte-identical through all of it."""
+    import json
+    import urllib.request
+
+    fleet, (ep, ed) = _mkfleet(lm, ("prefill", "decode"),
+                               slo_ttft_ms=1e5, slo_cadence_ms=1e5)
+    rng = np.random.RandomState(31)
+    p = rng.randint(0, VOCAB, (6,))
+    fi = FaultInjector()
+    with fleet:
+        with fi.fleet_handoff_failures(ed.engine_id, n=1):
+            h = fleet.submit(p, max_tokens=6)
+            for _ in range(200):
+                fleet.step()
+                if fleet.stats["handoffs"] == 1:
+                    break
+        assert fleet.stats["handoffs"] == 1      # retried, then landed
+        assert not h.done                        # decode still running
+        with fi.fleet_kill_replica(ed.engine_id):
+            fleet.step()                         # decode dies mid-round
+        fleet.serve_forever()
+        assert fleet.stats["failovers"] == 1
+        assert fleet.stats["role_promotions"] == 1
+        assert ep.role == "unified"
+        np.testing.assert_array_equal(np.asarray(h.result()),
+                                      _oracle(lm, p, 6))
+
+        tl = fleet.flight.timeline(h.id)
+        assert tl is not None and not tl["live"]
+        assert tl["dropped_events"] == 0
+        assert tl["hops"] == [ep.engine_id, ed.engine_id, ep.engine_id]
+        ts = [e["t_ms"] for e in tl["events"]]
+        assert ts == sorted(ts) and ts[0] == 0.0   # one monotonic clock
+        names = [e["event"] for e in tl["events"]]
+        assert names[0] == "submit" and names[-1] == "retire"
+        for must in ("placed", "first_token", "handoff_export",
+                     "in_transit", "retried", "admitted",
+                     "handoff_import", "failover", "migrated"):
+            assert must in names, (must, names)
+        # the journey's internal order — scope-qualified, because the
+        # ENGINE hops also record an "admitted"/"submit" of their own
+        # (slot admission vs the router's wire admission): placement
+        # before the export, the wire retry before the decode
+        # admission, the failover after it, the migration last
+        def _first(name, scope=None):
+            for i, e in enumerate(tl["events"]):
+                if e["event"] == name and \
+                        (scope is None or e["scope"] == scope):
+                    return i, e
+            raise AssertionError((name, scope, names))
+
+        keyed = [("placed", "router"), ("handoff_export", None),
+                 ("retried", "router"), ("admitted", "router"),
+                 ("failover", "router"), ("migrated", "router")]
+        order = [_first(n, s)[0] for n, s in keyed]
+        assert order == sorted(order), \
+            list(zip(order, (n for n, _ in keyed)))
+        by = {n: _first(n, s)[1] for n, s in keyed}
+        assert by["placed"]["reason"] == "role"
+        assert by["placed"]["replica"] == ep.engine_id
+        assert by["retried"]["op"] == "handoff"
+        assert by["admitted"]["replica"] == ed.engine_id
+        assert by["admitted"]["bytes"] > 0
+        assert by["admitted"]["pool_hit"] is False
+        assert by["failover"]["from"] == ed.engine_id
+        assert by["migrated"]["to"] == ep.engine_id
+        # per-engine events carry the trace context: same trace id,
+        # hop 1 on the prefill side, hop 2 on the decode side
+        eng_submits = [e for e in tl["events"]
+                      if e["event"] == "submit" and e["scope"] != "router"]
+        assert {e["trace"] for e in eng_submits} == {h.id}
+        assert {(e["scope"], e["hop"]) for e in eng_submits} >= \
+            {(ep.engine_id, 1), (ed.engine_id, 2)}
+
+        # the SLO decomposition sums — and matches the handle's own
+        # measured TTFT
+        slo = tl["meta"]["slo"]
+        _slo_sums(slo)
+        assert abs(slo["ttft_ms"]
+                   - (h.t_first - h.t_submit) * 1e3) <= 0.01
+        assert "cadence_ms" in slo
+
+        # the same journey over the wire: /fleet lists it, the
+        # per-trace endpoint serves the identical stitched timeline,
+        # and ?chrome=1 exports it for Perfetto
+        import mxnet_tpu as mx
+        srv = mx.telemetry.serve(port=0)
+        try:
+            with urllib.request.urlopen(srv.url + "/fleet",
+                                        timeout=10) as resp:
+                fleets = json.load(resp)["fleets"]
+            assert len(fleets) == 1
+            ft = fleets[0]
+            assert h.id in ft["flight"]["retired"]
+            assert ft["slo"]["ttft_ms"] == 1e5
+            assert set(ft["slo"]["ttft_burn"]) == {"1m", "5m", "1h"}
+            with urllib.request.urlopen(
+                    srv.url + "/fleet/flight/%s" % h.id,
+                    timeout=10) as resp:
+                wire = json.load(resp)
+            assert wire["events"] == json.loads(
+                json.dumps(tl["events"]))
+            assert wire["meta"]["slo"] == json.loads(
+                json.dumps(slo))
+            with urllib.request.urlopen(
+                    srv.url + "/fleet/flight/%s?chrome=1" % h.id,
+                    timeout=10) as resp:
+                chrome = json.load(resp)
+            assert chrome["otherData"]["trace_id"] == h.id
+            spans = [e for e in chrome["traceEvents"]
+                     if e.get("cat") == "fleet.slo"]
+            assert [s["name"] for s in spans] == [
+                "router_queue", "prefill", "handoff_wait",
+                "decode_admission", "decode"]
+        finally:
+            mx.telemetry.stop_server()
+        _assert_clean(ep)
+        assert_compile_contract(ep)
+    ed.close()
+
+
+def test_fleet_trace_continuity_unified_fallback(lm):
+    """Trace continuity through the OTHER fault shape (the
+    test_decode_death_falls_back_to_unified script): channel budget
+    exhausts with NO retry budget while the package is in transit, the
+    decode replica is declared dead, and the journey continues on the
+    promoted unified survivor — still ONE stitched timeline,
+    ascending, with the mid-transit failover visible (reason: target
+    died in transit) and the re-delivery landing as a hop-2 admission
+    on the survivor, and the decomposition still summing."""
+    fleet, (ep, ed) = _mkfleet(lm, ("prefill", "decode"),
+                               max_retries=0)
+    rng = np.random.RandomState(37)
+    p = rng.randint(0, VOCAB, (6,))
+    fi = FaultInjector()
+    with fleet:
+        with fi.fleet_handoff_failures(ed.engine_id, n=2):
+            h = fleet.submit(p, max_tokens=5)
+            fleet.serve_forever()
+        np.testing.assert_array_equal(np.asarray(h.result()),
+                                      _oracle(lm, p, 5))
+        assert fleet.stats["failovers"] == 1
+        assert fleet.stats["role_promotions"] == 1
+        tl = fleet.flight.timeline(h.id)
+        assert tl is not None and not tl["live"]
+        ts = [e["t_ms"] for e in tl["events"]]
+        assert ts == sorted(ts)
+        names = [e["event"] for e in tl["events"]]
+        assert names[0] == "submit" and names[-1] == "retire"
+        assert "retried" not in names            # no retry budget
+        routed = [e for e in tl["events"] if e["scope"] == "router"]
+        fo = [e for e in routed if e["event"] == "failover"]
+        assert len(fo) == 1
+        assert fo[0]["reason"] == "target died in transit"
+        assert fo[0]["from"] == ed.engine_id
+        adm = [e for e in routed if e["event"] == "admitted"]
+        assert len(adm) == 1
+        # the re-delivery landed on the promoted survivor out of its
+        # own pool, as the journey's hop 2
+        assert adm[0]["replica"] == ep.engine_id
+        assert adm[0]["pool_hit"] is True and adm[0]["hop"] == 2
+        assert routed.index(fo[0]) < routed.index(adm[0])
+        assert tl["hops"] == [ep.engine_id]      # consecutive collapse
+        assert h.migrations == 0                 # re-delivered, not
+        _slo_sums(tl["meta"]["slo"])             # re-prefilled
+    ed.close()

@@ -566,6 +566,10 @@ def test_flight_recorder_reconstructs_failed_request_over_http(lm,
         by_id = {r["id"]: r for r in rows if r["state"] == "retired"}
         assert by_id[r_bad.id]["retire_reason"] == "error"
         assert by_id[r_ok.id]["retire_reason"] == "length"
+        # every row names its owning engine and role (the multi-replica
+        # /requests disambiguation, ISSUE 19)
+        assert by_id[r_ok.id]["engine_id"] == feng.engine_id
+        assert by_id[r_ok.id]["role"] == "unified"
         with urllib.request.urlopen(srv.url + "/healthz",
                                     timeout=10) as resp:
             assert json.load(resp)["status"] == "ok"

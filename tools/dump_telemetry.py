@@ -13,6 +13,7 @@ Usage::
     python tools/dump_telemetry.py BENCH_extra.json --fleet
     python tools/dump_telemetry.py --url http://host:9100   # live server
     python tools/dump_telemetry.py --url http://host:9100 --watch 2
+    python tools/dump_telemetry.py --url http://host:9100 --fleet --trace f3
 
 ``--url`` reads a LIVE process instead of a file: it fetches
 ``/snapshot`` from the exposition server ``mx.telemetry.serve`` /
@@ -42,7 +43,11 @@ doc/fault_tolerance.md "Fleet resilience"): live replicas, failovers,
 drains, migrated requests, channel retries, dedup hits, heartbeat
 misses, and affinity placements — the one-look answer to "did the
 fleet actually fail anything over, and did placement keep prefixes
-warm".
+warm". ``--fleet --trace <id>`` instead prints one request's STITCHED
+cross-replica journey — router, wire, and per-engine flight events on
+one clock plus the end-to-end SLO decomposition — fetched from
+``/fleet/flight/<id>`` with ``--url`` (or a saved timeline JSON);
+``--watch`` composes, re-printing a live journey as it unfolds.
 """
 from __future__ import annotations
 
@@ -274,6 +279,46 @@ def print_fleet(snap, out=None):
                      _fmt_hist(hms) if hms_live else "(empty)"))
 
 
+def print_fleet_trace(tl, out=None):
+    """One stitched cross-replica journey (``/fleet/flight/<id>``):
+    the ordered event timeline with the scope that recorded each one,
+    then the SLO decomposition — the components sum to the end-to-end
+    wall time by construction, so the table reads as "where the
+    request's life went"."""
+    out = out or sys.stdout
+    out.write("trace %s  %s" % (tl.get("id"),
+                                "LIVE" if tl.get("live")
+                                else "retired(%s)"
+                                % tl.get("meta", {}).get(
+                                    "retire_reason")))
+    hops = tl.get("hops") or []
+    if hops:
+        out.write("  hops: %s" % " -> ".join(str(h) for h in hops))
+    out.write("\n")
+    if tl.get("dropped_events"):
+        out.write("WARNING: %d events dropped at the per-request cap\n"
+                  % tl["dropped_events"])
+    out.write("%10s  %-14s %-16s %s\n"
+              % ("t_ms", "scope", "event", "detail"))
+    for ev in tl.get("events", ()):
+        detail = " ".join(
+            "%s=%s" % (k, v) for k, v in ev.items()
+            if k not in ("t_ms", "scope", "event", "slo"))
+        out.write("%10.3f  %-14s %-16s %s\n"
+                  % (ev.get("t_ms", 0), ev.get("scope", "?"),
+                     ev.get("event", "?"), detail))
+    slo = tl.get("meta", {}).get("slo")
+    if slo:
+        out.write("\nslo decomposition (sums to e2e):\n")
+        for comp in ("router_queue", "prefill", "handoff_wait",
+                     "decode_admission", "decode"):
+            if comp in slo:
+                out.write("  %-18s %10.3f ms\n" % (comp, slo[comp]))
+        for total in ("e2e_ms", "ttft_ms", "cadence_ms"):
+            if total in slo:
+                out.write("  %-18s %10.3f ms\n" % (total, slo[total]))
+
+
 def print_trace(doc, name_filters=(), out=None):
     out = out or sys.stdout
     evs = doc.get("traceEvents", [])
@@ -314,6 +359,11 @@ def _load(args):
     if args.url:
         import urllib.request
         url = args.url.rstrip("/")
+        if getattr(args, "trace", None):
+            with urllib.request.urlopen(
+                    "%s/fleet/flight/%s" % (url, args.trace),
+                    timeout=10) as resp:
+                return json.load(resp)
         last = url.rsplit("/", 1)[-1]
         if last == "metrics":
             # a copied Prometheus scrape URL: the text exposition is
@@ -328,6 +378,13 @@ def _load(args):
 
 
 def _print(doc, args, out=None):
+    if getattr(args, "trace", None) or (
+            isinstance(doc, dict) and "events" in doc and "id" in doc
+            and "meta" in doc):
+        # a stitched fleet journey (GET /fleet/flight/<id>, or the
+        # same JSON saved to a file)
+        print_fleet_trace(doc, out)
+        return
     if isinstance(doc, dict) and isinstance(doc.get("traceEvents"),
                                             list):
         names = tuple(args.names)
@@ -374,6 +431,11 @@ def main(argv=None):
                          "and channel counters (fleet.* — "
                          "doc/fault_tolerance.md 'Fleet resilience'); "
                          "composes with --serving")
+    ap.add_argument("--trace", default=None, metavar="ID",
+                    help="print one request's stitched cross-replica "
+                         "journey (fetched from /fleet/flight/<ID> "
+                         "with --url, or a saved timeline JSON file); "
+                         "--watch composes")
     ap.add_argument("--watch", type=float, default=None, metavar="SEC",
                     help="re-read and re-print the source every SEC "
                          "seconds until interrupted")

@@ -226,6 +226,12 @@ def replay(cap, engine, timing="recorded", verify=False,
                 and engine.queued() < engine.max_queue \
                 and (timing == "max" or submits[i]["t"] <= now):
             rec = submits[i]
+            kw = {}
+            if rec.get("trace_id") is not None \
+                    and not hasattr(engine, "replica_ids"):
+                # preserve the captured fleet identity on plain-engine
+                # replays; a FleetRouter mints its own trace context
+                kw["_trace"] = (rec["trace_id"], rec.get("hop", 1))
             req = engine.submit(
                 np.asarray(rec["prompt"], np.int32),
                 max_tokens=rec["max_tokens"],
@@ -233,7 +239,8 @@ def replay(cap, engine, timing="recorded", verify=False,
                 temperature=rec.get("temperature", 0.0),
                 seed=rec.get("seed"),
                 request_id=rec["id"],
-                _resume_tokens=tuple(rec.get("resume_tokens", ())))
+                _resume_tokens=tuple(rec.get("resume_tokens", ())),
+                **kw)
             handles.append((rec, req))
             i += 1
         engine.step()
@@ -301,6 +308,23 @@ def replay(cap, engine, timing="recorded", verify=False,
         report["verify_mode"] = verify_mode
         report["mismatches"] = mismatches
     return report
+
+
+def role_report(cap, roles_pd=None):
+    """Role round-trip (ISSUE 19): the capture header records the
+    source engine's role (next to engine_id/migrated_from). Returns
+    ``(captured_role, note)`` where ``note`` is non-None when a
+    SPECIALIST capture is being replayed without a role topology —
+    byte-identical either way by the disaggregation contract, but the
+    report must say the topology changed rather than stay silent."""
+    role = cap["engine"].get("role", "unified")
+    note = None
+    if role != "unified" and not roles_pd:
+        note = ("capture was recorded on a %s-role specialist but "
+                "replayed on a unified topology — byte-identical by "
+                "the disaggregation contract; pass --roles to "
+                "reproduce the captured topology" % role)
+    return role, note
 
 
 def main(argv=None):
@@ -440,6 +464,10 @@ def main(argv=None):
                     verify=args.verify, verify_mode=args.verify_mode,
                     on_round=on_round)
     report["overrides"] = overrides
+    captured_role, note = role_report(cap, roles_pd)
+    report["captured_role"] = captured_role
+    if note:
+        report["role_note"] = note
     if args.replicas or roles_pd:
         report["fleet"] = dict(engine.stats)
         if roles_pd:
